@@ -1,0 +1,120 @@
+"""pathway_tpu — a TPU-native live-dataflow framework.
+
+A brand-new implementation of the capabilities of the reference streaming
+framework (Tables + expressions DSL, incremental engine, connectors,
+temporal windows, indexes, LLM/RAG xpack), architected for TPU:
+JAX/XLA/Pallas numeric plane, device-mesh scale-out, host C++ kernel for
+the irregular hot loops.
+
+Import convention, same as the reference: `import pathway_tpu as pw`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import dtype as _dtype
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals import universe as _universe_mod
+from pathway_tpu.internals import udfs
+from pathway_tpu.internals.common import (
+    apply,
+    apply_async,
+    apply_with_type,
+    assert_table_has_schema,
+    cast,
+    coalesce,
+    declare_type,
+    fill_error,
+    if_else,
+    iterate,
+    make_tuple,
+    require,
+    table_transformer,
+    unwrap,
+)
+from pathway_tpu.internals.config import (
+    PathwayConfig,
+    get_config,
+    set_license_key,
+    set_monitoring_config,
+)
+from pathway_tpu.internals.datetime_types import DateTimeNaive, DateTimeUtc, Duration
+from pathway_tpu.internals.errors import global_error_log
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    left,
+    right,
+    this,
+)
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.keys import Key as Pointer
+from pathway_tpu.internals.run import run, run_all
+from pathway_tpu.internals.schema import (
+    ColumnDefinition,
+    Schema,
+    column_definition,
+    schema_builder,
+    schema_from_dict,
+    schema_from_pandas,
+    schema_from_types,
+)
+from pathway_tpu.internals.table import JoinMode, Table
+from pathway_tpu.internals.udfs import (
+    UDF,
+    AsyncRetryStrategy,
+    CacheStrategy,
+    DiskCache,
+    ExponentialBackoffRetryStrategy,
+    FixedDelayRetryStrategy,
+    InMemoryCache,
+    NoRetryStrategy,
+    async_executor,
+    auto_executor,
+    fully_async_executor,
+    sync_executor,
+    udf,
+)
+from pathway_tpu.internals.parse_graph import G as parse_graph_G  # noqa: N811
+
+# subpackages (import order matters: io/stdlib pull from internals)
+from pathway_tpu import debug  # noqa: E402
+from pathway_tpu import demo  # noqa: E402
+from pathway_tpu import io  # noqa: E402
+from pathway_tpu import persistence  # noqa: E402
+from pathway_tpu.stdlib import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils  # noqa: E402
+from pathway_tpu.internals.sql import sql  # noqa: E402
+from pathway_tpu.internals.yaml_loader import load_yaml  # noqa: E402
+from pathway_tpu.internals.custom_reducers import BaseCustomAccumulator  # noqa: E402
+
+# dtype namespace parity (pw.Json handled above)
+Pointer_dtype = _dtype.ANY_POINTER
+universes = _universe_mod
+
+
+class __module_shortcuts__:
+    pass
+
+
+# reference exposes reducers also at pw.reducers; xpacks lazily
+from pathway_tpu import xpacks  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Table", "Schema", "Json", "Pointer", "DateTimeNaive", "DateTimeUtc",
+    "Duration", "JoinMode", "ColumnExpression", "ColumnReference",
+    "this", "left", "right", "run", "run_all", "iterate",
+    "apply", "apply_async", "apply_with_type", "cast", "declare_type",
+    "coalesce", "require", "if_else", "make_tuple", "unwrap", "fill_error",
+    "assert_table_has_schema", "table_transformer",
+    "udf", "UDF", "udfs", "reducers",
+    "column_definition", "ColumnDefinition", "schema_from_types",
+    "schema_from_dict", "schema_from_pandas", "schema_builder",
+    "io", "debug", "demo", "persistence", "temporal", "indexing", "ml",
+    "graphs", "stateful", "statistical", "ordered", "utils", "universes",
+    "sql", "load_yaml", "BaseCustomAccumulator", "xpacks",
+    "get_config", "PathwayConfig", "set_license_key", "set_monitoring_config",
+    "global_error_log",
+]

@@ -1,0 +1,31 @@
+"""TPU-native incremental dataflow engine.
+
+This package is the equivalent of the reference's Rust engine
+(src/engine/dataflow.rs + vendored timely/differential fork), re-derived as
+a DBSP-style minimal core:
+
+- one total-ordered timestamp domain (even milliseconds, matching
+  src/engine/timestamp.rs:20-27) instead of Naiad product timestamps;
+- z-set (diff) collections flowing through a DAG of operator nodes;
+- a single-threaded pump per worker that finalizes one timestamp at a
+  time in topological order — progress tracking collapses to "the wave
+  for time t has fully drained", no distributed frontier protocol needed
+  on a single host;
+- numeric columns batch onto the XLA plane (engine/vectorize.py), hot
+  index/sort/join inner loops go through the C++ kernel
+  (pathway_tpu/native) when available;
+- multi-chip scale-out shards every arrangement by the 128-bit row key;
+  the exchange of numeric payloads is an ICI all_to_all
+  (pathway_tpu/parallel/exchange.py), host control plane carries the
+  frontier ticks.
+"""
+
+from pathway_tpu.engine.core import (
+    Entry,
+    Node,
+    Graph,
+    CaptureNode,
+)
+from pathway_tpu.engine.runtime import Runtime
+
+__all__ = ["Entry", "Node", "Graph", "CaptureNode", "Runtime"]

@@ -1,0 +1,1178 @@
+"""Engine core: z-set collections, operator nodes, arrangements.
+
+Reference parity: the ~60-op `Graph` trait (src/engine/graph.rs:664-1005)
+implemented over differential collections (src/engine/dataflow.rs). Here
+each op is a `Node` in a DAG; a `Graph` owns the nodes; the `Runtime`
+(engine/runtime.py) pumps timestamps through in topological order.
+
+Data model: an engine table is a keyed z-set — entries `(key, row, diff)`
+where `key` is a 128-bit pointer, `row` a tuple of values, `diff` a signed
+multiplicity. A healthy table has exactly one row per key (diff sum == 1);
+the general multiset form appears inside arrangements keyed by derived
+(join/group) keys.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Callable, Iterable, Sequence
+
+import numpy as np
+
+from pathway_tpu.internals.errors import ERROR, ErrorValue, global_error_log
+from pathway_tpu.internals.keys import Key, hash_values, key_for_values
+
+Entry = tuple[Key, tuple, int]  # (key, row, diff)
+
+
+# ------------------------------------------------------------------ hashing
+
+
+def freeze_value(v: Any) -> Any:
+    """Make a value usable as part of a dict key (multiset token)."""
+    if isinstance(v, np.ndarray):
+        return ("\x00ndarray", str(v.dtype), v.shape, v.tobytes())
+    if isinstance(v, tuple):
+        return tuple(freeze_value(x) for x in v)
+    if isinstance(v, dict):
+        from pathway_tpu.internals.json import Json
+
+        return ("\x00json", Json.dumps(v))
+    if isinstance(v, list):
+        return tuple(freeze_value(x) for x in v)
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return ("\x00repr", repr(v))
+
+
+def freeze_row(row: tuple) -> tuple:
+    return tuple(freeze_value(v) for v in row)
+
+
+def consolidate(entries: Iterable[Entry]) -> list[Entry]:
+    """Sum diffs of identical (key, row) pairs; drop zeros."""
+    acc: dict[tuple, tuple[Key, tuple, int]] = {}
+    for key, row, diff in entries:
+        token = (key.value, freeze_row(row))
+        if token in acc:
+            k, r, d = acc[token]
+            acc[token] = (k, r, d + diff)
+        else:
+            acc[token] = (key, row, diff)
+    return [(k, r, d) for (k, r, d) in acc.values() if d != 0]
+
+
+class KeyedState:
+    """Arrangement of a healthy keyed table: key -> row."""
+
+    __slots__ = ("rows",)
+
+    def __init__(self) -> None:
+        self.rows: dict[Key, tuple] = {}
+
+    def update(self, entries: Iterable[Entry]) -> None:
+        for key, row, diff in entries:
+            if diff > 0:
+                self.rows[key] = row
+            elif diff < 0:
+                existing = self.rows.get(key)
+                if existing is not None and freeze_row(existing) == freeze_row(row):
+                    del self.rows[key]
+
+    def get(self, key: Key) -> tuple | None:
+        return self.rows.get(key)
+
+    def items(self):
+        return self.rows.items()
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def as_entries(self) -> list[Entry]:
+        return [(k, r, 1) for k, r in self.rows.items()]
+
+
+class MultisetState:
+    """Arrangement by a derived key: dkey -> {token: (payload, count)}."""
+
+    __slots__ = ("groups",)
+
+    def __init__(self) -> None:
+        self.groups: dict[Any, dict[Any, tuple[Any, int]]] = {}
+
+    def update_one(self, dkey: Any, payload: Any, diff: int) -> None:
+        group = self.groups.get(dkey)
+        if group is None:
+            group = self.groups[dkey] = {}
+        token = freeze_value(payload)
+        cur = group.get(token)
+        new_count = (cur[1] if cur else 0) + diff
+        if new_count == 0:
+            group.pop(token, None)
+            if not group:
+                del self.groups[dkey]
+        else:
+            group[token] = (payload, new_count)
+
+    def get(self, dkey: Any) -> list[tuple[Any, int]]:
+        group = self.groups.get(dkey)
+        if not group:
+            return []
+        return list(group.values())
+
+    def group_keys(self):
+        return self.groups.keys()
+
+    def __contains__(self, dkey: Any) -> bool:
+        return dkey in self.groups
+
+
+# ------------------------------------------------------------------- nodes
+
+
+class Node:
+    """A dataflow operator. Inputs buffer entries; `finish_time` consumes
+    them when the wave for a timestamp reaches this node."""
+
+    def __init__(self, graph: "Graph", inputs: Sequence["Node"] = ()):
+        self.graph = graph
+        self.inputs = list(inputs)
+        self.downstream: list[tuple[Node, int]] = []
+        self.buffers: list[list[Entry]] = [[] for _ in inputs]
+        self.node_id = graph.register(self)
+        for i, inp in enumerate(self.inputs):
+            inp.downstream.append((self, i))
+        # observability (reference: OperatorStats graph.rs:520)
+        self.rows_in = 0
+        self.rows_out = 0
+
+    def accept(self, input_idx: int, entries: list[Entry]) -> None:
+        self.buffers[input_idx].extend(entries)
+
+    def emit(self, time: int, entries: list[Entry]) -> None:
+        if not entries:
+            return
+        self.rows_out += len(entries)
+        for node, idx in self.downstream:
+            node.accept(idx, entries)
+
+    def take_input(self, idx: int = 0) -> list[Entry]:
+        entries = self.buffers[idx]
+        self.buffers[idx] = []
+        self.rows_in += len(entries)
+        return entries
+
+    def finish_time(self, time: int) -> None:
+        raise NotImplementedError
+
+    def on_end(self, time: int) -> None:
+        """Called once when the stream is complete (frontier -> +inf)."""
+
+
+class Graph:
+    """Owns nodes in topological (creation) order."""
+
+    def __init__(self) -> None:
+        self.nodes: list[Node] = []
+        self.error_log = global_error_log()
+        self.terminate_on_error = False
+
+    def register(self, node: Node) -> int:
+        self.nodes.append(node)
+        return len(self.nodes) - 1
+
+    def log_error(self, message: str) -> None:
+        if self.terminate_on_error:
+            raise RuntimeError(message)
+        self.error_log.log(message)
+
+    def step(self, time: int) -> None:
+        for node in self.nodes:
+            node.finish_time(time)
+
+    def end(self, time: int) -> None:
+        for node in self.nodes:
+            node.on_end(time)
+            node.finish_time(time)
+
+
+class InputNode(Node):
+    """Entry point: the runtime / connector sessions push batches here."""
+
+    def __init__(self, graph: Graph):
+        super().__init__(graph, ())
+        self.pending: list[Entry] = []
+
+    def push(self, entries: list[Entry]) -> None:
+        self.pending.extend(entries)
+
+    def finish_time(self, time: int) -> None:
+        if self.pending:
+            out, self.pending = self.pending, []
+            self.emit(time, consolidate(out))
+
+
+class StatelessNode(Node):
+    """Map-like node: fn(entries) -> entries."""
+
+    def __init__(self, graph: Graph, inp: Node, fn: Callable[[list[Entry], int], list[Entry]]):
+        super().__init__(graph, [inp])
+        self.fn = fn
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        if entries:
+            self.emit(time, self.fn(entries, time))
+
+
+class RowwiseNode(Node):
+    """Evaluate compiled row functions over aligned same-universe inputs.
+
+    Reference: expression_table (dataflow.rs:1246) + Rowwise context.
+    Input 0 drives the universe; inputs 1..n are key-aligned side tables
+    whose current row is visible to the expressions.
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        inputs: Sequence[Node],
+        fn: Callable[..., tuple],
+        append_only: bool = False,
+    ):
+        super().__init__(graph, inputs)
+        self.fn = fn  # fn(key, *rows) -> out_row
+        self.side_states = [KeyedState() for _ in range(len(inputs) - 1)]
+        self.emitted: dict[Key, tuple] = {}
+        self.deferred: dict[Key, int] = {}
+
+    def _compute(self, key: Key, row0: tuple) -> tuple | None:
+        rows = [row0]
+        for st in self.side_states:
+            side_row = st.get(key)
+            if side_row is None:
+                return None  # wait until all aligned inputs have the key
+            rows.append(side_row)
+        return self.fn(key, *rows)  # column fns are individually guarded
+
+    def finish_time(self, time: int) -> None:
+        main = self.take_input(0)
+        side_batches = [self.take_input(i) for i in range(1, len(self.inputs))]
+        if not main and not any(side_batches):
+            return
+        main_state: KeyedState = self._main_state()
+        affected: dict[Key, None] = {}
+        for key, _row, _diff in main:
+            affected[key] = None
+        for i, batch in enumerate(side_batches):
+            self.side_states[i].update(batch)
+            for key, _row, _diff in batch:
+                affected[key] = None
+        main_state.update(main)
+        out: list[Entry] = []
+        for key in affected:
+            old = self.emitted.get(key)
+            row0 = main_state.get(key)
+            new = self._compute(key, row0) if row0 is not None else None
+            if old is not None and (new is None or freeze_row(old) != freeze_row(new)):
+                out.append((key, old, -1))
+                del self.emitted[key]
+            if new is not None and (old is None or freeze_row(old) != freeze_row(new)):
+                out.append((key, new, 1))
+                self.emitted[key] = new
+        self.emit(time, out)
+
+    def _main_state(self) -> KeyedState:
+        if not hasattr(self, "_main_state_"):
+            self._main_state_ = KeyedState()
+        return self._main_state_
+
+
+class FilterNode(Node):
+    def __init__(self, graph: Graph, inp: Node, predicate: Callable[[Key, tuple], Any]):
+        super().__init__(graph, [inp])
+        self.predicate = predicate
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        if not entries:
+            return
+        out = []
+        for key, row, diff in entries:
+            try:
+                keep = self.predicate(key, row)
+            except Exception as e:  # noqa: BLE001
+                self.graph.log_error(f"filter: {type(e).__name__}: {e}")
+                keep = False
+            if isinstance(keep, ErrorValue):
+                self.graph.log_error("filter: Error value in condition")
+                keep = False
+            if keep:
+                out.append((key, row, diff))
+        self.emit(time, out)
+
+
+class ReindexNode(Node):
+    """Assign new keys via fn(key, row) -> new_key (reindex / with_id_from)."""
+
+    def __init__(self, graph: Graph, inp: Node, key_fn: Callable[[Key, tuple], Key]):
+        super().__init__(graph, [inp])
+        self.key_fn = key_fn
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        if not entries:
+            return
+        out: list[Entry] = []
+        for key, row, diff in entries:
+            try:
+                nk = self.key_fn(key, row)
+            except Exception as e:  # noqa: BLE001
+                self.graph.log_error(f"reindex: {type(e).__name__}: {e}")
+                continue
+            out.append((nk, row, diff))
+        self.emit(time, consolidate(out))
+
+
+class ConcatNode(Node):
+    def __init__(self, graph: Graph, inputs: Sequence[Node]):
+        super().__init__(graph, inputs)
+
+    def finish_time(self, time: int) -> None:
+        out: list[Entry] = []
+        for i in range(len(self.inputs)):
+            out.extend(self.take_input(i))
+        if out:
+            self.emit(time, consolidate(out))
+
+
+class FlattenNode(Node):
+    def __init__(self, graph: Graph, inp: Node, flatten_idx: int):
+        super().__init__(graph, [inp])
+        self.flatten_idx = flatten_idx
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        if not entries:
+            return
+        out: list[Entry] = []
+        for key, row, diff in entries:
+            seq = row[self.flatten_idx]
+            if seq is None:
+                continue
+            if isinstance(seq, (str, bytes)):
+                items: Iterable[Any] = seq if isinstance(seq, str) else [
+                    seq[i : i + 1] for i in range(len(seq))
+                ]
+            elif isinstance(seq, np.ndarray):
+                items = list(seq)
+            elif isinstance(seq, (tuple, list)):
+                items = seq
+            else:
+                self.graph.log_error(f"flatten: cannot flatten {type(seq).__name__}")
+                continue
+            for i, item in enumerate(items):
+                new_row = row[: self.flatten_idx] + (item,) + row[self.flatten_idx + 1 :]
+                nk = Key(hash_values(key, i))
+                out.append((nk, new_row, diff))
+        self.emit(time, consolidate(out))
+
+
+class SetOpNode(Node):
+    """intersect / difference / restrict on key sets.
+
+    Output rows come from input 0; inputs 1..n contribute key presence.
+    mode: 'intersect' | 'difference' | 'restrict'
+    """
+
+    def __init__(self, graph: Graph, inputs: Sequence[Node], mode: str):
+        super().__init__(graph, inputs)
+        self.mode = mode
+        self.main = KeyedState()
+        self.others: list[dict[Key, int]] = [defaultdict(int) for _ in range(len(inputs) - 1)]
+        self.emitted: dict[Key, tuple] = {}
+
+    def _present(self, key: Key) -> bool:
+        if self.mode == "intersect" or self.mode == "restrict":
+            return all(o.get(key, 0) > 0 for o in self.others)
+        if self.mode == "difference":
+            return self.others[0].get(key, 0) <= 0
+        raise AssertionError(self.mode)
+
+    def finish_time(self, time: int) -> None:
+        main_batch = self.take_input(0)
+        affected: dict[Key, None] = {k: None for k, _, _ in main_batch}
+        for i in range(1, len(self.inputs)):
+            for key, _row, diff in self.take_input(i):
+                self.others[i - 1][key] += diff
+                affected[key] = None
+        self.main.update(main_batch)
+        out: list[Entry] = []
+        for key in affected:
+            row = self.main.get(key)
+            present = row is not None and self._present(key)
+            old = self.emitted.get(key)
+            new = row if present else None
+            if old is not None and (new is None or freeze_row(old) != freeze_row(new)):
+                out.append((key, old, -1))
+                del self.emitted[key]
+            if new is not None and (old is None or freeze_row(old) != freeze_row(new)):
+                out.append((key, new, 1))
+                self.emitted[key] = new
+        self.emit(time, out)
+
+
+class UpdateRowsNode(Node):
+    """union with right-priority (reference: update_rows dataflow.rs)."""
+
+    def __init__(self, graph: Graph, left: Node, right: Node):
+        super().__init__(graph, [left, right])
+        self.left = KeyedState()
+        self.right = KeyedState()
+        self.emitted: dict[Key, tuple] = {}
+
+    def finish_time(self, time: int) -> None:
+        lb = self.take_input(0)
+        rb = self.take_input(1)
+        if not lb and not rb:
+            return
+        affected = {k: None for k, _, _ in lb}
+        affected.update({k: None for k, _, _ in rb})
+        self.left.update(lb)
+        self.right.update(rb)
+        out: list[Entry] = []
+        for key in affected:
+            new = self.right.get(key)
+            if new is None:
+                new = self.left.get(key)
+            old = self.emitted.get(key)
+            if old is not None and (new is None or freeze_row(old) != freeze_row(new)):
+                out.append((key, old, -1))
+                del self.emitted[key]
+            if new is not None and (old is None or freeze_row(old) != freeze_row(new)):
+                out.append((key, new, 1))
+                self.emitted[key] = new
+        self.emit(time, out)
+
+
+class UpdateCellsNode(Node):
+    """Override selected columns where the right table has the key."""
+
+    def __init__(self, graph: Graph, left: Node, right: Node, col_map: list[int | None]):
+        # col_map[i] = index into right row overriding left col i, or None
+        super().__init__(graph, [left, right])
+        self.col_map = col_map
+        self.left = KeyedState()
+        self.right = KeyedState()
+        self.emitted: dict[Key, tuple] = {}
+
+    def finish_time(self, time: int) -> None:
+        lb = self.take_input(0)
+        rb = self.take_input(1)
+        if not lb and not rb:
+            return
+        affected = {k: None for k, _, _ in lb}
+        affected.update({k: None for k, _, _ in rb})
+        self.left.update(lb)
+        self.right.update(rb)
+        out: list[Entry] = []
+        for key in affected:
+            lrow = self.left.get(key)
+            new = None
+            if lrow is not None:
+                rrow = self.right.get(key)
+                if rrow is None:
+                    new = lrow
+                else:
+                    new = tuple(
+                        rrow[m] if m is not None else lrow[i]
+                        for i, m in enumerate(self.col_map)
+                    )
+            old = self.emitted.get(key)
+            if old is not None and (new is None or freeze_row(old) != freeze_row(new)):
+                out.append((key, old, -1))
+                del self.emitted[key]
+            if new is not None and (old is None or freeze_row(old) != freeze_row(new)):
+                out.append((key, new, 1))
+                self.emitted[key] = new
+        self.emit(time, out)
+
+
+class JoinNode(Node):
+    """Incremental equi-join with inner/left/right/outer modes.
+
+    Reference: join_tables (dataflow.rs:2270). State: both sides arranged by
+    join key. Delta rule: d(L ⋈ R) = dL ⋈ R_old + L_new ⋈ dR.
+    Output key assignment: 'hash' (new key from (lkey, rkey)), 'left', 'right'.
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        left: Node,
+        right: Node,
+        left_jk: Callable[[Key, tuple], Any],
+        right_jk: Callable[[Key, tuple], Any],
+        mode: str = "inner",
+        id_mode: str = "hash",
+        left_width: int = 0,
+        right_width: int = 0,
+        exact_match: bool = False,
+        asof_now: bool = False,
+    ):
+        super().__init__(graph, [left, right])
+        self.left_jk = left_jk
+        self.right_jk = right_jk
+        self.mode = mode
+        self.id_mode = id_mode
+        self.left_width = left_width
+        self.right_width = right_width
+        self.left_state = MultisetState()
+        self.right_state = MultisetState()
+        # asof_now: left deltas join the right side's state as of their
+        # arrival; right-side changes never retro-update results
+        # (reference: asof_now joins / use_external_index_as_of_now)
+        self.asof_now = asof_now
+
+    def _jk_of(self, side: int, key: Key, row: tuple) -> Any:
+        fn = self.left_jk if side == 0 else self.right_jk
+        try:
+            jk = fn(key, row)
+        except Exception as e:  # noqa: BLE001
+            self.graph.log_error(f"join key: {type(e).__name__}: {e}")
+            return None
+        if isinstance(jk, ErrorValue) or (isinstance(jk, tuple) and any(isinstance(x, ErrorValue) for x in jk)):
+            return None
+        return freeze_value(jk)
+
+    def _out_entry(self, lkey, lrow, rkey, rrow, diff) -> Entry:
+        if lrow is None:
+            lrow = (None,) * self.left_width
+        if rrow is None:
+            rrow = (None,) * self.right_width
+        if self.id_mode == "left" and lkey is not None:
+            key = lkey
+        elif self.id_mode == "right" and rkey is not None:
+            key = rkey
+        else:
+            key = Key(hash_values(lkey, rkey))
+        # output rows carry both side keys so pw.left.id / pw.right.id resolve
+        return (key, (lkey, rkey) + tuple(lrow) + tuple(rrow), diff)
+
+    def finish_time(self, time: int) -> None:
+        lb = self.take_input(0)
+        rb = self.take_input(1)
+        if not lb and not rb:
+            return
+        ldelta: dict[Any, list[tuple[tuple[Key, tuple], int]]] = defaultdict(list)
+        rdelta: dict[Any, list[tuple[tuple[Key, tuple], int]]] = defaultdict(list)
+        for key, row, diff in lb:
+            jk = self._jk_of(0, key, row)
+            if jk is not None:
+                ldelta[jk].append(((key, row), diff))
+        for key, row, diff in rb:
+            jk = self._jk_of(1, key, row)
+            if jk is not None:
+                rdelta[jk].append(((key, row), diff))
+
+        out: list[Entry] = []
+        outer = self.mode in ("left", "outer", "full")
+        router = self.mode in ("right", "outer", "full") and not self.asof_now
+
+        # For outer modes, snapshot match counts before applying deltas.
+        def rcount(jk: Any) -> int:
+            return sum(c for _, c in self.right_state.get(jk))
+
+        def lcount(jk: Any) -> int:
+            return sum(c for _, c in self.left_state.get(jk))
+
+        pre_r = {jk: rcount(jk) for jk in set(ldelta) | set(rdelta)} if outer else {}
+        pre_l = {jk: lcount(jk) for jk in set(ldelta) | set(rdelta)} if router else {}
+
+        # asof_now: right delta applies BEFORE left delta joins, and right
+        # changes never join existing left state
+        if self.asof_now:
+            for jk, drs in rdelta.items():
+                for payload, dc in drs:
+                    self.right_state.update_one(jk, payload, dc)
+            for jk, dls in ldelta.items():
+                rmatches = self.right_state.get(jk)
+                for (lkey, lrow), dc in dls:
+                    for (rkey, rrow), rc in rmatches:
+                        out.append(self._out_entry(lkey, lrow, rkey, rrow, dc * rc))
+                    if not rmatches and self.mode in ("left", "outer", "full"):
+                        out.append(self._out_entry(lkey, lrow, None, None, dc))
+            self.emit(time, consolidate(out))
+            return
+        # dL ⋈ R_old
+        for jk, dls in ldelta.items():
+            rmatches = self.right_state.get(jk)
+            for (lkey, lrow), dc in dls:
+                for (rkey, rrow), rc in rmatches:
+                    out.append(self._out_entry(lkey, lrow, rkey, rrow, dc * rc))
+        # apply left delta
+        for jk, dls in ldelta.items():
+            for payload, dc in dls:
+                self.left_state.update_one(jk, payload, dc)
+        # L_new ⋈ dR
+        for jk, drs in rdelta.items():
+            lmatches = self.left_state.get(jk)
+            for (rkey, rrow), dc in drs:
+                for (lkey, lrow), lc in lmatches:
+                    out.append(self._out_entry(lkey, lrow, rkey, rrow, lc * dc))
+        for jk, drs in rdelta.items():
+            for payload, dc in drs:
+                self.right_state.update_one(jk, payload, dc)
+
+        # Outer padding via antijoin transitions.
+        if outer:
+            for jk in set(ldelta) | set(rdelta):
+                before, after = pre_r.get(jk, 0), rcount(jk)
+                # left rows present before/after this wave
+                if before == 0 or after == 0:
+                    lrows_now = self.left_state.get(jk)
+                    lrows_before = _rollback(lrows_now, ldelta.get(jk, []))
+                    if before == 0:
+                        for (lkey, lrow), c in lrows_before:
+                            out.append(self._out_entry(lkey, lrow, None, None, -c))
+                    if after == 0:
+                        for (lkey, lrow), c in lrows_now:
+                            out.append(self._out_entry(lkey, lrow, None, None, c))
+                else:
+                    # matched throughout; pad only the delta if no matches at all
+                    pass
+        if router:
+            for jk in set(ldelta) | set(rdelta):
+                before, after = pre_l.get(jk, 0), lcount(jk)
+                if before == 0 or after == 0:
+                    rrows_now = self.right_state.get(jk)
+                    rrows_before = _rollback(rrows_now, rdelta.get(jk, []))
+                    if before == 0:
+                        for (rkey, rrow), c in rrows_before:
+                            out.append(self._out_entry(None, None, rkey, rrow, -c))
+                    if after == 0:
+                        for (rkey, rrow), c in rrows_now:
+                            out.append(self._out_entry(None, None, rkey, rrow, c))
+        self.emit(time, consolidate(out))
+
+
+def _rollback(
+    now: list[tuple[Any, int]], delta: list[tuple[Any, int]]
+) -> list[tuple[Any, int]]:
+    """Reconstruct a multiset state before a delta was applied."""
+    acc: dict[Any, tuple[Any, int]] = {}
+    for payload, c in now:
+        acc[freeze_value(payload)] = (payload, c)
+    for payload, dc in delta:
+        token = freeze_value(payload)
+        cur = acc.get(token)
+        nc = (cur[1] if cur else 0) - dc
+        if nc == 0:
+            acc.pop(token, None)
+        else:
+            acc[token] = (payload, nc)
+    return list(acc.values())
+
+
+class GroupByNode(Node):
+    """Incremental groupby + reduce (reference: group_by_table dataflow.rs:2991).
+
+    gk_fn(key, row) -> (group_values_tuple, group_key:Key)
+    arg_fns: per reducer, fn(key, row, time) -> args tuple
+    Output row = group_values_tuple + (reduced values...).
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        gk_fn: Callable,
+        reducers: list[Any],
+        arg_fns: list[Callable],
+        set_id: bool = False,
+    ):
+        super().__init__(graph, [inp])
+        self.gk_fn = gk_fn
+        self.reducers = reducers
+        self.arg_fns = arg_fns
+        self.state = MultisetState()  # gkey -> {token: ((gvals, args...), count)}
+        self.gkeys: dict[Any, tuple[Key, tuple]] = {}  # frozen gval -> (Key, gvals)
+        self.emitted: dict[Key, tuple] = {}
+        self.stateful_state: dict[Any, list[Any]] = {}
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        if not entries:
+            return
+        affected: dict[Any, None] = {}
+        batch_per_group: dict[Any, list[tuple[tuple, int]]] = defaultdict(list)
+        for key, row, diff in entries:
+            try:
+                gvals = self.gk_fn(key, row)
+            except Exception as e:  # noqa: BLE001
+                self.graph.log_error(f"groupby key: {type(e).__name__}: {e}")
+                continue
+            args = []
+            for fn in self.arg_fns:
+                try:
+                    args.append(fn(key, row, time))
+                except Exception as e:  # noqa: BLE001
+                    self.graph.log_error(f"reducer arg: {type(e).__name__}: {e}")
+                    args.append(ERROR)
+            token_g = freeze_value(gvals)
+            if token_g not in self.gkeys:
+                self.gkeys[token_g] = (key_for_values(*gvals), gvals)
+            self.state.update_one(token_g, tuple(args), diff)
+            batch_per_group[token_g].append((tuple(args), diff))
+            affected[token_g] = None
+
+        out: list[Entry] = []
+        for token_g in affected:
+            gkey, gvals = self.gkeys[token_g]
+            entries_now = self.state.get(token_g)
+            from pathway_tpu.internals.reducers import StatefulReducer
+
+            old = self.emitted.get(gkey)
+            if not entries_now and not any(
+                isinstance(r, StatefulReducer) for r in self.reducers
+            ):
+                new = None
+            else:
+                vals = []
+                for ri, reducer in enumerate(self.reducers):
+                    if isinstance(reducer, StatefulReducer):
+                        st_key = (token_g, ri)
+                        state = self.stateful_state.get(st_key)
+                        rows = [
+                            (list(args[ri]), cnt)
+                            for args, cnt in batch_per_group.get(token_g, [])
+                        ]
+                        state = reducer.combine_fn(state, rows)
+                        self.stateful_state[st_key] = state
+                        vals.append(state)
+                    else:
+                        per_reducer = [(args[ri], cnt) for args, cnt in entries_now]
+                        vals.append(reducer.from_multiset(per_reducer))
+                new = tuple(gvals) + tuple(vals)
+                if not entries_now:
+                    new = None
+            if old is not None and (new is None or freeze_row(old) != freeze_row(new)):
+                out.append((gkey, old, -1))
+                del self.emitted[gkey]
+            if new is not None and (old is None or freeze_row(old) != freeze_row(new)):
+                out.append((gkey, new, 1))
+                self.emitted[gkey] = new
+        self.emit(time, out)
+
+
+class DeduplicateNode(Node):
+    """Keep one accepted row per instance via acceptor(new, old) -> bool
+    (reference: deduplicate dataflow.rs:3101)."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        instance_fn: Callable[[Key, tuple], Any],
+        value_fn: Callable[[Key, tuple], Any],
+        acceptor: Callable[[Any, Any], bool],
+        keep_key: bool = False,
+    ):
+        super().__init__(graph, [inp])
+        self.instance_fn = instance_fn
+        self.value_fn = value_fn
+        self.acceptor = acceptor
+        self.accepted: dict[Any, tuple[Key, tuple]] = {}
+        self.ikeys: dict[Any, Key] = {}
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        if not entries:
+            return
+        out: list[Entry] = []
+        for key, row, diff in entries:
+            if diff <= 0:
+                continue  # dedup state machine consumes insertions only
+            try:
+                inst = freeze_value(self.instance_fn(key, row))
+            except Exception as e:  # noqa: BLE001
+                self.graph.log_error(f"deduplicate instance: {e}")
+                continue
+            prev = self.accepted.get(inst)
+            try:
+                ok = (
+                    self.acceptor(self.value_fn(key, row), self.value_fn(*prev))
+                    if prev is not None
+                    else True
+                )
+            except Exception as e:  # noqa: BLE001
+                self.graph.log_error(f"deduplicate acceptor: {e}")
+                ok = False
+            if ok:
+                if inst not in self.ikeys:
+                    self.ikeys[inst] = key_for_values(*(inst if isinstance(inst, tuple) else (inst,)))
+                ikey = self.ikeys[inst]
+                if prev is not None:
+                    out.append((ikey, prev[1], -1))
+                out.append((ikey, row, 1))
+                self.accepted[inst] = (key, row)
+        self.emit(time, consolidate(out))
+
+
+class IxNode(Node):
+    """Pointer lookup: for each source row, fetch the target row at
+    pointer_fn(key, row) (reference: ix_table dataflow.rs:2133)."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        source: Node,
+        target: Node,
+        pointer_fn: Callable[[Key, tuple], Any],
+        optional: bool = False,
+        strict: bool = True,
+        target_width: int = 0,
+    ):
+        super().__init__(graph, [source, target])
+        self.pointer_fn = pointer_fn
+        self.optional = optional
+        self.strict = strict
+        self.target_width = target_width
+        self.source_by_ptr = MultisetState()  # ptr -> {(skey, srow)}
+        self.target_state = KeyedState()
+        self.emitted: dict[Key, tuple] = {}
+
+    def finish_time(self, time: int) -> None:
+        sb = self.take_input(0)
+        tb = self.take_input(1)
+        if not sb and not tb:
+            return
+        affected_ptrs: dict[Any, None] = {}
+        for key, row, diff in sb:
+            try:
+                ptr = self.pointer_fn(key, row)
+            except Exception as e:  # noqa: BLE001
+                self.graph.log_error(f"ix pointer: {e}")
+                continue
+            self.source_by_ptr.update_one(
+                ptr.value if isinstance(ptr, Key) else freeze_value(ptr), (key, row, ptr), diff
+            )
+            affected_ptrs[ptr.value if isinstance(ptr, Key) else freeze_value(ptr)] = None
+        for key, _row, _diff in tb:
+            affected_ptrs[key.value] = None
+        self.target_state.update(tb)
+
+        out: list[Entry] = []
+        for ptr_tok in affected_ptrs:
+            for (skey, srow, ptr), c in self.source_by_ptr.get(ptr_tok):
+                trow = (
+                    self.target_state.get(ptr) if isinstance(ptr, Key) else None
+                )
+                if ptr is None and self.optional:
+                    new = (None,) * self.target_width
+                elif trow is None:
+                    if self.optional:
+                        new = (None,) * self.target_width
+                    else:
+                        new = None
+                else:
+                    new = trow
+                old = self.emitted.get(skey)
+                if old is not None and (new is None or freeze_row(old) != freeze_row(new)):
+                    out.append((skey, old, -1))
+                    del self.emitted[skey]
+                if new is not None and c > 0 and (old is None or freeze_row(old) != freeze_row(new)):
+                    out.append((skey, new, 1))
+                    self.emitted[skey] = new
+                if c <= 0 and old is not None:
+                    out.append((skey, old, -1))
+                    del self.emitted[skey]
+        self.emit(time, out)
+
+
+class SortNode(Node):
+    """Maintain prev/next pointers over sorted instances
+    (reference: operators/prev_next.rs via sort_table)."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        sort_key_fn: Callable[[Key, tuple], Any],
+        instance_fn: Callable[[Key, tuple], Any],
+    ):
+        super().__init__(graph, [inp])
+        self.sort_key_fn = sort_key_fn
+        self.instance_fn = instance_fn
+        self.instances: dict[Any, dict[Key, Any]] = defaultdict(dict)  # inst -> {key: sortval}
+        self.emitted: dict[Key, tuple] = {}
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        if not entries:
+            return
+        touched: set[Any] = set()
+        for key, row, diff in entries:
+            inst = freeze_value(self.instance_fn(key, row))
+            touched.add(inst)
+            if diff > 0:
+                self.instances[inst][key] = self.sort_key_fn(key, row)
+            else:
+                self.instances[inst].pop(key, None)
+        out: list[Entry] = []
+        for inst in touched:
+            group = self.instances[inst]
+            ordered = sorted(group.items(), key=lambda kv: (kv[1], kv[0].value))
+            for i, (key, _sv) in enumerate(ordered):
+                prev = ordered[i - 1][0] if i > 0 else None
+                nxt = ordered[i + 1][0] if i + 1 < len(ordered) else None
+                new = (prev, nxt)
+                old = self.emitted.get(key)
+                if old is not None and freeze_row(old) != freeze_row(new):
+                    out.append((key, old, -1))
+                if old is None or freeze_row(old) != freeze_row(new):
+                    out.append((key, new, 1))
+                    self.emitted[key] = new
+            # retractions for keys that left the group
+            gone = [k for k in list(self.emitted) if k not in group and k in [e[0] for e in entries if e[2] < 0]]
+            for k in gone:
+                out.append((k, self.emitted.pop(k), -1))
+        self.emit(time, consolidate(out))
+
+
+class CaptureNode(Node):
+    """Accumulates the full update stream and final state (debug/capture)."""
+
+    def __init__(self, graph: Graph, inp: Node):
+        super().__init__(graph, [inp])
+        self.stream: list[tuple[int, Key, tuple, int]] = []
+        self.state = KeyedState()
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        if not entries:
+            return
+        for key, row, diff in entries:
+            self.stream.append((time, key, row, diff))
+        self.state.update(entries)
+
+
+class SubscribeNode(Node):
+    """pw.io.subscribe: per-row callbacks + time-end + end callbacks
+    (reference: subscribe_table dataflow.rs:3645)."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        on_change: Callable | None = None,
+        on_time_end: Callable | None = None,
+        on_end: Callable | None = None,
+        sort_by_time: bool = True,
+    ):
+        super().__init__(graph, [inp])
+        self.on_change = on_change
+        self.on_time_end_cb = on_time_end
+        self.on_end_cb = on_end
+        self._ended = False
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        if entries and self.on_change is not None:
+            for key, row, diff in consolidate(entries):
+                reps = abs(diff)
+                for _ in range(reps):
+                    self.on_change(key, row, time, diff > 0)
+        if entries and self.on_time_end_cb is not None:
+            self.on_time_end_cb(time)
+
+    def on_end(self, time: int) -> None:
+        if not self._ended and self.on_end_cb is not None:
+            self._ended = True
+            self.on_end_cb()
+
+
+class BufferNode(Node):
+    """Postpone rows until the stream's max threshold passes their release
+    time (reference: operators/time_column.rs postpone_core:380)."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        threshold_fn: Callable[[Key, tuple], Any],
+        current_fn: Callable[[Key, tuple], Any],
+        flush_on_end: bool = True,
+    ):
+        super().__init__(graph, [inp])
+        self.threshold_fn = threshold_fn  # row's release threshold
+        self.current_fn = current_fn  # row's event-time contribution to "now"
+        self.now: Any = None
+        self.pending: dict[Key, tuple[tuple, int, Any]] = {}
+        self.released: set[int] = set()
+        self.flush_on_end = flush_on_end
+        self._virtual_end = False
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        out: list[Entry] = []
+        for key, row, diff in entries:
+            cur = self.current_fn(key, row)
+            if self.now is None or cur > self.now:
+                self.now = cur
+            thr = self.threshold_fn(key, row)
+            if key.value in self.released or (self.now is not None and thr <= self.now):
+                self.released.add(key.value)
+                out.append((key, row, diff))
+                self.pending.pop(key, None)
+            else:
+                if diff > 0:
+                    self.pending[key] = (row, diff, thr)
+                else:
+                    self.pending.pop(key, None)
+        # release pending rows whose threshold has passed
+        if self.now is not None:
+            ready = [k for k, (_r, _d, thr) in self.pending.items() if thr <= self.now]
+            for k in ready:
+                row, diff, _ = self.pending.pop(k)
+                self.released.add(k.value)
+                out.append((k, row, diff))
+        self.emit(time, consolidate(out))
+
+    def on_end(self, time: int) -> None:
+        if self.flush_on_end and self.pending:
+            out = [(k, row, diff) for k, (row, diff, _t) in self.pending.items()]
+            self.pending.clear()
+            for k, _r, _d in out:
+                self.released.add(k.value)
+            self.emit(time, consolidate(out))
+
+
+class ForgetNode(Node):
+    """Retract rows older than the moving threshold; drop late arrivals
+    (reference: time_column.rs forget:566 + ignore_late:677)."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        threshold_fn: Callable[[Key, tuple], Any],
+        current_fn: Callable[[Key, tuple], Any],
+        mark_forgetting_records: bool = False,
+    ):
+        super().__init__(graph, [inp])
+        self.threshold_fn = threshold_fn
+        self.current_fn = current_fn
+        self.now: Any = None
+        self.live: dict[Key, tuple[tuple, Any]] = {}
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        out: list[Entry] = []
+        for key, row, diff in entries:
+            cur = self.current_fn(key, row)
+            if self.now is None or cur > self.now:
+                self.now = cur
+            thr = self.threshold_fn(key, row)
+            if self.now is not None and thr <= self.now and diff > 0:
+                # late row: ignore
+                continue
+            out.append((key, row, diff))
+            if diff > 0:
+                self.live[key] = (row, thr)
+            else:
+                self.live.pop(key, None)
+        # retract rows that have fallen behind the threshold
+        if self.now is not None:
+            expired = [k for k, (_r, thr) in self.live.items() if thr <= self.now]
+            for k in expired:
+                row, _ = self.live.pop(k)
+                out.append((k, row, -1))
+        self.emit(time, consolidate(out))
+
+
+class FreezeNode(Node):
+    """Ignore updates/retractions to rows past the freeze threshold
+    (reference: time_column.rs freeze via dataflow.rs:1555)."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        threshold_fn: Callable[[Key, tuple], Any],
+        current_fn: Callable[[Key, tuple], Any],
+    ):
+        super().__init__(graph, [inp])
+        self.threshold_fn = threshold_fn
+        self.current_fn = current_fn
+        self.now: Any = None
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        out: list[Entry] = []
+        for key, row, diff in entries:
+            cur = self.current_fn(key, row)
+            thr = self.threshold_fn(key, row)
+            if self.now is not None and thr <= self.now:
+                continue  # frozen region: drop the change
+            if self.now is None or cur > self.now:
+                self.now = cur
+            out.append((key, row, diff))
+        self.emit(time, consolidate(out))
+
+
+class GradualBroadcastNode(Node):
+    """Broadcast (lower, value, upper) from a small table onto every row of a
+    big table with hysteresis (reference: operators/gradual_broadcast.rs:65)."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        big: Node,
+        small: Node,
+        lvu_fn: Callable[[Key, tuple], tuple],
+    ):
+        super().__init__(graph, [big, small])
+        self.lvu_fn = lvu_fn
+        self.current: Any = None  # (lower, value, upper)
+        self.big_state = KeyedState()
+        self.emitted: dict[Key, Any] = {}
+
+    def finish_time(self, time: int) -> None:
+        bb = self.take_input(0)
+        sb = self.take_input(1)
+        if not bb and not sb:
+            return
+        new_value = self.current[1] if self.current else None
+        for key, row, diff in sb:
+            if diff > 0:
+                lower, value, upper = self.lvu_fn(key, row)
+                if (
+                    self.current is None
+                    or value < self.current[0]
+                    or value > self.current[2]
+                ):
+                    self.current = (lower, value, upper)
+                    new_value = value
+        self.big_state.update(bb)
+        out: list[Entry] = []
+        changed_all = new_value is not None and (
+            not self.emitted or any(v != new_value for v in self.emitted.values())
+        )
+        targets = self.big_state.items() if changed_all else [
+            (k, self.big_state.get(k)) for k, _r, d in bb if d > 0 and self.big_state.get(k) is not None
+        ]
+        for key, _row in list(targets):
+            old = self.emitted.get(key)
+            if old is not None and old != new_value:
+                out.append((key, (old,), -1))
+            if new_value is not None and old != new_value:
+                out.append((key, (new_value,), 1))
+                self.emitted[key] = new_value
+        # retractions of removed big rows
+        for key, _row, diff in bb:
+            if diff < 0 and key in self.emitted and self.big_state.get(key) is None:
+                out.append((key, (self.emitted.pop(key),), -1))
+        self.emit(time, consolidate(out))

@@ -1,0 +1,424 @@
+"""Engine runtime: the per-worker pump loop.
+
+Reference parity: run_with_new_dataflow_graph (src/engine/dataflow.rs:5506)
+— connector pollers feeding input sessions, commit timestamps on an
+even-millisecond total order (src/engine/timestamp.rs:20-27), a pump that
+finalizes one timestamp per wave, and end-of-stream flush.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+import time as _time
+from typing import Any, Callable, Iterable, Sequence
+
+from pathway_tpu.engine.core import (
+    CaptureNode,
+    Entry,
+    Graph,
+    InputNode,
+    KeyedState,
+    Node,
+    consolidate,
+    freeze_row,
+)
+from pathway_tpu.internals.errors import ERROR
+from pathway_tpu.internals.keys import Key, key_for_values, sequential_key
+
+
+class InputSession:
+    """Thread-safe staging buffer feeding an InputNode.
+
+    Mirrors the reference's input session + upsert session
+    (src/connectors/adaptors.rs:23): `upsert` overwrites by key, `insert`/
+    `remove` are plain z-set deltas.
+    """
+
+    def __init__(self, node: InputNode, upsert: bool = False):
+        self.node = node
+        self.upsert_mode = upsert
+        self._lock = threading.Lock()
+        self._staged: list[Entry] = []
+        self._current: dict[Key, tuple] = {}  # for upsert sessions
+        self.closed = False
+
+    def insert(self, key: Key, row: tuple) -> None:
+        with self._lock:
+            if self.upsert_mode:
+                old = self._current.get(key)
+                if old is not None:
+                    self._staged.append((key, old, -1))
+                self._current[key] = row
+            self._staged.append((key, row, 1))
+
+    def remove(self, key: Key, row: tuple | None = None) -> None:
+        with self._lock:
+            if self.upsert_mode:
+                old = self._current.pop(key, None)
+                if old is not None:
+                    self._staged.append((key, old, -1))
+            elif row is not None:
+                self._staged.append((key, row, -1))
+
+    def drain(self) -> list[Entry]:
+        with self._lock:
+            staged, self._staged = self._staged, []
+        return staged
+
+    def close(self) -> None:
+        self.closed = True
+
+
+class Connector:
+    """A data source with its own reader thread (reference:
+    src/connectors/mod.rs:427 Connector::run — one thread per input
+    connector, poller drained by the main pump)."""
+
+    def __init__(self, name: str, session: InputSession):
+        self.name = name
+        self.session = session
+        self.thread: threading.Thread | None = None
+        self.finished = threading.Event()
+
+    def start(self) -> None:
+        pass
+
+    def poll(self) -> list[Entry]:
+        return self.session.drain()
+
+    @property
+    def done(self) -> bool:
+        return self.finished.is_set() and not self.session._staged
+
+
+class ThreadConnector(Connector):
+    """Runs a read function on a dedicated thread."""
+
+    def __init__(self, name: str, session: InputSession, read_fn: Callable[[InputSession], None]):
+        super().__init__(name, session)
+        self.read_fn = read_fn
+
+    def start(self) -> None:
+        def run() -> None:
+            try:
+                self.read_fn(self.session)
+            finally:
+                self.finished.set()
+
+        self.thread = threading.Thread(target=run, daemon=True, name=f"pw-connector-{self.name}")
+        self.thread.start()
+
+
+class Runtime:
+    """Single-worker pump. Timestamps are even milliseconds from run start."""
+
+    def __init__(self, graph: Graph, autocommit_ms: int = 2):
+        self.graph = graph
+        self.autocommit_ms = max(2, autocommit_ms - autocommit_ms % 2)
+        self.time = 0
+        self.connectors: list[Connector] = []
+        self.monitors: list[Callable[[int], None]] = []
+
+    def next_time(self) -> int:
+        self.time += 2  # even-ms granule, reference timestamp.rs:20-27
+        return self.time
+
+    def add_connector(self, connector: Connector) -> None:
+        self.connectors.append(connector)
+
+    def run(self) -> None:
+        """Pump until all connectors are done; then flush + end."""
+        for c in self.connectors:
+            c.start()
+        if not self.connectors:
+            t = self.next_time()
+            self.graph.step(t)
+            self.graph.end(t)
+            return
+        while True:
+            _time.sleep(self.autocommit_ms / 1000.0)
+            any_data = False
+            for c in self.connectors:
+                entries = c.poll()
+                if entries:
+                    any_data = True
+                    c.session.node.push(entries)
+            if any_data:
+                t = self.next_time()
+                self.graph.step(t)
+                for m in self.monitors:
+                    m(t)
+            if all(c.done for c in self.connectors):
+                # final drain
+                final: bool = False
+                for c in self.connectors:
+                    entries = c.poll()
+                    if entries:
+                        c.session.node.push(entries)
+                        final = True
+                t = self.next_time()
+                if final:
+                    self.graph.step(t)
+                self.graph.end(t)
+                break
+
+    def run_static(self, batches: list[tuple[int, InputNode, list[Entry]]]) -> None:
+        """Batch mode: feed pre-timed batches, run each wave, then end.
+
+        `batches` are (time, node, entries); times must use the even-ms
+        domain. All nodes step at every distinct time in order.
+        """
+        by_time: dict[int, list[tuple[InputNode, list[Entry]]]] = {}
+        for t, node, entries in batches:
+            by_time.setdefault(t, []).append((node, entries))
+        last_t = 0
+        for t in sorted(by_time):
+            for node, entries in by_time[t]:
+                node.push(entries)
+            self.graph.step(t)
+            last_t = t
+        self.graph.end(last_t + 2)
+
+
+class IterateNode(Node):
+    """Fixpoint iteration (reference: iterate dataflow.rs:3737).
+
+    v0 strategy: per outer timestamp, re-run the loop body over the full
+    accumulated input collections until the iterated collections stop
+    changing, then emit the diff of the outputs versus what was previously
+    emitted. Incremental-within-loop is a later optimization; the semantics
+    (per-time fixpoint, diff-based output) match.
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        inputs: Sequence[Node],
+        input_names: list[str],
+        iterated_names: list[str],
+        output_names: list[str],
+        step_fn: Callable[[dict[str, list[Entry]]], dict[str, list[Entry]]],
+        iteration_limit: int | None = None,
+    ):
+        super().__init__(graph, inputs)
+        self.input_names = input_names
+        self.iterated_names = iterated_names
+        self.output_names = output_names
+        self.step_fn = step_fn
+        self.iteration_limit = iteration_limit
+        self.states = {name: KeyedState() for name in input_names}
+        self.emitted: dict[str, dict[Key, tuple]] = {name: {} for name in output_names}
+        self.out_nodes: dict[str, InputNode] = {}
+
+    def set_output_node(self, name: str, node: InputNode) -> None:
+        self.out_nodes[name] = node
+
+    def finish_time(self, time: int) -> None:
+        any_change = False
+        for i, name in enumerate(self.input_names):
+            batch = self.take_input(i)
+            if batch:
+                any_change = True
+                self.states[name].update(batch)
+        if not any_change:
+            return
+        cur = {name: self.states[name].as_entries() for name in self.input_names}
+        n = 0
+        while True:
+            outs = self.step_fn(cur)
+            n += 1
+            changed = False
+            for name in self.iterated_names:
+                if name in outs and _collections_differ(cur[name], outs[name]):
+                    changed = True
+                cur[name] = outs.get(name, cur[name])
+            if not changed:
+                break
+            if self.iteration_limit is not None and n >= self.iteration_limit:
+                break
+        for name in self.output_names:
+            result = outs.get(name, cur.get(name, []))
+            new_state: dict[Key, tuple] = {}
+            for key, row, diff in consolidate(result):
+                if diff > 0:
+                    new_state[key] = row
+            old_state = self.emitted[name]
+            delta: list[Entry] = []
+            for key, row in old_state.items():
+                nrow = new_state.get(key)
+                if nrow is None or freeze_row(nrow) != freeze_row(row):
+                    delta.append((key, row, -1))
+            for key, row in new_state.items():
+                orow = old_state.get(key)
+                if orow is None or freeze_row(orow) != freeze_row(row):
+                    delta.append((key, row, 1))
+            self.emitted[name] = new_state
+            out_node = self.out_nodes.get(name)
+            if out_node is not None and delta:
+                out_node.push(delta)
+                # downstream of out_node runs later in topo order within
+                # this same wave because out_node was created after self
+                out_node.finish_time(time)
+
+
+def _collections_differ(a: list[Entry], b: list[Entry]) -> bool:
+    def norm(entries: list[Entry]) -> set:
+        return {
+            (key.value, freeze_row(row), diff) for key, row, diff in consolidate(entries)
+        }
+
+    return norm(a) != norm(b)
+
+
+class AsyncApplyNode(Node):
+    """Async UDF application (reference: async_apply_table dataflow.rs:1442,
+    MapWithConsistentDeletions operators.rs:308).
+
+    Insertions run the (async) function — concurrently within a wave via an
+    event loop; results are memoized per key so retractions retract exactly
+    the value the insertion produced, even for non-deterministic functions.
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        fn: Callable[[Key, tuple], Any],
+        is_async: bool,
+        deterministic: bool = False,
+    ):
+        super().__init__(graph, [inp])
+        self.fn = fn
+        self.is_async = is_async
+        self.deterministic = deterministic
+        self.memo: dict[tuple, Any] = {}
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        if not entries:
+            return
+        insertions = [(k, r) for k, r, d in entries if d > 0]
+        results: dict[tuple, Any] = {}
+        if insertions:
+            if self.is_async:
+                results = _run_async_batch(self.fn, insertions, self.graph)
+            else:
+                for k, r in insertions:
+                    try:
+                        results[(k.value, freeze_row(r))] = self.fn(k, r)
+                    except Exception as e:  # noqa: BLE001
+                        self.graph.log_error(f"apply: {type(e).__name__}: {e}")
+                        results[(k.value, freeze_row(r))] = ERROR
+        out: list[Entry] = []
+        for key, row, diff in entries:
+            token = (key.value, freeze_row(row))
+            if diff > 0:
+                value = results.get(token, self.memo.get(token, ERROR))
+                if not self.deterministic:
+                    self.memo[token] = value
+            else:
+                if token in self.memo:
+                    value = self.memo.pop(token)
+                elif token in results:
+                    value = results[token]
+                elif self.deterministic:
+                    # recompute for retraction — allowed for deterministic fns
+                    try:
+                        value = self.fn(key, row)
+                    except Exception as e:  # noqa: BLE001
+                        self.graph.log_error(f"apply: {type(e).__name__}: {e}")
+                        value = ERROR
+                else:
+                    value = ERROR
+            out.append((key, row + (value,), diff))
+        self.emit(time, consolidate(out))
+
+
+_async_loop: asyncio.AbstractEventLoop | None = None
+_async_loop_lock = threading.Lock()
+
+
+def _get_async_loop() -> asyncio.AbstractEventLoop:
+    """Dedicated event-loop thread (reference: graph_runner/async_utils.py)."""
+    global _async_loop
+    with _async_loop_lock:
+        if _async_loop is None or _async_loop.is_closed():
+            loop = asyncio.new_event_loop()
+
+            def run() -> None:
+                asyncio.set_event_loop(loop)
+                loop.run_forever()
+
+            threading.Thread(target=run, daemon=True, name="pw-async-loop").start()
+            _async_loop = loop
+    return _async_loop
+
+
+def _run_async_batch(
+    fn: Callable, insertions: list[tuple[Key, tuple]], graph: Graph
+) -> dict[tuple, Any]:
+    loop = _get_async_loop()
+
+    async def one(k: Key, r: tuple) -> Any:
+        try:
+            res = fn(k, r)
+            if asyncio.iscoroutine(res):
+                res = await res
+            return res
+        except Exception as e:  # noqa: BLE001
+            graph.log_error(f"async apply: {type(e).__name__}: {e}")
+            return ERROR
+
+    async def batch() -> list[Any]:
+        return await asyncio.gather(*[one(k, r) for k, r in insertions])
+
+    fut = asyncio.run_coroutine_threadsafe(batch(), loop)
+    values = fut.result()
+    return {
+        (k.value, freeze_row(r)): v for (k, r), v in zip(insertions, values)
+    }
+
+
+class OutputNode(Node):
+    """Sink: formats consolidated batches and hands them to a writer callback
+    with retries (reference: output_table dataflow.rs:3542, OUTPUT_RETRIES=5)."""
+
+    RETRIES = 5
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        write_batch: Callable[[int, list[Entry]], None],
+        flush: Callable[[], None] | None = None,
+        close: Callable[[], None] | None = None,
+    ):
+        super().__init__(graph, [inp])
+        self.write_batch = write_batch
+        self.flush = flush
+        self.close = close
+        self._closed = False
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        if not entries:
+            return
+        batch = consolidate(entries)
+        last_err: Exception | None = None
+        for _attempt in range(self.RETRIES):
+            try:
+                self.write_batch(time, batch)
+                if self.flush is not None:
+                    self.flush()
+                return
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+                _time.sleep(0.01)
+        self.graph.log_error(f"output failed after {self.RETRIES} retries: {last_err}")
+
+    def on_end(self, time: int) -> None:
+        if not self._closed and self.close is not None:
+            self._closed = True
+            self.close()

@@ -1,0 +1,134 @@
+"""`.dt` expression namespace (reference: internals/expressions/date_time.py).
+
+Operates on DateTimeNaive/DateTimeUtc/Duration (ns-int backed), so most
+methods are integer math — the vectorized path maps them onto int64 device
+columns.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.datetime_types import (
+    DateTimeNaive,
+    DateTimeUtc,
+    Duration,
+    _to_duration,
+)
+from pathway_tpu.internals.expression import ColumnExpression, MethodCallExpression, wrap_arg
+
+
+def _m(name: str, expr: ColumnExpression, *args: Any, fn: Any, rt: Any):
+    return MethodCallExpression(f"dt.{name}", expr, *args, fn=fn, return_type=rt)
+
+
+class DateTimeNamespace:
+    def __init__(self, expr: ColumnExpression):
+        self._expr = expr
+
+    # field accessors
+    def nanosecond(self):
+        return _m("nanosecond", self._expr, fn=lambda x: x.nanosecond(), rt=dt.INT)
+
+    def microsecond(self):
+        return _m("microsecond", self._expr, fn=lambda x: x.microsecond(), rt=dt.INT)
+
+    def millisecond(self):
+        return _m("millisecond", self._expr, fn=lambda x: x.millisecond(), rt=dt.INT)
+
+    def second(self):
+        return _m("second", self._expr, fn=lambda x: x.second(), rt=dt.INT)
+
+    def minute(self):
+        return _m("minute", self._expr, fn=lambda x: x.minute(), rt=dt.INT)
+
+    def hour(self):
+        return _m("hour", self._expr, fn=lambda x: x.hour(), rt=dt.INT)
+
+    def day(self):
+        return _m("day", self._expr, fn=lambda x: x.day(), rt=dt.INT)
+
+    def month(self):
+        return _m("month", self._expr, fn=lambda x: x.month(), rt=dt.INT)
+
+    def year(self):
+        return _m("year", self._expr, fn=lambda x: x.year(), rt=dt.INT)
+
+    def weekday(self):
+        return _m("weekday", self._expr, fn=lambda x: x.weekday(), rt=dt.INT)
+
+    def timestamp(self, unit: str = "ns"):
+        return _m("timestamp", self._expr, fn=lambda x: x.timestamp(unit),
+                  rt=dt.INT if unit == "ns" else dt.FLOAT)
+
+    # parsing / formatting
+    def strptime(self, fmt: Any = None, contains_timezone: bool = False):
+        cls = DateTimeUtc if contains_timezone else DateTimeNaive
+
+        def f(s, fmt_):
+            return cls(s, fmt=fmt_)
+
+        return _m("strptime", self._expr, wrap_arg(fmt), fn=f,
+                  rt=dt.DATE_TIME_UTC if contains_timezone else dt.DATE_TIME_NAIVE)
+
+    def strftime(self, fmt: Any):
+        return _m("strftime", self._expr, wrap_arg(fmt),
+                  fn=lambda x, fmt_: x.strftime(fmt_), rt=dt.STR)
+
+    def to_naive(self, timezone: str = "UTC"):
+        def f(x):
+            if isinstance(x, DateTimeUtc):
+                return DateTimeNaive(ns=x.timestamp_ns())
+            return x
+        return _m("to_naive", self._expr, fn=f, rt=dt.DATE_TIME_NAIVE)
+
+    def to_utc(self, from_timezone: str = "UTC"):
+        def f(x):
+            if isinstance(x, DateTimeNaive):
+                return DateTimeUtc(ns=x.timestamp_ns())
+            return x
+        return _m("to_utc", self._expr, fn=f, rt=dt.DATE_TIME_UTC)
+
+    def round(self, duration: Any):
+        return _m("round", self._expr, wrap_arg(duration),
+                  fn=lambda x, d: x.round(_to_duration(d)), rt=None)
+
+    def floor(self, duration: Any):
+        return _m("floor", self._expr, wrap_arg(duration),
+                  fn=lambda x, d: x.floor(_to_duration(d)), rt=None)
+
+    def from_timestamp(self, unit: str = "s"):
+        mult = {"s": 1_000_000_000, "ms": 1_000_000, "us": 1_000, "ns": 1}[unit]
+        return _m("from_timestamp", self._expr,
+                  fn=lambda x: DateTimeNaive(ns=int(x * mult)), rt=dt.DATE_TIME_NAIVE)
+
+    def utc_from_timestamp(self, unit: str = "s"):
+        mult = {"s": 1_000_000_000, "ms": 1_000_000, "us": 1_000, "ns": 1}[unit]
+        return _m("utc_from_timestamp", self._expr,
+                  fn=lambda x: DateTimeUtc(ns=int(x * mult)), rt=dt.DATE_TIME_UTC)
+
+    # duration accessors
+    def nanoseconds(self):
+        return _m("nanoseconds", self._expr, fn=lambda d: d.nanoseconds(), rt=dt.INT)
+
+    def microseconds(self):
+        return _m("microseconds", self._expr, fn=lambda d: d.microseconds(), rt=dt.INT)
+
+    def milliseconds(self):
+        return _m("milliseconds", self._expr, fn=lambda d: d.milliseconds(), rt=dt.INT)
+
+    def seconds(self):
+        return _m("seconds", self._expr, fn=lambda d: d.seconds(), rt=dt.INT)
+
+    def minutes(self):
+        return _m("minutes", self._expr, fn=lambda d: d.minutes(), rt=dt.INT)
+
+    def hours(self):
+        return _m("hours", self._expr, fn=lambda d: d.hours(), rt=dt.INT)
+
+    def days(self):
+        return _m("days", self._expr, fn=lambda d: d.days(), rt=dt.INT)
+
+    def weeks(self):
+        return _m("weeks", self._expr, fn=lambda d: d.weeks(), rt=dt.INT)

@@ -1,0 +1,157 @@
+"""128-bit content-addressed row keys (pointers).
+
+TPU-native equivalent of the reference's `Key` (src/engine/value.rs:41-63):
+a 128-bit hash used as a stable, content-addressed row identifier. The
+reference uses xxh3-128; we use blake2b truncated to 128 bits — the contract
+(deterministic, content-addressed, uniformly distributed, shardable) is the
+same, the hash function is an implementation detail.
+
+Keys double as the sharding domain: `shard(n)` buckets a key onto one of n
+workers / TPU cores; the same bucketing drives the ICI all_to_all exchange
+plan in `pathway_tpu.parallel`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import struct
+from typing import Any, Iterable
+
+_MASK = (1 << 128) - 1
+_SALT_SEQ = 0x9E3779B97F4A7C15F39CC0605CEDC834
+
+
+class Key:
+    """A 128-bit pointer / row id."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: int):
+        self.value = value & _MASK
+
+    def __repr__(self) -> str:
+        return f"^{self.value:032X}"[:12] + "..."
+
+    def __str__(self) -> str:
+        return f"^{self.value:032X}"
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Key) and self.value == other.value
+
+    def __lt__(self, other: "Key") -> bool:
+        return self.value < other.value
+
+    def __le__(self, other: "Key") -> bool:
+        return self.value <= other.value
+
+    def __gt__(self, other: "Key") -> bool:
+        return self.value > other.value
+
+    def __ge__(self, other: "Key") -> bool:
+        return self.value >= other.value
+
+    def __hash__(self) -> int:
+        return self.value & 0x7FFFFFFFFFFFFFFF
+
+    def salted_with(self, salt: int) -> "Key":
+        """Mix a salt into the key (reference: value.rs salted_with)."""
+        return Key(_hash_bytes(self.value.to_bytes(16, "little") + salt.to_bytes(8, "little", signed=False)))
+
+    def with_shard_of(self, other: "Key", n_shards: int = 1 << 16) -> "Key":
+        """Keep `other`'s shard bucket while retaining this key's identity
+        (reference: value.rs with_shard_of — co-locates instance groups)."""
+        bucket = other.shard(n_shards)
+        base = self.value & (_MASK >> 16)
+        return Key((bucket << 112) | base)
+
+    def shard(self, n: int) -> int:
+        """Shard bucket in [0, n) — top bits, matching exchange routing."""
+        return (self.value >> 112) % n
+
+    def to_hi_lo(self) -> tuple[int, int]:
+        return (self.value >> 64, self.value & 0xFFFFFFFFFFFFFFFF)
+
+    @staticmethod
+    def from_hi_lo(hi: int, lo: int) -> "Key":
+        return Key((hi << 64) | lo)
+
+
+def _hash_bytes(data: bytes) -> int:
+    return int.from_bytes(hashlib.blake2b(data, digest_size=16).digest(), "little")
+
+
+def _serialize_value(value: Any, out: list[bytes]) -> None:
+    """Canonical serialization of a Value for hashing (type-tagged)."""
+    import numpy as np
+
+    from pathway_tpu.internals import json as pw_json
+    from pathway_tpu.internals.datetime_types import DateTimeNaive, DateTimeUtc, Duration
+
+    if value is None:
+        out.append(b"\x00")
+    elif isinstance(value, bool) or isinstance(value, np.bool_):
+        out.append(b"\x01" + (b"\x01" if value else b"\x00"))
+    elif isinstance(value, (int, np.integer)):
+        out.append(b"\x02" + struct.pack("<q", int(value)))
+    elif isinstance(value, (float, np.floating)):
+        out.append(b"\x03" + struct.pack("<d", float(value)))
+    elif isinstance(value, str):
+        b = value.encode("utf-8")
+        out.append(b"\x04" + struct.pack("<q", len(b)) + b)
+    elif isinstance(value, bytes):
+        out.append(b"\x05" + struct.pack("<q", len(value)) + value)
+    elif isinstance(value, Key):
+        out.append(b"\x06" + value.value.to_bytes(16, "little"))
+    elif isinstance(value, tuple):
+        out.append(b"\x07" + struct.pack("<q", len(value)))
+        for v in value:
+            _serialize_value(v, out)
+    elif isinstance(value, np.ndarray):
+        out.append(b"\x08" + str(value.dtype).encode() + str(value.shape).encode() + value.tobytes())
+    elif isinstance(value, DateTimeUtc):
+        out.append(b"\x0b" + struct.pack("<q", value.timestamp_ns()))
+    elif isinstance(value, DateTimeNaive):
+        out.append(b"\x09" + struct.pack("<q", value.timestamp_ns()))
+    elif isinstance(value, Duration):
+        out.append(b"\x0a" + struct.pack("<q", value.nanoseconds()))
+    elif isinstance(value, pw_json.Json):
+        out.append(b"\x0c" + pw_json.Json.dumps(value.value).encode("utf-8"))
+    else:
+        # Opaque objects: hash by repr (stable within a run for wrappers)
+        out.append(b"\x0d" + repr(value).encode("utf-8", "replace"))
+
+
+def hash_values(*values: Any) -> int:
+    out: list[bytes] = []
+    for v in values:
+        _serialize_value(v, out)
+    return _hash_bytes(b"".join(out))
+
+
+def key_for_values(*values: Any) -> Key:
+    """Content-addressed key from column values (reference: Key::for_values)."""
+    return Key(hash_values(*values))
+
+
+def key_for_value(value: Any) -> Key:
+    return Key(hash_values(value))
+
+
+_seq_counter = itertools.count()
+
+
+def sequential_key(base: int = 0) -> Key:
+    """Auto-generated key for rows without a primary key: hash of a sequence
+    number (keeps keys uniformly spread over the shard space)."""
+    n = next(_seq_counter)
+    return Key(_hash_bytes(struct.pack("<QQ", base, n) + _SALT_SEQ.to_bytes(16, "little")))
+
+
+def ref_scalar(*args: Any, optional: bool = False, instance: Any = None) -> Key:
+    """Public `pw.Table.pointer_from` semantics."""
+    if instance is not None:
+        base = key_for_values(*args)
+        inst = key_for_values(instance)
+        return base.with_shard_of(inst)
+    return key_for_values(*args)

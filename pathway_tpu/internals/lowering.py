@@ -1,0 +1,604 @@
+"""Lowering: graph IR (OpSpecs) -> engine nodes.
+
+Reference parity: internals/graph_runner/ (storage_graph.py:51 plans,
+operator_handler.py:77 per-op handlers, expression_evaluator.py:201 rowwise
+eval). Tree-shaking is implicit: only specs reachable from requested sinks
+are lowered.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.engine import core as eng
+from pathway_tpu.engine.runtime import (
+    AsyncApplyNode,
+    Connector,
+    InputSession,
+    IterateNode,
+    OutputNode,
+    Runtime,
+)
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.expression_compiler import (
+    Resolver,
+    compile_expression,
+    referenced_tables,
+)
+from pathway_tpu.internals.keys import Key, hash_values, key_for_values
+from pathway_tpu.internals.table import OpSpec, Table
+
+
+class _SlotRef(ex.ColumnExpression):
+    """Direct (input_idx, col_idx) reference injected during lowering."""
+
+    def __init__(self, input_idx: int, col_idx: int):
+        self.input_idx = input_idx
+        self.col_idx = col_idx
+
+
+class GroupResolver(Resolver):
+    """Resolver for post-groupby expressions: grouping columns and reducer
+    results live in the groupby node's output row."""
+
+    def __init__(self, gb_exprs: list, reducer_slots: dict[int, int], table: Table):
+        super().__init__([None], reducer_slots=reducer_slots, reducer_input=0)
+        self.gb_exprs = gb_exprs
+        self.source_table = table
+
+    def resolve(self, ref: ex.ColumnReference) -> tuple[int, int | None]:
+        if isinstance(ref, ex.IdReference):
+            return (0, None)
+        for i, g in enumerate(self.gb_exprs):
+            if isinstance(g, ex.ColumnReference) and g.name == ref.name:
+                return (0, i)
+        raise KeyError(
+            f"column {ref.name!r} is not part of the groupby key; "
+            f"wrap it in a reducer"
+        )
+
+
+class JoinResolver(Resolver):
+    """Resolver over a join node's output rows: (lkey, rkey, *lrow, *rrow)."""
+
+    def __init__(self, left: Table, right: Table):
+        super().__init__([None], left_table=left, right_table=right)
+        self.left = left
+        self.right = right
+        self.lnames = left._column_names()
+        self.rnames = right._column_names()
+
+    def resolve(self, ref: ex.ColumnReference) -> tuple[int, int | None]:
+        from pathway_tpu.internals.joins import _JoinIdRef
+
+        if isinstance(ref, _JoinIdRef):
+            return (0, None)
+        tab = ref.table
+        if isinstance(tab, ex.ThisMarker):
+            tab = self.left if tab._side in ("this", "left") else self.right
+        if isinstance(ref, ex.IdReference):
+            return (0, 0) if tab is self.left else (0, 1)
+        if tab is self.left:
+            return (0, 2 + self.lnames.index(ref.name))
+        if tab is self.right:
+            return (0, 2 + len(self.lnames) + self.rnames.index(ref.name))
+        raise KeyError(f"table of {ref!r} is not a join side")
+
+
+class Session:
+    """One lowering + execution context (per pw.run / debug computation)."""
+
+    def __init__(self) -> None:
+        self.graph = eng.Graph()
+        self.cache: dict[int, eng.Node] = {}
+        self.static_batches: list[tuple[int, eng.InputNode, list]] = []
+        self.connectors: list[Connector] = []
+        self.iterate_nodes: dict[int, IterateNode] = {}
+        self.placeholder_data: dict[str, list] = {}
+        self.autocommit_ms = 2
+        self.monitors: list[Callable[[int], None]] = []
+
+    # ---------------------------------------------------------------- build
+
+    def node_of(self, table: Table) -> eng.Node:
+        spec = table._spec
+        if spec.id in self.cache:
+            return self.cache[spec.id]
+        node = self._build(table, spec)
+        self.cache[spec.id] = node
+        return node
+
+    def _compile_rowwise(
+        self, main: Table, exprs: dict[str, ex.ColumnExpression]
+    ) -> tuple[list[eng.Node], Callable]:
+        """Returns (input nodes, fn(key, *rows) -> out_row), handling side
+        tables and async sub-expressions."""
+        expr_list = list(exprs.values())
+        side_tables = [
+            t for t in referenced_tables(expr_list) if isinstance(t, Table) and t is not main
+        ]
+        # async sub-expressions get their own AsyncApplyNode each
+        async_exprs = _collect_async(expr_list)
+        input_nodes: list[eng.Node] = [self.node_of(main)]
+        tables: list[Any] = [main]
+        for t in side_tables:
+            input_nodes.append(self.node_of(t))
+            tables.append(t)
+        substitutions: dict[int, _SlotRef] = {}
+        for ae in async_exprs:
+            side_idx = len(input_nodes)
+            node = self._build_async_node(main, ae)
+            input_nodes.append(node)
+            substitutions[id(ae)] = _SlotRef(side_idx, len(main._column_names()))
+        if substitutions:
+            exprs = {
+                name: _substitute(e, substitutions) for name, e in exprs.items()
+            }
+        resolver = _SubstitutingResolver(tables, substitutions)
+        fns = [compile_expression(e, resolver) for e in exprs.values()]
+        graph = self.graph
+
+        def guard(f):
+            # per-column poison: a failing expression yields ERROR in its
+            # column only (reference: Value::Error semantics)
+            def g(key, rows):
+                try:
+                    return f(key, rows)
+                except Exception as e:  # noqa: BLE001
+                    graph.log_error(f"{type(e).__name__}: {e}")
+                    from pathway_tpu.internals.errors import ERROR
+
+                    return ERROR
+
+            return g
+
+        gfns = [guard(f) for f in fns]
+
+        def fn(key: Key, *rows: tuple) -> tuple:
+            return tuple(f(key, rows) for f in gfns)
+
+        return input_nodes, fn
+
+    def _build_async_node(self, main: Table, ae: ex.AsyncApplyExpression) -> eng.Node:
+        resolver = Resolver([main])
+        arg_fns = [compile_expression(a, resolver) for a in ae._args]
+        kw_fns = {k: compile_expression(v, resolver) for k, v in ae._kwargs.items()}
+        raw_fn = ae._fn
+
+        def call(key: Key, row: tuple) -> Any:
+            rows = (row,)
+            args = [f(key, rows) for f in arg_fns]
+            kwargs = {k: f(key, rows) for k, f in kw_fns.items()}
+            return raw_fn(*args, **kwargs)
+
+        return AsyncApplyNode(
+            self.graph,
+            self.node_of(main),
+            call,
+            is_async=True,
+            deterministic=ae._deterministic,
+        )
+
+    def _build(self, table: Table, spec: OpSpec) -> eng.Node:
+        kind = spec.kind
+        g = self.graph
+
+        if kind == "static":
+            node = eng.InputNode(g)
+            rows = spec.params["rows"]
+            by_time: dict[int, list] = {}
+            for t, key, row, diff in rows:
+                by_time.setdefault(t, []).append((key, row, diff))
+            for t, entries in by_time.items():
+                self.static_batches.append((t, node, entries))
+            return node
+
+        if kind == "connector":
+            node = eng.InputNode(g)
+            factory = spec.params["factory"]
+            session = InputSession(node, upsert=spec.params.get("upsert", False))
+            connector = factory(session)
+            self.connectors.append(connector)
+            return node
+
+        if kind == "iterate_placeholder":
+            node = eng.InputNode(g)
+            name = spec.params["name"]
+            entries = self.placeholder_data.get(name, [])
+            if entries:
+                self.static_batches.append((0, node, list(entries)))
+            return node
+
+        if kind == "rowwise":
+            exprs = spec.params["exprs"]
+            input_nodes, fn = self._compile_rowwise(spec.inputs[0], exprs)
+            return eng.RowwiseNode(g, input_nodes, fn)
+
+        if kind == "filter":
+            main = spec.inputs[0]
+            cond = spec.params["cond"]
+            side = [
+                t for t in referenced_tables([cond]) if isinstance(t, Table) and t is not main
+            ]
+            if not side and not _collect_async([cond]):
+                resolver = Resolver([main])
+                cf = compile_expression(cond, resolver)
+                return eng.FilterNode(
+                    g, self.node_of(main), lambda key, row: cf(key, (row,))
+                )
+            # general case: compute condition as an extra aligned column
+            names = main._column_names()
+            exprs = {n: ex.ColumnReference(main, n) for n in names}
+            exprs["__cond__"] = cond
+            input_nodes, fn = self._compile_rowwise(main, exprs)
+            rw = eng.RowwiseNode(g, input_nodes, fn)
+            flt = eng.FilterNode(g, rw, lambda key, row: row[-1])
+            return eng.StatelessNode(
+                g, flt, lambda entries, t: [(k, r[:-1], d) for k, r, d in entries]
+            )
+
+        if kind == "groupby":
+            return self._build_groupby(table, spec)
+
+        if kind == "join":
+            return self._build_join(table, spec)
+
+        if kind == "concat":
+            nodes = [self.node_of(t) for t in spec.inputs]
+            if spec.params.get("reindex"):
+                nodes = [
+                    eng.ReindexNode(
+                        g, n, (lambda salt: lambda key, row: Key(hash_values(key, salt)))(i)
+                    )
+                    for i, n in enumerate(nodes)
+                ]
+            return eng.ConcatNode(g, nodes)
+
+        if kind == "update_rows":
+            return eng.UpdateRowsNode(
+                g, self.node_of(spec.inputs[0]), self.node_of(spec.inputs[1])
+            )
+
+        if kind == "update_cells":
+            return eng.UpdateCellsNode(
+                g,
+                self.node_of(spec.inputs[0]),
+                self.node_of(spec.inputs[1]),
+                spec.params["col_map"],
+            )
+
+        if kind == "setop":
+            nodes = [self.node_of(t) for t in spec.inputs]
+            return eng.SetOpNode(g, nodes, spec.params["mode"])
+
+        if kind == "with_universe_of":
+            return eng.SetOpNode(
+                g,
+                [self.node_of(spec.inputs[0]), self.node_of(spec.inputs[1])],
+                "restrict",
+            )
+
+        if kind == "having":
+            indexers = spec.params["indexers"]
+            nodes = [self.node_of(spec.inputs[0])]
+            for ref in indexers:
+                nodes.append(self.node_of(ref.table))
+            return eng.SetOpNode(g, nodes, "intersect")
+
+        if kind == "reindex":
+            main = spec.inputs[0]
+            key_expr = spec.params["key_expr"]
+            resolver = Resolver([main])
+            kf = compile_expression(key_expr, resolver)
+
+            def key_fn(key: Key, row: tuple) -> Key:
+                v = kf(key, (row,))
+                if not isinstance(v, Key):
+                    v = key_for_values(v)
+                return v
+
+            return eng.ReindexNode(g, self.node_of(main), key_fn)
+
+        if kind == "flatten":
+            main = spec.inputs[0]
+            idx = main._column_names().index(spec.params["column"])
+            return eng.FlattenNode(g, self.node_of(main), idx)
+
+        if kind == "ix":
+            context_t, target_t = spec.inputs
+            resolver = Resolver([context_t])
+            pf = compile_expression(spec.params["pointer"], resolver)
+            return eng.IxNode(
+                g,
+                self.node_of(context_t),
+                self.node_of(target_t),
+                lambda key, row: pf(key, (row,)),
+                optional=spec.params.get("optional", False),
+                target_width=len(target_t._column_names()),
+            )
+
+        if kind == "sort":
+            main = spec.inputs[0]
+            resolver = Resolver([main])
+            kf = compile_expression(spec.params["key"], resolver)
+            inst_e = spec.params.get("instance")
+            if inst_e is not None:
+                inf = compile_expression(inst_e, resolver)
+            else:
+                inf = lambda key, rows: 0  # noqa: E731
+            return eng.SortNode(
+                g,
+                self.node_of(main),
+                lambda key, row: kf(key, (row,)),
+                lambda key, row: inf(key, (row,)),
+            )
+
+        if kind == "deduplicate":
+            main = spec.inputs[0]
+            resolver = Resolver([main])
+            vf = compile_expression(spec.params["value"], resolver)
+            inst_e = spec.params.get("instance")
+            if inst_e is not None:
+                instf = compile_expression(inst_e, resolver)
+            else:
+                instf = lambda key, rows: 0  # noqa: E731
+            return eng.DeduplicateNode(
+                g,
+                self.node_of(main),
+                lambda key, row: instf(key, (row,)),
+                lambda key, row: vf(key, (row,)),
+                spec.params["acceptor"],
+            )
+
+        if kind in ("buffer", "forget", "freeze"):
+            main = spec.inputs[0]
+            resolver = Resolver([main])
+            tf = compile_expression(spec.params["threshold"], resolver)
+            cf = compile_expression(spec.params["current"], resolver)
+            cls = {"buffer": eng.BufferNode, "forget": eng.ForgetNode, "freeze": eng.FreezeNode}[kind]
+            return cls(
+                g,
+                self.node_of(main),
+                lambda key, row: tf(key, (row,)),
+                lambda key, row: cf(key, (row,)),
+            )
+
+        if kind == "iterate_output":
+            it_spec = spec.params["iterate"]
+            name = spec.params["name"]
+            it_node = self._get_iterate_node(it_spec)
+            out_node = eng.InputNode(self.graph)
+            it_node.set_output_node(name, out_node)
+            return out_node
+
+        if kind == "external_index":
+            from pathway_tpu.stdlib.indexing.lowering import build_external_index
+
+            return build_external_index(self, table, spec)
+
+        if kind == "gradual_broadcast":
+            big, small = spec.inputs
+            resolver = Resolver([small])
+            lf = compile_expression(spec.params["lower"], resolver)
+            vf = compile_expression(spec.params["value"], resolver)
+            uf = compile_expression(spec.params["upper"], resolver)
+            return eng.GradualBroadcastNode(
+                g,
+                self.node_of(big),
+                self.node_of(small),
+                lambda key, row: (lf(key, (row,)), vf(key, (row,)), uf(key, (row,))),
+            )
+
+        raise NotImplementedError(f"lowering for spec kind {kind!r}")
+
+    # ------------------------------------------------------------- groupby
+
+    def _build_groupby(self, table: Table, spec: OpSpec) -> eng.Node:
+        from pathway_tpu.internals.reducers import _EngineTimeMarker
+
+        main = spec.inputs[0]
+        gb_exprs: list = spec.params["gb_exprs"]
+        out_exprs: dict[str, ex.ColumnExpression] = spec.params["out_exprs"]
+        reducer_exprs: list[ex.ReducerExpression] = spec.params["reducer_exprs"]
+
+        resolver = Resolver([main])
+        gb_fns = [compile_expression(e, resolver) for e in gb_exprs]
+
+        def gk_fn(key: Key, row: tuple) -> tuple:
+            return tuple(f(key, (row,)) for f in gb_fns)
+
+        reducers = []
+        arg_fns = []
+        for re_ in reducer_exprs:
+            reducers.append(re_._reducer)
+            per_arg: list[Callable] = []
+            for a in re_._args:
+                if isinstance(a, _EngineTimeMarker):
+                    per_arg.append(lambda key, rows, time: time)
+                else:
+                    f = compile_expression(a, resolver)
+                    per_arg.append(
+                        (lambda f_: lambda key, rows, time: f_(key, rows))(f)
+                    )
+            arg_fns.append(
+                (lambda fs: lambda key, row, time: tuple(
+                    f(key, (row,), time) for f in fs
+                ))(per_arg)
+            )
+
+        gnode = eng.GroupByNode(
+            self.graph, self.node_of(main), gk_fn, reducers, arg_fns
+        )
+        # post-processing rowwise over (gvals..., rvals...)
+        reducer_slots = {
+            id(re_): len(gb_exprs) + i for i, re_ in enumerate(reducer_exprs)
+        }
+        gres = GroupResolver(gb_exprs, reducer_slots, main)
+        fns = [compile_expression(e, gres) for e in out_exprs.values()]
+
+        def fn(key: Key, *rows: tuple) -> tuple:
+            return tuple(f(key, rows) for f in fns)
+
+        return eng.RowwiseNode(self.graph, [gnode], fn)
+
+    # ---------------------------------------------------------------- join
+
+    def _build_join(self, table: Table, spec: OpSpec) -> eng.Node:
+        left_t, right_t = spec.inputs
+        on = spec.params["on"]
+        mode = spec.params["mode"]
+        id_mode = spec.params["id_mode"]
+        out_exprs: dict[str, ex.ColumnExpression] = spec.params["exprs"]
+
+        lres = Resolver([left_t])
+        rres = Resolver([right_t])
+        lfns = [compile_expression(le, lres) for le, _ in on]
+        rfns = [compile_expression(re_, rres) for _, re_ in on]
+
+        def left_jk(key: Key, row: tuple) -> tuple:
+            return tuple(f(key, (row,)) for f in lfns)
+
+        def right_jk(key: Key, row: tuple) -> tuple:
+            return tuple(f(key, (row,)) for f in rfns)
+
+        jnode = eng.JoinNode(
+            self.graph,
+            self.node_of(left_t),
+            self.node_of(right_t),
+            left_jk,
+            right_jk,
+            mode=mode,
+            id_mode=id_mode,
+            left_width=len(left_t._column_names()),
+            right_width=len(right_t._column_names()),
+            asof_now=spec.params.get("asof_now", False),
+        )
+        jres = JoinResolver(left_t, right_t)
+        fns = [compile_expression(e, jres) for e in out_exprs.values()]
+
+        def fn(key: Key, *rows: tuple) -> tuple:
+            return tuple(f(key, rows) for f in fns)
+
+        return eng.RowwiseNode(self.graph, [jnode], fn)
+
+    # ------------------------------------------------------------- iterate
+
+    def _get_iterate_node(self, it_spec: Any) -> IterateNode:
+        if id(it_spec) in self.iterate_nodes:
+            return self.iterate_nodes[id(it_spec)]
+        input_nodes = [self.node_of(t) for t in it_spec.inputs.values()]
+        input_names = list(it_spec.inputs.keys())
+
+        def step_fn(data: dict[str, list]) -> dict[str, list]:
+            sub = Session()
+            sub.placeholder_data = data
+            captures: dict[str, eng.CaptureNode] = {}
+            for name, t in it_spec.results.items():
+                captures[name] = eng.CaptureNode(sub.graph, sub.node_of(t))
+            runtime = Runtime(sub.graph)
+            runtime.run_static(sub.static_batches)
+            return {
+                name: cap.state.as_entries() for name, cap in captures.items()
+            }
+
+        node = IterateNode(
+            self.graph,
+            input_nodes,
+            input_names,
+            it_spec.iterated_names,
+            list(it_spec.results.keys()),
+            step_fn,
+            it_spec.iteration_limit,
+        )
+        self.iterate_nodes[id(it_spec)] = node
+        return node
+
+    # ------------------------------------------------------------- execute
+
+    def capture(self, table: Table) -> eng.CaptureNode:
+        return eng.CaptureNode(self.graph, self.node_of(table))
+
+    def subscribe(
+        self,
+        table: Table,
+        on_change: Callable | None = None,
+        on_time_end: Callable | None = None,
+        on_end: Callable | None = None,
+    ) -> None:
+        from pathway_tpu.engine.core import SubscribeNode
+
+        SubscribeNode(self.graph, self.node_of(table), on_change, on_time_end, on_end)
+
+    def output(self, table: Table, write_batch: Callable, flush=None, close=None) -> None:
+        OutputNode(self.graph, self.node_of(table), write_batch, flush, close)
+
+    def execute(self) -> None:
+        runtime = Runtime(self.graph, autocommit_ms=self.autocommit_ms)
+        runtime.monitors = list(self.monitors)
+        if not self.connectors:
+            runtime.run_static(self.static_batches)
+            return
+        # streaming: static data goes in at the first tick
+        for t, node, entries in self.static_batches:
+            node.push(entries)
+        for c in self.connectors:
+            runtime.add_connector(c)
+        if self.static_batches:
+            runtime.graph.step(runtime.next_time())
+        runtime.run()
+
+
+class _SubstitutingResolver(Resolver):
+    def __init__(self, tables: list, substitutions: dict[int, _SlotRef]):
+        super().__init__(tables)
+        self.substitutions = substitutions
+
+
+def _collect_async(exprs: list) -> list[ex.AsyncApplyExpression]:
+    out: list[ex.AsyncApplyExpression] = []
+    seen: set[int] = set()
+
+    def rec(e: ex.ColumnExpression) -> None:
+        if id(e) in seen:
+            return
+        seen.add(id(e))
+        if isinstance(e, ex.AsyncApplyExpression):
+            out.append(e)
+            return
+        for s in e._sub_expressions():
+            rec(s)
+
+    for e in exprs:
+        rec(e)
+    return out
+
+
+def _substitute(
+    e: ex.ColumnExpression, subs: dict[int, _SlotRef]
+) -> ex.ColumnExpression:
+    if id(e) in subs:
+        return subs[id(e)]
+    for name, val in list(vars(e).items()):
+        if isinstance(val, ex.ColumnExpression):
+            setattr(e, name, _substitute(val, subs))
+        elif isinstance(val, tuple) and any(isinstance(v, ex.ColumnExpression) for v in val):
+            setattr(
+                e,
+                name,
+                tuple(
+                    _substitute(v, subs) if isinstance(v, ex.ColumnExpression) else v
+                    for v in val
+                ),
+            )
+        elif isinstance(val, dict) and any(
+            isinstance(v, ex.ColumnExpression) for v in val.values()
+        ):
+            setattr(
+                e,
+                name,
+                {
+                    k: _substitute(v, subs) if isinstance(v, ex.ColumnExpression) else v
+                    for k, v in val.items()
+                },
+            )
+    return e

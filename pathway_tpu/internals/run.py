@@ -1,0 +1,66 @@
+"""pw.run: lower all registered sinks and execute
+(reference: internals/run.py:11 + graph_runner/__init__.py:113)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.config import get_config
+from pathway_tpu.internals.lowering import Session
+from pathway_tpu.internals.parse_graph import G
+
+
+def run(
+    *,
+    debug: bool = False,
+    monitoring_level: Any = None,
+    with_http_server: bool = False,
+    default_logging: bool = True,
+    persistence_config: Any = None,
+    license_key: str | None = None,
+    runtime_typechecking: bool = True,
+    terminate_on_error: bool = False,
+    autocommit_duration_ms: int | None = None,
+    device: str | None = None,
+    **kwargs: Any,
+) -> None:
+    session = Session()
+    session.graph.terminate_on_error = terminate_on_error or get_config().terminate_on_error
+    if autocommit_duration_ms:
+        session.autocommit_ms = autocommit_duration_ms
+    if persistence_config is not None:
+        from pathway_tpu.persistence import attach_persistence
+
+        attach_persistence(session, persistence_config)
+    for hook in G.pre_run_hooks:
+        hook()
+    for sink in G.sinks:
+        if sink.kind == "subscribe":
+            session.subscribe(
+                sink.table,
+                on_change=sink.params.get("on_change"),
+                on_time_end=sink.params.get("on_time_end"),
+                on_end=sink.params.get("on_end"),
+            )
+        elif sink.kind == "output":
+            session.output(
+                sink.table,
+                sink.params["write_batch"],
+                sink.params.get("flush"),
+                sink.params.get("close"),
+            )
+        else:
+            raise ValueError(f"unknown sink kind {sink.kind}")
+    if with_http_server:
+        from pathway_tpu.internals.metrics import start_metrics_server
+
+        start_metrics_server(session)
+    if monitoring_level not in (None, False, "none"):
+        from pathway_tpu.internals.monitoring import attach_monitor
+
+        attach_monitor(session)
+    session.execute()
+
+
+def run_all(**kwargs: Any) -> None:
+    run(**kwargs)

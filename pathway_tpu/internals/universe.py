@@ -1,0 +1,68 @@
+"""Universes: key-set identity & subset reasoning.
+
+Reference: internals/universe.py + universe_solver.py — static reasoning
+about which tables share the same key set, so same-universe ops (select
+across tables, update_cells, with_universe_of) can be validated at graph
+build time. Union-find for equality + a subset relation graph.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any
+
+_ids = itertools.count()
+
+
+class Universe:
+    def __init__(self) -> None:
+        self.id = next(_ids)
+        self._parent: Universe | None = None
+        self._subset_of: set[int] = set()  # root ids this is a subset of
+
+    def root(self) -> "Universe":
+        u = self
+        while u._parent is not None:
+            u = u._parent
+        if u is not self:
+            self._parent = u
+        return u
+
+    def __repr__(self) -> str:
+        return f"Universe({self.root().id})"
+
+
+def promise_are_equal(*universes: Universe) -> None:
+    roots = [u.root() for u in universes]
+    for other in roots[1:]:
+        if other is not roots[0]:
+            other._parent = roots[0]
+            roots[0]._subset_of |= other._subset_of
+
+
+def are_equal(a: Universe, b: Universe) -> bool:
+    return a.root() is b.root()
+
+
+def register_subset(sub: Universe, sup: Universe) -> None:
+    sub.root()._subset_of.add(sup.root().id)
+
+
+def is_subset(sub: Universe, sup: Universe) -> bool:
+    if are_equal(sub, sup):
+        return True
+    # transitive closure over the (small) subset graph
+    seen: set[int] = set()
+    frontier = [sub.root()]
+    sup_id = sup.root().id
+    while frontier:
+        u = frontier.pop()
+        if u.id in seen:
+            continue
+        seen.add(u.id)
+        if u.id == sup_id or sup_id in u._subset_of:
+            return True
+        for uid in u._subset_of:
+            if uid == sup_id:
+                return True
+    return sup_id in {uid for u in [sub.root()] for uid in u._subset_of} or False

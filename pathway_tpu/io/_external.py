@@ -1,0 +1,43 @@
+"""Shared machinery for service-backed connectors.
+
+The reference implements these against native client crates
+(src/connectors/data_storage.rs). Here each family exposes the same
+read()/write() API; families whose client library is absent in the runtime
+raise a clear error at call time (the API surface and descriptors stay
+importable so templates/YAML configs parse).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable
+
+
+def require_module(name: str, family: str) -> Any:
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(
+            f"pw.io.{family} requires the {name!r} package, which is not "
+            f"installed in this environment"
+        ) from e
+
+
+def gated_reader(family: str, module: str) -> Callable:
+    def read(*args: Any, **kwargs: Any) -> Any:
+        require_module(module, family)
+        raise NotImplementedError(
+            f"pw.io.{family}.read: client {module!r} unavailable in this build"
+        )
+
+    return read
+
+
+def gated_writer(family: str, module: str) -> Callable:
+    def write(*args: Any, **kwargs: Any) -> None:
+        require_module(module, family)
+        raise NotImplementedError(
+            f"pw.io.{family}.write: client {module!r} unavailable in this build"
+        )
+
+    return write

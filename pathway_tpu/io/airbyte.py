@@ -1,0 +1,9 @@
+"""pw.io.airbyte — API-parity connector (reference: io/airbyte).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("airbyte", "requests")
+write = gated_writer("airbyte", "requests")

@@ -1,0 +1,9 @@
+"""pw.io.bigquery — API-parity connector (reference: io/bigquery).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("bigquery", "google.cloud.bigquery")
+write = gated_writer("bigquery", "google.cloud.bigquery")

@@ -1,0 +1,9 @@
+"""pw.io.debezium — API-parity connector (reference: io/debezium).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("debezium", "confluent_kafka")
+write = gated_writer("debezium", "confluent_kafka")

@@ -1,0 +1,9 @@
+"""pw.io.deltalake — API-parity connector (reference: io/deltalake).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("deltalake", "deltalake")
+write = gated_writer("deltalake", "deltalake")

@@ -1,0 +1,9 @@
+"""pw.io.elasticsearch — API-parity connector (reference: io/elasticsearch).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("elasticsearch", "elasticsearch")
+write = gated_writer("elasticsearch", "elasticsearch")

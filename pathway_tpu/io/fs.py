@@ -1,0 +1,252 @@
+"""Filesystem connector: csv / json(lines) / plaintext / binary read+write.
+
+Reference: io/fs (read/write over the Rust posix-like reader,
+src/connectors/scanner/filesystem.rs + data_format.rs parsers). Static mode
+reads the current contents once; streaming mode keeps polling the path for
+new/updated files, the reference's directory-watch behavior.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import glob as _glob
+import json as _json
+import os
+import threading
+import time as _time
+from typing import Any, Callable, Iterable
+
+from pathway_tpu.engine.runtime import InputSession, ThreadConnector
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals import universe as univ
+from pathway_tpu.internals.datasink import CallbackDataSink
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.keys import key_for_values, sequential_key
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import OpSpec, Table
+
+
+def _list_files(path: str) -> list[str]:
+    if os.path.isdir(path):
+        out = []
+        for root, _dirs, files in os.walk(path):
+            for f in sorted(files):
+                out.append(os.path.join(root, f))
+        return sorted(out)
+    if any(c in path for c in "*?["):
+        return sorted(_glob.glob(path))
+    if os.path.exists(path):
+        return [path]
+    return []
+
+
+def _coerce(value: str, dtype: dt.DType) -> Any:
+    base = dt.unoptionalize(dtype)
+    if value == "" and isinstance(dtype, dt.Optional):
+        return None
+    try:
+        if base == dt.INT:
+            return int(value)
+        if base == dt.FLOAT:
+            return float(value)
+        if base == dt.BOOL:
+            return value.strip().lower() in ("true", "1", "yes", "on")
+        if base == dt.JSON:
+            return Json(_json.loads(value))
+    except (ValueError, TypeError):
+        return None if isinstance(dtype, dt.Optional) else value
+    return value
+
+
+def _parse_file(
+    path: str, format: str, schema: sch.SchemaMetaclass, csv_settings: Any = None,
+    with_metadata: bool = False,
+) -> Iterable[dict[str, Any]]:
+    names = list(schema.__columns__)
+    meta = None
+    if with_metadata:
+        st = os.stat(path)
+        meta = Json({
+            "path": path, "size": st.st_size, "modified_at": int(st.st_mtime),
+            "created_at": int(st.st_ctime), "seen_at": int(_time.time()),
+        })
+    if format in ("plaintext", "plaintext_by_file"):
+        if format == "plaintext_by_file":
+            with open(path, "r", errors="replace") as f:
+                row = {"data": f.read()}
+                if with_metadata:
+                    row["_metadata"] = meta
+                yield row
+            return
+        with open(path, "r", errors="replace") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if line or True:
+                    row = {"data": line}
+                    if with_metadata:
+                        row["_metadata"] = meta
+                    yield row
+        return
+    if format == "binary":
+        with open(path, "rb") as f:
+            row = {"data": f.read()}
+            if with_metadata:
+                row["_metadata"] = meta
+            yield row
+        return
+    if format == "csv":
+        delim = ","
+        if csv_settings is not None:
+            delim = getattr(csv_settings, "delimiter", ",")
+        with open(path, "r", newline="", errors="replace") as f:
+            reader = _csv.DictReader(f, delimiter=delim)
+            for rec in reader:
+                row = {}
+                for n in names:
+                    if n == "_metadata":
+                        continue
+                    v = rec.get(n)
+                    row[n] = _coerce(v, schema.__columns__[n].dtype) if v is not None else None
+                if with_metadata:
+                    row["_metadata"] = meta
+                yield row
+        return
+    if format in ("json", "jsonlines"):
+        with open(path, "r", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = _json.loads(line)
+                row = {}
+                for n in names:
+                    if n == "_metadata":
+                        continue
+                    v = rec.get(n)
+                    if isinstance(v, (dict, list)):
+                        v = Json(v)
+                    row[n] = v
+                if with_metadata:
+                    row["_metadata"] = meta
+                yield row
+        return
+    raise ValueError(f"unknown format {format!r}")
+
+
+def read(
+    path: str | os.PathLike,
+    *,
+    format: str = "csv",  # noqa: A002
+    schema: Any = None,
+    mode: str = "streaming",
+    csv_settings: Any = None,
+    autocommit_duration_ms: int | None = 1500,
+    with_metadata: bool = False,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    path = os.fspath(path)
+    if schema is None:
+        if format in ("plaintext", "plaintext_by_file"):
+            schema = sch.schema_from_types(data=str)
+        elif format == "binary":
+            schema = sch.schema_from_types(data=bytes)
+        else:
+            raise ValueError(f"schema required for format {format!r}")
+    if with_metadata and "_metadata" not in schema.__columns__:
+        cols = dict(schema.__columns__)
+        cols["_metadata"] = sch.ColumnSchema(name="_metadata", dtype=dt.JSON)
+        schema = sch.schema_from_columns(cols)
+    names = list(schema.__columns__)
+    pk = schema.primary_key_columns()
+
+    if mode == "static":
+        rows = []
+        for f in _list_files(path):
+            for rec in _parse_file(f, format, schema, csv_settings, with_metadata):
+                rows.append(tuple(rec.get(n) for n in names))
+        keys = None
+        if pk:
+            keys = [key_for_values(*[r[names.index(c)] for c in pk]) for r in rows]
+        return Table.from_rows(schema, rows, keys=keys)
+
+    # streaming: poll for new files forever (reference directory watcher)
+    def factory(session: InputSession) -> ThreadConnector:
+        def run_fn(sess: InputSession) -> None:
+            seen: dict[str, float] = {}
+            while True:
+                for f in _list_files(path):
+                    try:
+                        mtime = os.path.getmtime(f)
+                    except OSError:
+                        continue
+                    if seen.get(f) == mtime:
+                        continue
+                    seen[f] = mtime
+                    for rec in _parse_file(f, format, schema, csv_settings, with_metadata):
+                        row = tuple(rec.get(n) for n in names)
+                        key = (
+                            key_for_values(*[rec.get(c) for c in pk])
+                            if pk
+                            else sequential_key()
+                        )
+                        sess.insert(key, row)
+                _time.sleep((autocommit_duration_ms or 1500) / 1000.0)
+
+        return ThreadConnector(name or f"fs:{path}", session, run_fn)
+
+    spec = OpSpec("connector", [], factory=factory, upsert=pk is not None, name=name)
+    return Table(spec, schema, univ.Universe())
+
+
+class _FileWriter:
+    def __init__(self, filename: str, format: str):
+        self.filename = filename
+        self.format = format
+        self._file = None
+        self._csv_writer = None
+        self._names: list[str] | None = None
+
+    def open(self, names: list[str]) -> None:
+        self._names = names
+        self._file = open(self.filename, "w", newline="")
+        if self.format == "csv":
+            self._csv_writer = _csv.writer(self._file)
+            self._csv_writer.writerow(names + ["time", "diff"])
+
+    def write(self, time: int, entries: list) -> None:
+        assert self._file is not None
+        for _key, row, diff in entries:
+            if self.format == "csv":
+                self._csv_writer.writerow(list(row) + [time, diff])
+            elif self.format in ("json", "jsonlines"):
+                rec = dict(zip(self._names, row))
+                rec["time"] = time
+                rec["diff"] = diff
+                self._file.write(Json.dumps(rec) + "\n")
+            else:  # plaintext
+                self._file.write(str(row[0]) + "\n")
+
+    def flush(self) -> None:
+        if self._file:
+            self._file.flush()
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None
+
+
+def write(table: Table, filename: str | os.PathLike, *, format: str = "csv", **kwargs: Any) -> None:  # noqa: A002
+    filename = os.fspath(filename)
+    writer = _FileWriter(filename, format)
+    names = table._column_names()
+    writer.open(names)
+    G.add_sink(
+        "output",
+        table,
+        write_batch=lambda time, entries: writer.write(time, entries),
+        flush=writer.flush,
+        close=writer.close,
+    )

@@ -1,0 +1,9 @@
+"""pw.io.gdrive — API-parity connector (reference: io/gdrive).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("gdrive", "google.oauth2")
+write = gated_writer("gdrive", "google.oauth2")

@@ -1,0 +1,274 @@
+"""pw.io.http — REST ingress/egress + request-response over the dataflow.
+
+Reference: io/http/_server.py (PathwayWebserver :329, rest_connector :624)
+— an aiohttp server turns HTTP requests into rows of a streaming table; a
+response writer subscribes to a result table and completes the pending
+HTTP futures. This is the serving front of the RAG stack.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json as _json
+import threading
+import time as _time
+from typing import Any, Callable
+
+from pathway_tpu.engine.runtime import Connector, InputSession
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals import universe as univ
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.keys import Key, sequential_key
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import OpSpec, Table
+
+
+class PathwayWebserver:
+    """One aiohttp server shared by any number of rest_connector routes."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 8080, with_cors: bool = False):
+        self.host = host
+        self.port = port
+        self.with_cors = with_cors
+        self._routes: list[tuple[str, list[str], Callable]] = []
+        self._started = False
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._ready = threading.Event()
+
+    def add_route(self, route: str, methods: list[str], handler: Callable) -> None:
+        self._routes.append((route, methods, handler))
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        import aiohttp.web as web
+
+        def run() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            app = web.Application()
+            for route, methods, handler in self._routes:
+                for m in methods:
+                    app.router.add_route(m, route, handler)
+
+            async def main() -> None:
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, self.host, self.port)
+                await site.start()
+                self._ready.set()
+
+            loop.run_until_complete(main())
+            loop.run_forever()
+
+        threading.Thread(target=run, daemon=True, name="pw-webserver").start()
+        self._ready.wait(timeout=10)
+
+
+class _RestConnector(Connector):
+    """Never-finishing connector fed by HTTP requests."""
+
+    def __init__(self, name: str, session: InputSession):
+        super().__init__(name, session)
+
+    def start(self) -> None:
+        pass
+
+    @property
+    def done(self) -> bool:
+        return False
+
+
+def rest_connector(
+    host: str | None = None,
+    port: int | None = None,
+    *,
+    webserver: PathwayWebserver | None = None,
+    route: str = "/",
+    methods: tuple[str, ...] = ("POST",),
+    schema: Any = None,
+    autocommit_duration_ms: int | None = 50,
+    keep_queries: bool = False,
+    delete_completed_queries: bool = False,
+    request_validator: Callable | None = None,
+) -> tuple[Table, Callable[[Table], None]]:
+    """Returns (queries_table, response_writer)."""
+    import aiohttp.web as web
+
+    if webserver is None:
+        webserver = PathwayWebserver(host or "0.0.0.0", port or 8080)
+    if schema is None:
+        schema = sch.schema_from_types(query=str, user=str)
+    names = list(schema.__columns__)
+    defaults = schema.default_values()
+
+    pending: dict[int, asyncio.Future] = {}
+    pending_lock = threading.Lock()
+    session_holder: dict[str, InputSession] = {}
+
+    async def handler(request: "web.Request") -> "web.Response":
+        if request.method in ("POST", "PUT", "PATCH"):
+            try:
+                payload = await request.json()
+            except Exception:  # noqa: BLE001
+                payload = {}
+        else:
+            payload = dict(request.query)
+        if request_validator is not None:
+            try:
+                request_validator(payload)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)}, status=400)
+        row = []
+        for n in names:
+            if n in payload:
+                v = payload[n]
+                if isinstance(v, (dict, list)):
+                    v = Json(v)
+                row.append(v)
+            elif n in defaults:
+                row.append(defaults[n])
+            else:
+                row.append(None)
+        key = sequential_key()
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        with pending_lock:
+            pending[key.value] = fut
+        sess = session_holder.get("session")
+        if sess is None:
+            return web.json_response({"error": "pipeline not running"}, status=503)
+        sess.insert(key, tuple(row))
+        try:
+            result = await asyncio.wait_for(fut, timeout=120)
+        except asyncio.TimeoutError:
+            return web.json_response({"error": "timeout"}, status=504)
+        finally:
+            with pending_lock:
+                pending.pop(key.value, None)
+        if isinstance(result, Json):
+            result = result.value
+        return web.json_response(result, dumps=lambda obj: Json.dumps(obj))
+
+    webserver.add_route(route, list(methods), handler)
+
+    def factory(session: InputSession) -> _RestConnector:
+        session_holder["session"] = session
+        return _RestConnector(f"rest:{route}", session)
+
+    spec = OpSpec("connector", [], factory=factory, upsert=False)
+    queries = Table(spec, schema, univ.Universe())
+
+    G.pre_run_hooks.append(webserver.start)
+
+    def response_writer(response_table: Table) -> None:
+        rnames = response_table._column_names()
+        try:
+            result_idx = rnames.index("result")
+        except ValueError:
+            result_idx = 0
+
+        def write_batch(time: int, entries: list) -> None:
+            for key, row, diff in entries:
+                if diff <= 0:
+                    continue
+                with pending_lock:
+                    fut = pending.get(key.value)
+                if fut is not None and not fut.done():
+                    loop = fut.get_loop()
+                    loop.call_soon_threadsafe(
+                        lambda f=fut, v=row[result_idx]: (not f.done()) and f.set_result(v)
+                    )
+
+        G.add_sink("output", response_table, write_batch=write_batch)
+
+    return queries, response_writer
+
+
+# --- egress: per-row HTTP requests ---------------------------------------
+
+
+def write(
+    table: Table,
+    url: str,
+    *,
+    method: str = "POST",
+    format: str = "json",  # noqa: A002
+    headers: dict[str, str] | None = None,
+    n_retries: int = 0,
+    **kwargs: Any,
+) -> None:
+    import requests as _requests
+
+    names = table._column_names()
+
+    def write_batch(time: int, entries: list) -> None:
+        for _key, row, diff in entries:
+            payload = dict(zip(names, row))
+            payload["time"] = time
+            payload["diff"] = diff
+            for attempt in range(n_retries + 1):
+                try:
+                    _requests.request(
+                        method, url, json=_json.loads(Json.dumps(payload)),
+                        headers=headers, timeout=30,
+                    )
+                    break
+                except Exception:  # noqa: BLE001
+                    if attempt == n_retries:
+                        raise
+                    _time.sleep(0.5)
+
+    G.add_sink("output", table, write_batch=write_batch)
+
+
+def read(
+    url: str,
+    *,
+    schema: Any = None,
+    format: str = "json",  # noqa: A002
+    refresh_interval_ms: int = 10000,
+    mode: str = "streaming",
+    **kwargs: Any,
+) -> Table:
+    """Poll an HTTP endpoint and stream its (JSON) rows."""
+    import requests as _requests
+
+    from pathway_tpu.engine.runtime import ThreadConnector
+    from pathway_tpu.internals.keys import key_for_values
+
+    if schema is None:
+        schema = sch.schema_from_types(data=dt.JSON)
+    names = list(schema.__columns__)
+    pk = schema.primary_key_columns()
+
+    def factory(session: InputSession):
+        def run_fn(sess: InputSession) -> None:
+            while True:
+                try:
+                    resp = _requests.get(url, timeout=30)
+                    data = resp.json()
+                    records = data if isinstance(data, list) else [data]
+                    for rec in records:
+                        row = tuple(
+                            Json(rec.get(n)) if isinstance(rec.get(n), (dict, list)) else rec.get(n)
+                            for n in names
+                        )
+                        key = (
+                            key_for_values(*[rec.get(c) for c in pk])
+                            if pk
+                            else key_for_values(Json.dumps(rec))
+                        )
+                        sess.insert(key, row)
+                except Exception:  # noqa: BLE001
+                    pass
+                if mode == "static":
+                    return
+                _time.sleep(refresh_interval_ms / 1000.0)
+
+        return ThreadConnector(f"http:{url}", session, run_fn)
+
+    spec = OpSpec("connector", [], factory=factory, upsert=pk is not None)
+    return Table(spec, schema, univ.Universe())

@@ -1,0 +1,9 @@
+"""pw.io.kafka — API-parity connector (reference: io/kafka).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("kafka", "confluent_kafka")
+write = gated_writer("kafka", "confluent_kafka")

@@ -1,0 +1,9 @@
+"""pw.io.logstash — API-parity connector (reference: io/logstash).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("logstash", "requests")
+write = gated_writer("logstash", "requests")

@@ -1,0 +1,9 @@
+"""pw.io.minio — API-parity connector (reference: io/minio).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("minio", "boto3")
+write = gated_writer("minio", "boto3")

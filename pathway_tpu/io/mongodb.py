@@ -1,0 +1,9 @@
+"""pw.io.mongodb — API-parity connector (reference: io/mongodb).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("mongodb", "pymongo")
+write = gated_writer("mongodb", "pymongo")

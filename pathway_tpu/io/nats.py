@@ -1,0 +1,9 @@
+"""pw.io.nats — API-parity connector (reference: io/nats).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("nats", "nats")
+write = gated_writer("nats", "nats")

@@ -1,0 +1,9 @@
+"""pw.io.postgres — API-parity connector (reference: io/postgres).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("postgres", "psycopg2")
+write = gated_writer("postgres", "psycopg2")

@@ -1,0 +1,9 @@
+"""pw.io.pubsub — API-parity connector (reference: io/pubsub).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("pubsub", "google.cloud.pubsub_v1")
+write = gated_writer("pubsub", "google.cloud.pubsub_v1")

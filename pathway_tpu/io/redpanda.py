@@ -1,0 +1,9 @@
+"""pw.io.redpanda — API-parity connector (reference: io/redpanda).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("redpanda", "confluent_kafka")
+write = gated_writer("redpanda", "confluent_kafka")

@@ -1,0 +1,9 @@
+"""pw.io.s3 — API-parity connector (reference: io/s3).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("s3", "boto3")
+write = gated_writer("s3", "boto3")

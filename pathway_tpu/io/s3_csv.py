@@ -1,0 +1,9 @@
+"""pw.io.s3_csv — API-parity connector (reference: io/s3_csv).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("s3_csv", "boto3")
+write = gated_writer("s3_csv", "boto3")

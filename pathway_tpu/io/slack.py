@@ -1,0 +1,9 @@
+"""pw.io.slack — API-parity connector (reference: io/slack).
+
+Client library gated: see io/_external.py.
+"""
+
+from pathway_tpu.io._external import gated_reader, gated_writer
+
+read = gated_reader("slack", "requests")
+write = gated_writer("slack", "requests")

@@ -1,0 +1,143 @@
+"""Persistence: checkpoint/resume of input streams + metadata.
+
+Reference: python/pathway/persistence/__init__.py (Backend :27, Config :88)
++ src/persistence/ (input snapshots, metadata, offset antichains).
+
+v0 mechanism (input-snapshot replay, the reference's primary free-tier
+path): every connector's parsed event stream is journaled per run to the
+backend; on restart the journal replays before live reading resumes, and
+sources that support seeking skip already-consumed offsets.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import os
+import pickle
+from typing import Any
+
+from pathway_tpu.internals.keys import Key
+
+
+class Backend:
+    kind = "mock"
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+
+    @classmethod
+    def filesystem(cls, path: str) -> "Backend":
+        b = cls(os.fspath(path))
+        b.kind = "filesystem"
+        return b
+
+    @classmethod
+    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
+        raise NotImplementedError("s3 persistence backend requires boto3 (unavailable)")
+
+    @classmethod
+    def azure(cls, *args: Any, **kwargs: Any) -> "Backend":
+        raise NotImplementedError("azure persistence backend unavailable")
+
+    @classmethod
+    def mock(cls, events: Any = None) -> "Backend":
+        return cls(None)
+
+
+class Config:
+    def __init__(
+        self,
+        backend: Backend | None = None,
+        *,
+        snapshot_interval_ms: int = 0,
+        persistence_mode: str = "PERSISTING",
+        snapshot_access: Any = None,
+        continue_after_replay: bool = True,
+    ):
+        self.backend = backend or Backend.mock()
+        self.snapshot_interval_ms = snapshot_interval_ms
+        self.persistence_mode = persistence_mode
+        self.continue_after_replay = continue_after_replay
+
+    @classmethod
+    def simple_config(cls, backend: Backend, **kwargs: Any) -> "Config":
+        return cls(backend, **kwargs)
+
+
+class SnapshotJournal:
+    """Append-only journal of (connector_name, seq, key, row, diff)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def path_for(self, name: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in name)
+        return os.path.join(self.root, f"{safe}.snapshot")
+
+    def load(self, name: str) -> list[tuple[int, tuple, int]]:
+        p = self.path_for(name)
+        out: list[tuple[int, tuple, int]] = []
+        if not os.path.exists(p):
+            return out
+        with open(p, "rb") as f:
+            while True:
+                try:
+                    out.append(pickle.load(f))  # noqa: S301
+                except EOFError:
+                    break
+        return out
+
+    def appender(self, name: str) -> Any:
+        return open(self.path_for(name), "ab")
+
+
+def attach_persistence(session: Any, config: Config) -> None:
+    """Wire input-snapshot journaling + replay into a lowering session."""
+    if config.backend.kind != "filesystem" or not config.backend.path:
+        return
+    journal = SnapshotJournal(config.backend.path)
+
+    from pathway_tpu.engine.runtime import Connector
+
+    class PersistentConnector(Connector):
+        def __init__(self, inner: Connector, name: str):
+            super().__init__(name, inner.session)
+            self.inner = inner
+            self.replayed = journal.load(name)
+            self.n_replayed = len(self.replayed)
+            self.skip = self.n_replayed  # offset-seek: skip already-seen events
+            self._appender = journal.appender(name)
+            self._replay_done = False
+            self._seen = 0
+
+        def start(self) -> None:
+            self.inner.start()
+
+        def poll(self) -> list:
+            out = []
+            if not self._replay_done:
+                self._replay_done = True
+                for (kv, row, diff) in self.replayed:
+                    out.append((Key(kv), row, diff))
+            live = self.inner.poll()
+            for (key, row, diff) in live:
+                self._seen += 1
+                if self._seen <= self.skip:
+                    continue  # replayed from snapshot already
+                pickle.dump((key.value, row, diff), self._appender)
+                out.append((key, row, diff))
+            if live:
+                self._appender.flush()
+            return out
+
+        @property
+        def done(self) -> bool:
+            return self.inner.done
+
+    session.connectors = [
+        PersistentConnector(c, c.name) for c in session.connectors
+    ]
+
+
+__all__ = ["Backend", "Config", "attach_persistence", "SnapshotJournal"]

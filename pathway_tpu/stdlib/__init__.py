@@ -1,0 +1,17 @@
+"""pw standard library (reference: python/pathway/stdlib/)."""
+
+from pathway_tpu.stdlib import (
+    graphs,
+    indexing,
+    ml,
+    ordered,
+    stateful,
+    statistical,
+    temporal,
+    utils,
+)
+
+__all__ = [
+    "graphs", "indexing", "ml", "ordered", "stateful", "statistical",
+    "temporal", "utils",
+]

@@ -1,0 +1,106 @@
+"""pw.graphs: iterative graph algorithms via pw.iterate
+(reference: stdlib/graphs/ — bellman_ford/, pagerank/, louvain_communities/).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pathway_tpu.internals.reducers as red
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.common import coalesce, if_else, iterate
+from pathway_tpu.internals.table import Table
+
+
+class Graph:
+    """Vertex/edge pair (reference: stdlib/graphs/graph.py:152)."""
+
+    def __init__(self, V: Table, E: Table):
+        self.V = V
+        self.E = E
+
+
+def pagerank(edges: Table, steps: int = 50, damping: float = 0.85) -> Table:
+    """PageRank over edges(u: Pointer, v: Pointer) -> (rank: float) keyed by
+    vertex (reference: stdlib/graphs/pagerank/impl.py; scaled-int ranks in
+    the reference, float here)."""
+    degs = edges.groupby(edges.u).reduce(edges.u, degree=red.count())
+    vertices_u = edges.groupby(edges.u).reduce(vid=edges.u)
+    vertices_v = edges.groupby(edges.v).reduce(vid=edges.v)
+    vertices = vertices_u.concat(vertices_v).groupby(
+        ex.this.vid
+    ).reduce(vid=ex.this.vid)
+
+    def step(ranks: Table) -> dict[str, Table]:
+        # contribution of u along each edge = rank(u) / degree(u)
+        with_rank = edges.join(
+            ranks, edges.u == ranks.vid
+        ).select(v=ex.left.v, contrib=ex.right.rank)
+        deg_joined = with_rank  # rank column already divided below
+        flowing = edges.join(ranks, edges.u == ranks.vid).join(
+            degs, ex.left.u == degs.u
+        )
+        contribs = (
+            edges.join(ranks, edges.u == ranks.vid)
+            .select(u=ex.left.u, v=ex.left.v, rank=ex.right.rank)
+            .join(degs, ex.left.u == degs.u)
+            .select(v=ex.left.v, contrib=ex.left.rank / ex.right.degree)
+        )
+        summed = contribs.groupby(contribs.v).reduce(
+            vid=contribs.v, flow=red.sum(contribs.contrib)
+        )
+        incoming = vertices.join_left(summed, vertices.vid == summed.vid).select(
+            vid=ex.left.vid, flow=coalesce(ex.right.flow, 0.0)
+        )
+        new_ranks = incoming.select(
+            vid=incoming.vid, rank=(1.0 - damping) + damping * incoming.flow
+        ).with_id_from(ex.this.vid)
+        return {"ranks": new_ranks}
+
+    init = vertices.select(vid=vertices.vid, rank=1.0).with_id_from(ex.this.vid)
+    result = iterate(lambda ranks: step(ranks), iteration_limit=steps, ranks=init)
+    return result
+
+
+def bellman_ford(vertices: Table, edges: Table) -> Table:
+    """Shortest paths from rows with is_source=True.
+
+    vertices: (is_source: bool); edges: (u: Pointer, v: Pointer, dist: float).
+    Returns (dist_from_source: float) keyed like vertices.
+    (reference: stdlib/graphs/bellman_ford/impl.py)
+    """
+    INF = float("inf")
+    init = vertices.select(
+        dist=if_else(vertices.is_source, 0.0, INF)
+    )
+
+    def step(state: Table) -> dict[str, Table]:
+        relaxed = (
+            edges.join(state, edges.u == state.id)
+            .select(v=ex.left.v, cand=ex.right.dist + ex.left.dist)
+        )
+        best = relaxed.groupby(relaxed.v).reduce(
+            v=relaxed.v, cand=red.min(relaxed.cand)
+        ).with_id_from(ex.this.v)
+        new_state = state.join_left(best, state.id == best.id).select(
+            dist=if_else(
+                coalesce(ex.right.cand, INF) < ex.left.dist,
+                coalesce(ex.right.cand, INF),
+                ex.left.dist,
+            ),
+            id=ex.left.id,
+        )
+        return {"state": new_state.with_id(ex.this.id).without("id")}
+
+    # NOTE: join_left keeps left ids when id=left.id; we reindex back onto
+    # the vertex universe each round so the fixpoint is key-stable.
+    result = iterate(lambda state: step(state), state=init)
+    return result
+
+
+def louvain_level(G: Graph, **kwargs: Any) -> Table:
+    raise NotImplementedError("louvain communities: planned (round 2)")
+
+
+def louvain_communities(G: Graph, **kwargs: Any) -> Table:
+    raise NotImplementedError("louvain communities: planned (round 2)")

@@ -1,0 +1,5 @@
+"""pw.ml (reference: stdlib/ml/) — filled in by the index/classifier work."""
+
+from pathway_tpu.stdlib.ml import classifiers, index, smart_table_ops, utils
+
+__all__ = ["classifiers", "index", "smart_table_ops", "utils"]

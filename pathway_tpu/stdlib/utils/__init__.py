@@ -1,0 +1,5 @@
+from pathway_tpu.stdlib.utils import col
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
+from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer
+
+__all__ = ["col", "AsyncTransformer", "pandas_transformer"]

@@ -1,0 +1,114 @@
+"""AsyncTransformer: fully-decoupled async row->row processing.
+
+Reference: stdlib/utils/async_transformer.py:282 — results loop back through
+a Python connector, arriving at fresh engine timestamps so slow async work
+doesn't backpressure the upstream dataflow.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+from typing import Any
+
+from pathway_tpu.engine.runtime import Connector, InputSession, _get_async_loop
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals import universe as univ
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import OpSpec, Table
+
+
+class AsyncTransformer:
+    """Subclass and implement `async def invoke(self, **kwargs) -> dict`.
+
+    `output_schema` declares the result columns. `.successful` is the
+    result table (keyed by the input row's key).
+    """
+
+    output_schema: Any = None
+
+    def __init__(self, input_table: Table, *, instance: Any = None, **kwargs: Any):
+        assert self.output_schema is not None, "set output_schema"
+        self._input_table = input_table
+        self._queue: queue.Queue = queue.Queue()
+        self._finished = threading.Event()
+        names = list(self.output_schema.__columns__)
+        in_names = input_table._column_names()
+
+        def on_change(key: Any, row: tuple, time: int, is_addition: bool) -> None:
+            if is_addition:
+                self._queue.put((key, dict(zip(in_names, row))))
+
+        def on_end() -> None:
+            self._queue.put(None)
+
+        G.add_sink("subscribe", input_table, on_change=on_change, on_end=on_end)
+
+        transformer = self
+
+        class _ResultConnector(Connector):
+            def __init__(self, name: str, session: InputSession):
+                super().__init__(name, session)
+                self._worker: threading.Thread | None = None
+                self._inflight = 0
+                self._lock = threading.Lock()
+                self._upstream_done = False
+
+            def start(self) -> None:
+                loop = _get_async_loop()
+
+                def run() -> None:
+                    pending: set = set()
+                    while True:
+                        item = transformer._queue.get()
+                        if item is None:
+                            break
+                        key, row_dict = item
+
+                        async def invoke_one(k=key, rd=row_dict) -> None:
+                            try:
+                                result = await transformer.invoke(**rd)
+                                out_row = tuple(result.get(n) for n in names)
+                                self.session.insert(k, out_row)
+                            except Exception:  # noqa: BLE001
+                                pass
+
+                        fut = asyncio.run_coroutine_threadsafe(invoke_one(), loop)
+                        pending.add(fut)
+                        pending = {f for f in pending if not f.done()}
+                    for f in pending:
+                        try:
+                            f.result(timeout=60)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    self.finished.set()
+
+                self._worker = threading.Thread(target=run, daemon=True)
+                self._worker.start()
+
+        def factory(session: InputSession) -> Connector:
+            return _ResultConnector("async-transformer", session)
+
+        spec = OpSpec("connector", [], factory=factory, upsert=True)
+        self._result = Table(spec, self.output_schema, univ.Universe())
+
+    async def invoke(self, **kwargs: Any) -> dict:
+        raise NotImplementedError
+
+    def open(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    @property
+    def successful(self) -> Table:
+        return self._result
+
+    @property
+    def output_table(self) -> Table:
+        return self._result
+
+    def with_options(self, **kwargs: Any) -> "AsyncTransformer":
+        return self

@@ -1,0 +1,53 @@
+"""Column utilities (reference: stdlib/utils/col.py:367 unpack_col etc.)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pathway_tpu.internals.expression as ex
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.table import Table
+
+
+def unpack_col(
+    column: ex.ColumnReference, *unpacked_columns: Any, schema: Any = None
+) -> Table:
+    """Unpack a tuple column into separate columns."""
+    table: Table = column.table
+    if schema is not None:
+        names = list(schema.__columns__)
+    else:
+        names = [
+            c.name if isinstance(c, ex.ColumnReference) else str(c)
+            for c in unpacked_columns
+        ]
+    kwargs = {name: column[i] for i, name in enumerate(names)}
+    return table.select(**kwargs)
+
+
+def flatten_column(column: ex.ColumnReference, origin_id: str = "origin_id") -> Table:
+    table: Table = column.table
+    flat = table.flatten(column)
+    return flat
+
+
+def multiapply_all_rows(*args: Any, **kwargs: Any) -> Any:
+    raise NotImplementedError("multiapply_all_rows is not yet implemented")
+
+
+def apply_all_rows(*args: Any, **kwargs: Any) -> Any:
+    raise NotImplementedError("apply_all_rows is not yet implemented")
+
+
+def groupby_reduce_majority(column: ex.ColumnReference, value_column: ex.ColumnReference) -> Table:
+    import pathway_tpu.internals.reducers as red
+
+    table: Table = column.table
+    counted = table.groupby(column, value_column).reduce(
+        column, value_column, cnt=red.count()
+    )
+    return counted.groupby(counted[column.name]).reduce(
+        counted[column.name],
+        majority=red.argmax(counted["cnt"]),
+    )

@@ -1,0 +1,4 @@
+"""pw.xpacks — extension packs (llm)."""
+from pathway_tpu.xpacks import llm
+
+__all__ = ["llm"]

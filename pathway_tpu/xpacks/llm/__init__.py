@@ -1,0 +1,1 @@
+"""LLM xpack — populated with the RAG stack."""

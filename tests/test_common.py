@@ -1,0 +1,346 @@
+"""Core Table ops (reference pattern: python/pathway/tests/test_common.py)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+    run_capture,
+)
+
+
+def test_select_arithmetic():
+    t = T(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    res = t.select(s=t.a + t.b, d=pw.this.b - pw.this.a, p=t.a * t.b)
+    expected = T(
+        """
+        s | d | p
+        3 | 1 | 2
+        7 | 1 | 12
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_select_keeps_keys():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    res = t.select(b=t.a * 10)
+    both = t.select(a=t.a, b=res.b)  # same-universe cross-table select
+    expected = T(
+        """
+        a | b
+        1 | 10
+        2 | 20
+        """
+    )
+    assert_table_equality_wo_index(both, expected)
+
+
+def test_filter():
+    t = T(
+        """
+        a
+        1
+        2
+        3
+        4
+        """
+    )
+    res = t.filter(t.a % 2 == 0)
+    assert_table_equality_wo_index(res, T("a\n2\n4"))
+
+
+def test_groupby_reduce_count_sum():
+    t = T(
+        """
+        k | v
+        a | 1
+        b | 2
+        a | 3
+        b | 4
+        a | 5
+        """
+    )
+    res = t.groupby(t.k).reduce(
+        t.k, cnt=pw.reducers.count(), total=pw.reducers.sum(t.v)
+    )
+    expected = T(
+        """
+        k | cnt | total
+        a | 3   | 9
+        b | 2   | 6
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_groupby_min_max_avg():
+    t = T(
+        """
+        k | v
+        a | 1.0
+        a | 3.0
+        b | 5.0
+        """
+    )
+    res = t.groupby(t.k).reduce(
+        t.k,
+        mn=pw.reducers.min(t.v),
+        mx=pw.reducers.max(t.v),
+        av=pw.reducers.avg(t.v),
+    )
+    expected = T(
+        """
+        k | mn  | mx  | av
+        a | 1.0 | 3.0 | 2.0
+        b | 5.0 | 5.0 | 5.0
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_global_reduce():
+    t = T("v\n1\n2\n3")
+    res = t.reduce(total=pw.reducers.sum(t.v), n=pw.reducers.count())
+    cap = run_capture(res)
+    rows = list(cap.state.rows.values())
+    assert rows == [(6, 3)]
+
+
+def test_join_inner():
+    left = T(
+        """
+        k | a
+        1 | x
+        2 | y
+        3 | z
+        """
+    )
+    right = T(
+        """
+        k | b
+        1 | u
+        2 | v
+        4 | w
+        """
+    )
+    res = left.join(right, left.k == right.k).select(
+        k=pw.left.k, a=pw.left.a, b=pw.right.b
+    )
+    expected = T(
+        """
+        k | a | b
+        1 | x | u
+        2 | y | v
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_join_left_outer():
+    left = T("k | a\n1 | x\n2 | y")
+    right = T("k | b\n1 | u")
+    res = left.join_left(right, left.k == right.k).select(
+        k=pw.left.k, b=pw.right.b
+    )
+    expected = T(
+        """
+        k | b
+        1 | u
+        2 | None
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_concat_and_update_rows():
+    t1 = T("a | b\n1 | x\n2 | y", id_from=["a"])
+    t2 = T("a | b\n2 | z\n3 | w", id_from=["a"])
+    up = t1.update_rows(t2)
+    expected = T("a | b\n1 | x\n2 | z\n3 | w", id_from=["a"])
+    assert_table_equality(up, expected)
+
+
+def test_update_cells():
+    t1 = T("a | b\n1 | x\n2 | y", id_from=["a"])
+    t2 = T("a | b\n2 | z", id_from=["a"])
+    res = t1.update_cells(t2)
+    expected = T("a | b\n1 | x\n2 | z", id_from=["a"])
+    assert_table_equality(res, expected)
+
+
+def test_intersect_difference():
+    t1 = T("a\n1\n2\n3", id_from=["a"])
+    t2 = T("a\n2\n3\n4", id_from=["a"])
+    assert_table_equality_wo_index(t1.intersect(t2), T("a\n2\n3"))
+    assert_table_equality_wo_index(t1.difference(t2), T("a\n1"))
+
+
+def test_flatten():
+    t = T("w\nabc\nde")
+    res = t.flatten(t.w)
+    expected = T("w\na\nb\nc\nd\ne")
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_with_id_from_and_ix():
+    t = T(
+        """
+        name | v
+        x    | 1
+        y    | 2
+        """
+    ).with_id_from(pw.this.name)
+    queries = T("q\nx\ny\nx")
+    looked = t.ix(t.pointer_from(queries.q), context=queries)
+    res = queries.select(q=queries.q, v=looked.v)
+    expected = T("q | v\nx | 1\ny | 2\nx | 1")
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_apply_and_udf():
+    t = T("a\n1\n2")
+
+    @pw.udf
+    def double(x: int) -> int:
+        return 2 * x
+
+    res = t.select(b=double(t.a), c=pw.apply(lambda x: x + 100, t.a))
+    expected = T("b | c\n2 | 101\n4 | 102")
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_async_udf():
+    t = T("a\n1\n2\n3")
+
+    @pw.udf
+    async def slow_double(x: int) -> int:
+        import asyncio
+
+        await asyncio.sleep(0.001)
+        return 2 * x
+
+    res = t.select(b=slow_double(t.a))
+    expected = T("b\n2\n4\n6")
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_ifelse_coalesce():
+    t = T(
+        """
+        a    | b
+        1    | 10
+        None | 20
+        """
+    )
+    res = t.select(
+        c=pw.coalesce(t.a, 0),
+        d=pw.if_else(t.b > 15, 1, 2),
+    )
+    expected = T("c | d\n1 | 2\n0 | 1")
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_deduplicate():
+    t = T(
+        """
+        v | __time__
+        1 | 2
+        2 | 4
+        1 | 6
+        5 | 8
+        """
+    )
+    res = t.deduplicate(value=t.v, acceptor=lambda new, old: new > old)
+    cap = run_capture(res)
+    assert sorted(r[0] for r in cap.state.rows.values()) == [5]
+
+
+def test_groupby_streaming_updates():
+    t = T(
+        """
+        k | v | __time__
+        a | 1 | 2
+        a | 2 | 4
+        b | 3 | 4
+        a | 4 | 6
+        """
+    )
+    res = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+    cap = run_capture(res)
+    state = sorted(tuple(r) for r in cap.state.rows.values())
+    assert state == [("a", 7), ("b", 3)]
+    # stream must contain intermediate retraction of (a, 3)
+    assert any(r == ("a", 3) and d == -1 for (_, _, r, d) in cap.stream)
+
+
+def test_wordcount():
+    words = T(
+        """
+        word
+        foo
+        bar
+        foo
+        baz
+        foo
+        bar
+        """
+    )
+    counts = words.groupby(words.word).reduce(
+        words.word, count=pw.reducers.count()
+    )
+    expected = T(
+        """
+        word | count
+        foo  | 3
+        bar  | 2
+        baz  | 1
+        """
+    )
+    assert_table_equality_wo_index(counts, expected)
+
+
+def test_iterate_collatz():
+    def collatz_step(t):
+        return {
+            "t": t.select(
+                a=pw.if_else(
+                    t.a == 1, 1,
+                    pw.if_else(t.a % 2 == 0, t.a // 2, 3 * t.a + 1),
+                )
+            )
+        }
+
+    start = T("a\n3\n5\n7")
+    res = pw.iterate(collatz_step, t=start)
+    cap = run_capture(res)
+    assert all(r == (1,) for r in cap.state.rows.values())
+
+
+def test_sort_prev_next():
+    t = T("v\n30\n10\n20")
+    s = t.sort(key=t.v)
+    joined = t.select(v=t.v, has_prev=s.prev.is_not_none(), has_next=s.next.is_not_none())
+    expected = T(
+        """
+        v  | has_prev | has_next
+        10 | False    | True
+        20 | True     | True
+        30 | True     | False
+        """
+    )
+    assert_table_equality_wo_index(joined, expected)

@@ -1,0 +1,63 @@
+"""Test utilities mirroring the reference tier-2 pattern
+(reference: python/pathway/tests/utils.py — T :531,
+assert_table_equality :471, DiffEntry/assert_key_entries_in_stream_consistent
+:120-246)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pathway_tpu as pw
+from pathway_tpu.engine.core import CaptureNode, freeze_row
+from pathway_tpu.internals.lowering import Session
+
+
+def T(txt: str, **kwargs: Any) -> pw.Table:
+    return pw.debug.table_from_markdown(txt, **kwargs)
+
+
+def run_capture(table: pw.Table) -> CaptureNode:
+    session = Session()
+    cap = session.capture(table)
+    session.execute()
+    return cap
+
+
+def _state_of(table: pw.Table) -> dict:
+    cap = run_capture(table)
+    return {k.value: freeze_row(row) for k, row in cap.state.rows.items()}
+
+
+def assert_table_equality(t1: pw.Table, t2: pw.Table) -> None:
+    """Key-sensitive equality of final states."""
+    s1, s2 = _state_of(t1), _state_of(t2)
+    assert s1 == s2, f"tables differ:\n  left={s1}\n  right={s2}"
+
+
+def assert_table_equality_wo_index(t1: pw.Table, t2: pw.Table) -> None:
+    s1 = sorted(_state_of(t1).values())
+    s2 = sorted(_state_of(t2).values())
+    assert s1 == s2, f"tables differ (ignoring ids):\n  left={s1}\n  right={s2}"
+
+
+def assert_table_equality_wo_index_types(t1: pw.Table, t2: pw.Table) -> None:
+    assert_table_equality_wo_index(t1, t2)
+
+
+def assert_stream_consistent(table: pw.Table) -> list:
+    """Checks per-key diff sequences are sane (no negative accumulation);
+    returns the stream."""
+    cap = run_capture(table)
+    counts: dict[tuple, int] = {}
+    for (t, key, row, diff) in cap.stream:
+        token = (key.value, freeze_row(row))
+        counts[token] = counts.get(token, 0) + diff
+        assert counts[token] >= 0, f"negative multiplicity for {token}"
+    for token, c in counts.items():
+        assert c in (0, 1), f"final multiplicity {c} for {token}"
+    return cap.stream
+
+
+def stream_of(table: pw.Table) -> list[tuple[int, int, tuple, int]]:
+    cap = run_capture(table)
+    return [(t, k.value, freeze_row(r), d) for (t, k, r, d) in cap.stream]
