@@ -1,0 +1,468 @@
+"""TPU-native transformer: embedder (bi-directional + mean pool) and causal LM.
+
+This is the flagship compute model of the framework — the engine behind the
+local `JaxEmbedder` / reranker / on-TPU generation in `xpacks.llm`, replacing
+the reference's torch `SentenceTransformerEmbedder`
+(`/root/reference/python/pathway/xpacks/llm/embedders.py:270`) and
+`HFPipelineChat` (`llms.py:441`) with batched XLA programs.
+
+Design notes (TPU-first):
+- Params are a plain pytree of `jnp` arrays; every leaf has a PartitionSpec
+  in `param_specs()` implementing Megatron-style tensor parallelism over the
+  mesh's `model` axis (attention heads + ffn hidden sharded), data
+  parallelism over `data` (batch sharded), with XLA inserting the
+  all-reduces at the row-parallel projections.
+- Forward is pure + jit-friendly: static shapes, no Python branching on
+  data; attention uses one fused einsum per projection so the MXU sees
+  [B*S, D] x [D, D'] matmuls in bf16 with f32 accumulation.
+- `remat` wraps each block for the train step: activations are
+  rematerialized in backward, trading MXU flops for HBM — the standard
+  memory lever on TPU.
+- The causal decode path keeps a KV cache laid out [layers, B, S, H, Dh]
+  sharded on heads, so generation is also tensor-parallel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Array = jax.Array
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_len: int = 512
+    causal: bool = False  # False: bi-directional encoder; True: decoder LM
+    pool: str = "mean"  # encoder pooling: mean | cls | last
+    dtype: Any = jnp.bfloat16
+    embed_dim: int | None = None  # projection head dim (None = d_model)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def __post_init__(self) -> None:
+        if self.pool not in ("mean", "cls", "last"):
+            raise ValueError(f"pool must be mean|cls|last, got {self.pool!r}")
+        if self.d_model % self.n_heads != 0:
+            raise ValueError("d_model must be divisible by n_heads")
+
+
+def embedder_config(**kw) -> TransformerConfig:
+    """SBERT-class text encoder."""
+    return TransformerConfig(causal=False, **kw)
+
+
+def lm_config(**kw) -> TransformerConfig:
+    """Gemma-class causal decoder."""
+    kw.setdefault("pool", "last")
+    return TransformerConfig(causal=True, **kw)
+
+
+# ------------------------------------------------------------------ params
+
+
+def _init_block(rng: Array, cfg: TransformerConfig) -> Params:
+    d, f = cfg.d_model, cfg.d_ff
+    ks = jax.random.split(rng, 6)
+    s = 1.0 / math.sqrt(d)
+    return {
+        "qkv": jax.random.normal(ks[0], (d, 3 * d), jnp.float32) * s,
+        "o": jax.random.normal(ks[1], (d, d), jnp.float32) * s,
+        "ff_in": jax.random.normal(ks[2], (d, f), jnp.float32) * s,
+        "ff_out": jax.random.normal(ks[3], (f, d), jnp.float32) * (1.0 / math.sqrt(f)),
+        "ln1_scale": jnp.ones((d,), jnp.float32),
+        "ln2_scale": jnp.ones((d,), jnp.float32),
+    }
+
+
+def init_params(rng: Array, cfg: TransformerConfig) -> Params:
+    ks = jax.random.split(rng, cfg.n_layers + 3)
+    e = cfg.embed_dim or cfg.d_model
+    params: Params = {
+        "tok_embed": jax.random.normal(
+            ks[0], (cfg.vocab_size, cfg.d_model), jnp.float32
+        )
+        * 0.02,
+        "pos_embed": jax.random.normal(ks[1], (cfg.max_len, cfg.d_model), jnp.float32)
+        * 0.02,
+        "ln_f_scale": jnp.ones((cfg.d_model,), jnp.float32),
+        "head": jax.random.normal(ks[2], (cfg.d_model, e), jnp.float32)
+        * (1.0 / math.sqrt(cfg.d_model)),
+        "blocks": [_init_block(ks[3 + i], cfg) for i in range(cfg.n_layers)],
+    }
+    return params
+
+
+def param_specs(cfg: TransformerConfig) -> Params:
+    """PartitionSpecs: tensor-parallel over the `model` mesh axis.
+
+    qkv/ff_in are column-parallel (output dim sharded); o/ff_out are
+    row-parallel (input dim sharded) so XLA places one psum per block half.
+    Embeddings shard the vocab/feature dim; norms are replicated.
+    """
+    block = {
+        "qkv": P(None, "model"),
+        "o": P("model", None),
+        "ff_in": P(None, "model"),
+        "ff_out": P("model", None),
+        "ln1_scale": P(None),
+        "ln2_scale": P(None),
+    }
+    return {
+        "tok_embed": P("model", None),
+        "pos_embed": P(None, None),
+        "ln_f_scale": P(None),
+        "head": P(None, "model"),
+        "blocks": [dict(block) for _ in range(cfg.n_layers)],
+    }
+
+
+def shard_params(params: Params, mesh: Mesh, cfg: TransformerConfig) -> Params:
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def count_params(params: Params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+# ----------------------------------------------------------------- forward
+
+
+def _rmsnorm(x: Array, scale: Array) -> Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
+
+
+def _attention(x: Array, block: Params, cfg: TransformerConfig, mask: Array) -> Array:
+    # Layout-stable attention: q/k/v stay [b, s, h, dh] and the head axis is
+    # contracted via einsum directly — no transposes to break XLA fusion.
+    b, s, d = x.shape
+    h, dh = cfg.n_heads, cfg.head_dim
+    qkv = jnp.einsum(
+        "bsd,de->bse", x, block["qkv"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(cfg.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, dh)
+    k = k.reshape(b, s, h, dh)
+    v = v.reshape(b, s, h, dh)
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(dh)
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    ctx = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
+    ).astype(cfg.dtype).reshape(b, s, d)
+    return jnp.einsum(
+        "bsd,de->bse", ctx, block["o"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(cfg.dtype)
+
+
+def _ffn(x: Array, block: Params, cfg: TransformerConfig) -> Array:
+    hline = jnp.einsum(
+        "bsd,df->bsf", x, block["ff_in"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    hline = jax.nn.gelu(hline).astype(cfg.dtype)
+    return jnp.einsum(
+        "bsf,fd->bsd", hline, block["ff_out"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(cfg.dtype)
+
+
+def _block_fwd(x: Array, block: Params, cfg: TransformerConfig, mask: Array) -> Array:
+    x = x + _attention(_rmsnorm(x, block["ln1_scale"]), block, cfg, mask)
+    x = x + _ffn(_rmsnorm(x, block["ln2_scale"]), block, cfg)
+    return x
+
+
+def _build_mask(token_mask: Array, causal: bool) -> Array:
+    # token_mask: [b, s] 1/0 valid; returns [b, 1, q, k] bool
+    b, s = token_mask.shape
+    attend = token_mask[:, None, None, :].astype(bool)
+    if causal:
+        tri = jnp.tril(jnp.ones((s, s), bool))
+        attend = attend & tri[None, None, :, :]
+    return attend
+
+
+def forward(
+    params: Params, token_ids: Array, token_mask: Array, cfg: TransformerConfig
+) -> Array:
+    """Hidden states [b, s, d_model]."""
+    b, s = token_ids.shape
+    x = params["tok_embed"].astype(cfg.dtype)[token_ids]
+    x = x + params["pos_embed"].astype(cfg.dtype)[None, :s, :]
+    mask = _build_mask(token_mask, cfg.causal)
+    blk = functools.partial(_block_fwd, cfg=cfg, mask=mask)
+    for block in params["blocks"]:
+        x = jax.checkpoint(blk)(x, block)
+    return _rmsnorm(x, params["ln_f_scale"])
+
+
+def encode(
+    params: Params, token_ids: Array, token_mask: Array, cfg: TransformerConfig
+) -> Array:
+    """Pooled, L2-normalized embeddings [b, embed_dim] (f32)."""
+    h = forward(params, token_ids, token_mask, cfg)
+    if cfg.pool == "mean":
+        # bf16 mask-and-sum (HBM-bound step); divide in f32 for accuracy
+        m16 = token_mask.astype(cfg.dtype)[:, :, None]
+        pooled = jnp.sum(h * m16, axis=1).astype(jnp.float32) / jnp.maximum(
+            jnp.sum(token_mask, axis=1)[:, None].astype(jnp.float32), 1.0
+        )
+    elif cfg.pool == "cls":
+        pooled = h[:, 0, :].astype(jnp.float32)
+    else:  # last valid token
+        idx = jnp.maximum(jnp.sum(token_mask, axis=1) - 1, 0).astype(jnp.int32)
+        pooled = h[jnp.arange(h.shape[0]), idx, :].astype(jnp.float32)
+    from pathway_tpu.ops.distances import normalize
+
+    return normalize(pooled @ params["head"].astype(jnp.float32))
+
+
+def logits(
+    params: Params, token_ids: Array, token_mask: Array, cfg: TransformerConfig
+) -> Array:
+    """LM logits [b, s, vocab] via tied embedding."""
+    h = forward(params, token_ids, token_mask, cfg)
+    return jnp.einsum(
+        "bsd,vd->bsv", h, params["tok_embed"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+# ------------------------------------------------------------- train step
+
+
+def lm_loss(
+    params: Params, token_ids: Array, token_mask: Array, cfg: TransformerConfig
+) -> Array:
+    """Next-token cross-entropy. Requires a causal config: with bidirectional
+    attention the target token is visible to its own position and the loss
+    degenerates to copying."""
+    if not cfg.causal:
+        raise ValueError("lm_loss requires causal=True (use lm_config)")
+    lg = logits(params, token_ids, token_mask, cfg)
+    targets = jnp.roll(token_ids, -1, axis=1)
+    valid = token_mask.astype(jnp.float32)
+    valid = valid * jnp.roll(valid, -1, axis=1)
+    valid = valid.at[:, -1].set(0.0)
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[:, :, None], axis=-1)[:, :, 0]
+    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+def make_train_step(cfg: TransformerConfig, learning_rate: float = 1e-3):
+    """Returns (init_opt_state, train_step). AdamW via optax."""
+    import optax
+
+    tx = optax.adamw(learning_rate, weight_decay=0.01)
+
+    def init_opt(params: Params):
+        return tx.init(params)
+
+    def train_step(params: Params, opt_state, token_ids: Array, token_mask: Array):
+        loss, grads = jax.value_and_grad(lm_loss)(params, token_ids, token_mask, cfg)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init_opt, train_step
+
+
+# ---------------------------------------------------------------- decoding
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int) -> Params:
+    shape = (cfg.n_layers, batch, cfg.max_len, cfg.n_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def decode_step(
+    params: Params,
+    cache: Params,
+    token: Array,  # [b] current token ids
+    pos: Array,  # scalar int32 position
+    cfg: TransformerConfig,
+) -> tuple[Array, Params]:
+    """One autoregressive step with KV cache; returns ([b, vocab], cache)."""
+    b = token.shape[0]
+    h, dh = cfg.n_heads, cfg.head_dim
+    x = params["tok_embed"].astype(cfg.dtype)[token][:, None, :]  # [b,1,d]
+    x = x + jax.lax.dynamic_slice_in_dim(
+        params["pos_embed"].astype(cfg.dtype), pos, 1, axis=0
+    )[None]
+    mask_len = cfg.max_len
+    kmask = (jnp.arange(mask_len) <= pos)[None, None, None, :]
+    for li, block in enumerate(params["blocks"]):
+        xin = _rmsnorm(x, block["ln1_scale"])
+        qkv = jnp.einsum(
+            "bsd,de->bse", xin, block["qkv"].astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, 1, h, dh)
+        k = k.reshape(b, 1, h, dh)
+        v = v.reshape(b, 1, h, dh)
+        cache["k"] = jax.lax.dynamic_update_slice(
+            cache["k"], k[None], (li, 0, pos, 0, 0)
+        )
+        cache["v"] = jax.lax.dynamic_update_slice(
+            cache["v"], v[None], (li, 0, pos, 0, 0)
+        )
+        keys, vals = cache["k"][li], cache["v"][li]  # [b, S, h, dh]
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, keys, preferred_element_type=jnp.float32
+        ) / math.sqrt(dh)
+        scores = jnp.where(kmask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        ctx = jnp.einsum(
+            "bhqk,bkhd->bqhd", probs, vals, preferred_element_type=jnp.float32
+        ).astype(cfg.dtype).reshape(b, 1, cfg.d_model)
+        attn_out = jnp.einsum(
+            "bsd,de->bse", ctx, block["o"].astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+        x = x + attn_out
+        x = x + _ffn(_rmsnorm(x, block["ln2_scale"]), block, cfg)
+    hline = _rmsnorm(x, params["ln_f_scale"])
+    lg = jnp.einsum(
+        "bsd,vd->bsv", hline, params["tok_embed"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return lg[:, 0, :], cache
+
+
+def prefill(
+    params: Params, prompt_ids: Array, cache: Params, cfg: TransformerConfig
+) -> tuple[Array, Params]:
+    """One batched causal forward over the whole prompt, writing every
+    layer's K/V into the cache. Returns (last-position logits [b, vocab],
+    cache). This is ONE XLA program over [b, p] — prefill cost does not
+    serialize over prompt length the way per-token decode would.
+    """
+    b, p = prompt_ids.shape
+    h, dh = cfg.n_heads, cfg.head_dim
+    x = params["tok_embed"].astype(cfg.dtype)[prompt_ids]
+    x = x + params["pos_embed"].astype(cfg.dtype)[None, :p, :]
+    mask = _build_mask(jnp.ones((b, p), jnp.int32), causal=True)
+    for li, block in enumerate(params["blocks"]):
+        xin = _rmsnorm(x, block["ln1_scale"])
+        qkv = jnp.einsum(
+            "bsd,de->bse", xin, block["qkv"].astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, p, h, dh)
+        k = k.reshape(b, p, h, dh)
+        v = v.reshape(b, p, h, dh)
+        cache["k"] = jax.lax.dynamic_update_slice(
+            cache["k"], k[None], (li, 0, 0, 0, 0)
+        )
+        cache["v"] = jax.lax.dynamic_update_slice(
+            cache["v"], v[None], (li, 0, 0, 0, 0)
+        )
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) / math.sqrt(dh)
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        ctx = jnp.einsum(
+            "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
+        ).astype(cfg.dtype).reshape(b, p, cfg.d_model)
+        attn_out = jnp.einsum(
+            "bsd,de->bse", ctx, block["o"].astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+        x = x + attn_out
+        x = x + _ffn(_rmsnorm(x, block["ln2_scale"]), block, cfg)
+    hlast = _rmsnorm(x[:, -1:, :], params["ln_f_scale"])
+    lg = jnp.einsum(
+        "bsd,vd->bsv", hlast, params["tok_embed"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return lg[:, 0, :], cache
+
+
+def generate(
+    params: Params,
+    prompt_ids: Array,  # [b, p]
+    n_steps: int,
+    cfg: TransformerConfig,
+    temperature: float = 0.0,
+    rng: Array | None = None,
+) -> Array:
+    """Batched prefill + `lax.scan` decode. Returns [b, p + n_steps]."""
+    b, p = prompt_ids.shape
+    if p + n_steps > cfg.max_len:
+        raise ValueError(
+            f"prompt ({p}) + n_steps ({n_steps}) exceeds max_len ({cfg.max_len})"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampled generation (temperature > 0) requires rng")
+    cache = init_kv_cache(cfg, b)
+    first_logits, cache = prefill(params, prompt_ids, cache, cfg)
+
+    def pick(lg: Array, key):
+        if temperature > 0.0:
+            key, sub = jax.random.split(key)
+            return jax.random.categorical(sub, lg / temperature).astype(jnp.int32), key
+        return jnp.argmax(lg, -1).astype(jnp.int32), key
+
+    key = rng
+    first_tok, key = pick(first_logits, key)
+
+    def body(carry, i):
+        cache, tok, key = carry
+        lg, cache = decode_step(params, cache, tok, p + i, cfg)
+        nxt, key = pick(lg, key)
+        # emit the token being consumed this step; the carry holds the next
+        return (cache, nxt, key), tok
+
+    (_, last_tok, _), toks = jax.lax.scan(
+        body, (cache, first_tok, key), jnp.arange(n_steps)
+    )
+    return jnp.concatenate([prompt_ids, toks.T], axis=1)
+
+
+class TransformerLM:
+    """Convenience OO wrapper over the functional model."""
+
+    def __init__(self, cfg: TransformerConfig, rng_seed: int = 0):
+        self.cfg = cfg
+        self.params = init_params(jax.random.PRNGKey(rng_seed), cfg)
+        self._encode = jax.jit(functools.partial(encode, cfg=cfg))
+        self._logits = jax.jit(functools.partial(logits, cfg=cfg))
+
+    def encode(self, token_ids: Array, token_mask: Array) -> Array:
+        return self._encode(self.params, token_ids, token_mask)
+
+    def logits(self, token_ids: Array, token_mask: Array) -> Array:
+        return self._logits(self.params, token_ids, token_mask)
+
+    def shard(self, mesh: Mesh) -> None:
+        self.params = shard_params(self.params, mesh, self.cfg)

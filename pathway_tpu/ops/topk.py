@@ -1,0 +1,152 @@
+"""Fused distance + top-k retrieval kernels, single-chip and mesh-sharded.
+
+Reference parity: this replaces the external CPU indexes the reference links
+in (`/root/reference/src/external_integration/usearch_integration.rs` HNSW,
+`brute_force_knn_integration.rs:22` exact search). On TPU the exact search IS
+the fast path: a [q,d]x[d,n] bf16 matmul hits the MXU at full tilt, and
+`lax.top_k` over the score row is bandwidth-bound on HBM — no pointer-chasing
+graph traversal to serialize.
+
+Sharded design (the 1M-doc north star): docs are sharded over the mesh's
+`data` axis, queries are replicated; each shard computes its local top-k and
+an `all_gather` over ICI merges k*n_shards candidates, re-top-k'd locally.
+That keeps the per-chip HBM traffic at docs/n_shards and the ICI payload at
+O(q * k * shards), tiny next to the matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pathway_tpu.ops.distances import metric_fn
+
+Array = jax.Array
+
+
+class TopKResult(NamedTuple):
+    indices: Array  # [q, k] int32 — indices into the doc matrix
+    distances: Array  # [q, k] f32 — metric distances (smaller = closer)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric", "normalized", "approx"))
+def knn_search(
+    queries: Array,
+    docs: Array,
+    k: int,
+    metric: str = "cos",
+    *,
+    normalized: bool = False,
+    approx: bool = False,
+) -> TopKResult:
+    """k-NN on one device: fused distance grid + top-k.
+
+    `normalized=True` skips per-call L2 normalization for cosine (store docs
+    pre-normalized — this is the serving fast path; re-normalizing 1M docs
+    per query costs more than the search). `approx=True` uses the
+    TPU-optimized `approx_min_k` (same recall regime as the reference's HNSW
+    default, `usearch_integration.rs:20`).
+    """
+    if metric in ("cos", "cosine", "dot"):
+        # similarity form: top-k runs directly on the matmul output and the
+        # distance conversion touches only the k winners, not all n docs
+        from pathway_tpu.ops.distances import dot_products, normalize
+
+        q = normalize(queries.astype(jnp.float32)) if metric != "dot" else queries
+        d_mat = docs if (normalized or metric == "dot") else normalize(
+            docs.astype(jnp.float32)
+        )
+        sims = dot_products(q, d_mat)
+        if approx:
+            s, idx = jax.lax.approx_max_k(sims, k)
+        else:
+            s, idx = jax.lax.top_k(sims, k)
+        d = (1.0 - s) if metric != "dot" else -s
+        return TopKResult(indices=idx.astype(jnp.int32), distances=d)
+    dists = metric_fn(metric)(queries, docs)
+    if approx:
+        d, idx = jax.lax.approx_min_k(dists, k)
+    else:
+        neg, idx = jax.lax.top_k(-dists, k)
+        d = -neg
+    return TopKResult(indices=idx.astype(jnp.int32), distances=d)
+
+
+def knn_search_masked(
+    queries: Array, docs: Array, valid: Array, k: int, metric: str = "cos"
+) -> TopKResult:
+    """Exact k-NN with a validity mask over doc slots (for tombstoned rows)."""
+    dists = metric_fn(metric)(queries, docs)
+    dists = jnp.where(valid[None, :], dists, jnp.inf)
+    neg, idx = jax.lax.top_k(-dists, k)
+    return TopKResult(indices=idx.astype(jnp.int32), distances=-neg)
+
+
+knn_search_masked = jax.jit(knn_search_masked, static_argnames=("k", "metric"))
+
+
+def knn_search_sharded(
+    queries: Array,
+    docs: Array,
+    k: int,
+    metric: str = "cos",
+    mesh: Mesh | None = None,
+    axis: str = "data",
+) -> TopKResult:
+    """Exact k-NN with docs sharded over `axis` of `mesh`.
+
+    Per-shard top-k then cross-shard merge. Returns global doc indices
+    (row offsets into the unsharded doc matrix).
+    """
+    if mesh is None:
+        return knn_search(queries, docs, k, metric)
+    n_shards = mesh.shape[axis]
+    n_docs = docs.shape[0]
+    if n_docs % n_shards != 0:
+        raise ValueError(f"doc count {n_docs} not divisible by {n_shards} shards")
+    shard_rows = n_docs // n_shards
+    dist = metric_fn(metric)
+    kk = min(k, shard_rows)
+
+    def local(q, d_shard):
+        # d_shard: [n/s, d]; local top-k, then gather candidates across shards
+        dists = dist(q, d_shard)
+        neg, idx = jax.lax.top_k(-dists, kk)
+        shard_id = jax.lax.axis_index(axis)
+        global_idx = idx.astype(jnp.int32) + shard_id * shard_rows
+        # [shards, q, kk] on every shard after the gather
+        all_neg = jax.lax.all_gather(neg, axis)
+        all_idx = jax.lax.all_gather(global_idx, axis)
+        q_n = q.shape[0]
+        cand_neg = jnp.transpose(all_neg, (1, 0, 2)).reshape(q_n, n_shards * kk)
+        cand_idx = jnp.transpose(all_idx, (1, 0, 2)).reshape(q_n, n_shards * kk)
+        mneg, midx = jax.lax.top_k(cand_neg, min(k, n_shards * kk))
+        merged_idx = jnp.take_along_axis(cand_idx, midx, axis=1)
+        return merged_idx, -mneg
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(axis, None)),
+        out_specs=(P(), P()),
+        # after the all_gather every shard holds identical merged candidates,
+        # which the varying-axes inference cannot prove
+        check_vma=False,
+    )
+    idx, dists = jax.jit(fn)(queries, docs)
+    return TopKResult(indices=idx, distances=dists)
+
+
+def make_knn_searcher(
+    k: int, metric: str = "cos", mesh: Mesh | None = None, axis: str = "data"
+) -> Callable[[Array, Array], TopKResult]:
+    """Pre-configured searcher closure (stable jit cache across calls)."""
+
+    def search(queries: Array, docs: Array) -> TopKResult:
+        return knn_search_sharded(queries, docs, k, metric, mesh, axis)
+
+    return search

@@ -1,0 +1,203 @@
+"""Tests for the TPU numeric plane (ops/) and flagship models (models/).
+
+Runs on the virtual 8-device CPU mesh (tests/conftest.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu.ops import (
+    cosine_distances,
+    knn_search,
+    knn_search_sharded,
+    l2_distances,
+    normalize,
+    segment_reduce,
+)
+
+
+def test_cosine_matches_numpy():
+    rng = np.random.default_rng(0)
+    q = rng.normal(size=(4, 16)).astype(np.float32)
+    d = rng.normal(size=(32, 16)).astype(np.float32)
+    got = np.asarray(cosine_distances(jnp.asarray(q), jnp.asarray(d)))
+    qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+    dn = d / np.linalg.norm(d, axis=1, keepdims=True)
+    want = 1.0 - qn @ dn.T
+    np.testing.assert_allclose(got, want, atol=2e-2)  # bf16 matmul tolerance
+
+
+def test_l2_matches_numpy():
+    rng = np.random.default_rng(1)
+    q = rng.normal(size=(3, 8)).astype(np.float32)
+    d = rng.normal(size=(10, 8)).astype(np.float32)
+    got = np.asarray(l2_distances(jnp.asarray(q), jnp.asarray(d)))
+    want = ((q[:, None, :] - d[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(got, want, atol=0.1)
+
+
+def test_knn_search_exact():
+    rng = np.random.default_rng(2)
+    d = rng.normal(size=(100, 12)).astype(np.float32)
+    q = d[[5, 17, 42]] + 1e-4  # queries near known docs
+    res = knn_search(jnp.asarray(q), jnp.asarray(d), k=1, metric="l2")
+    assert list(np.asarray(res.indices)[:, 0]) == [5, 17, 42]
+
+
+def test_knn_search_normalized_cos():
+    rng = np.random.default_rng(3)
+    d = rng.normal(size=(50, 8)).astype(np.float32)
+    dn = d / np.linalg.norm(d, axis=1, keepdims=True)
+    q = d[[7, 9]]
+    r1 = knn_search(jnp.asarray(q), jnp.asarray(d), k=3, metric="cos")
+    r2 = knn_search(jnp.asarray(q), jnp.asarray(dn), k=3, metric="cos", normalized=True)
+    np.testing.assert_array_equal(np.asarray(r1.indices), np.asarray(r2.indices))
+    assert np.asarray(r1.indices)[0, 0] == 7
+    assert np.asarray(r1.indices)[1, 0] == 9
+
+
+def test_knn_sharded_matches_single():
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    mesh = Mesh(np.asarray(devs).reshape(len(devs)), ("data",))
+    rng = np.random.default_rng(4)
+    d = rng.normal(size=(8 * 16, 12)).astype(np.float32)
+    q = rng.normal(size=(5, 12)).astype(np.float32)
+    single = knn_search(jnp.asarray(q), jnp.asarray(d), k=4, metric="cos")
+    sharded = knn_search_sharded(jnp.asarray(q), jnp.asarray(d), k=4, metric="cos", mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(single.indices), np.asarray(sharded.indices))
+
+
+def test_segment_reduce_ops():
+    vals = jnp.asarray([1.0, 2.0, 3.0, 4.0, 5.0])
+    segs = jnp.asarray([0, 0, 1, 1, 1])
+    np.testing.assert_allclose(np.asarray(segment_reduce(vals, segs, 2, "sum")), [3.0, 12.0])
+    np.testing.assert_allclose(np.asarray(segment_reduce(vals, segs, 2, "mean")), [1.5, 4.0])
+    np.testing.assert_allclose(np.asarray(segment_reduce(vals, segs, 2, "min")), [1.0, 3.0])
+    np.testing.assert_allclose(np.asarray(segment_reduce(vals, segs, 2, "max")), [2.0, 5.0])
+    np.testing.assert_allclose(np.asarray(segment_reduce(vals, segs, 2, "count")), [2, 3])
+
+
+def test_normalize_unit_rows():
+    x = jnp.asarray(np.random.default_rng(5).normal(size=(6, 9)).astype(np.float32))
+    n = np.linalg.norm(np.asarray(normalize(x)), axis=1)
+    np.testing.assert_allclose(n, np.ones(6), atol=1e-5)
+
+
+# ----------------------------------------------------------------- models
+
+
+def test_encoder_shapes_and_determinism():
+    from pathway_tpu.models import TransformerLM, embedder_config
+
+    cfg = embedder_config(vocab_size=128, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=16)
+    model = TransformerLM(cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(2, 128, (3, 16)), jnp.int32)
+    mask = jnp.ones((3, 16), jnp.int32)
+    e1 = model.encode(ids, mask)
+    e2 = model.encode(ids, mask)
+    assert e1.shape == (3, 32)
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2))
+    norms = np.linalg.norm(np.asarray(e1), axis=1)
+    np.testing.assert_allclose(norms, np.ones(3), atol=1e-5)
+
+
+def test_encoder_mask_ignores_padding():
+    from pathway_tpu.models import TransformerLM, embedder_config
+
+    cfg = embedder_config(vocab_size=128, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=16)
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(1)
+    base = rng.integers(2, 128, (1, 16)).astype(np.int32)
+    mask = np.ones((1, 16), np.int32)
+    mask[0, 8:] = 0
+    garbage = base.copy()
+    garbage[0, 8:] = rng.integers(2, 128, 8)
+    e1 = model.encode(jnp.asarray(base), jnp.asarray(mask))
+    e2 = model.encode(jnp.asarray(garbage), jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2), atol=1e-5)
+
+
+def test_train_step_reduces_loss():
+    from pathway_tpu.models import transformer as tfm
+
+    cfg = tfm.lm_config(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=12)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    init_opt, train_step = tfm.make_train_step(cfg, learning_rate=1e-2)
+    opt_state = init_opt(params)
+    step = jax.jit(train_step)
+    ids = jnp.asarray(np.random.default_rng(0).integers(2, 64, (4, 12)), jnp.int32)
+    mask = jnp.ones((4, 12), jnp.int32)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, ids, mask)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_generate_matches_full_forward_greedy():
+    from pathway_tpu.models import transformer as tfm
+
+    cfg = tfm.lm_config(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=24)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[1, 5, 9, 13]], jnp.int32)
+    n_steps = 6
+    out = tfm.generate(params, prompt, n_steps=n_steps, cfg=cfg)
+    assert out.shape == (1, 10)
+    np.testing.assert_array_equal(np.asarray(out[:, :4]), np.asarray(prompt))
+    # reference: greedy decode by re-running the full causal forward each
+    # step, at fixed padded shape so XLA compiles once
+    import functools
+
+    total = 4 + n_steps
+    lgf = jax.jit(functools.partial(tfm.logits, cfg=cfg))
+    seq = np.zeros((1, total), np.int32)
+    seq[:, :4] = np.asarray(prompt)
+    for cur in range(4, total):
+        mask = (np.arange(total) < cur).astype(np.int32)[None]
+        lg = lgf(params, jnp.asarray(seq), jnp.asarray(mask))
+        seq[0, cur] = int(jnp.argmax(lg[0, cur - 1]))
+    np.testing.assert_array_equal(np.asarray(out), seq)
+
+
+def test_generate_guards():
+    from pathway_tpu.models import transformer as tfm
+
+    cfg = tfm.lm_config(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=8)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[1, 5, 9, 13]], jnp.int32)
+    with pytest.raises(ValueError, match="max_len"):
+        tfm.generate(params, prompt, n_steps=30, cfg=cfg)
+    with pytest.raises(ValueError, match="rng"):
+        tfm.generate(params, prompt, n_steps=2, cfg=cfg, temperature=0.5)
+    with pytest.raises(ValueError, match="causal"):
+        tfm.lm_loss(params, prompt, jnp.ones_like(prompt),
+                    tfm.embedder_config(vocab_size=64, d_model=32, n_heads=4,
+                                        n_layers=2, d_ff=64, max_len=8))
+    with pytest.raises(ValueError, match="pool"):
+        tfm.TransformerConfig(pool="menu")
+
+
+def test_hash_tokenizer():
+    from pathway_tpu.models.tokenizer import HashTokenizer
+
+    tok = HashTokenizer(vocab_size=1024, max_len=8)
+    ids, mask = tok.batch(["hello world", "hello"])
+    assert ids.shape == mask.shape
+    assert ids[0, 0] == 1  # cls
+    assert mask[1].sum() == 2
+    # deterministic
+    ids2, _ = tok.batch(["hello world", "hello"])
+    np.testing.assert_array_equal(ids, ids2)
+
+
+def test_param_sharding_specs_cover_params():
+    from pathway_tpu.models import transformer as tfm
+
+    cfg = tfm.embedder_config(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=8)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    specs = tfm.param_specs(cfg)
+    jax.tree.map(lambda p, s: None, params, specs)  # same treedef or raises
