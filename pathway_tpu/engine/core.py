@@ -1176,3 +1176,217 @@ class GradualBroadcastNode(Node):
             if diff < 0 and key in self.emitted and self.big_state.get(key) is None:
                 out.append((key, (self.emitted.pop(key),), -1))
         self.emit(time, consolidate(out))
+
+
+class ExternalIndexNode(Node):
+    """Feed index-table diffs into a mutable host/device index; answer query
+    rows with top-k matches, optionally augmented with data-table columns.
+
+    Reference parity: UseExternalIndexAsOfNow
+    (src/engine/dataflow/operators/external_index.rs:38,
+    src/engine/dataflow.rs:2224) generalized with a non-as-of-now mode
+    (answers update when the index changes) and built-in result repacking
+    (the reference does repacking in Python via flatten+ix,
+    stdlib/indexing/data_index.py:294).
+
+    Inputs: [index_table, query_table] (+ [data_table] unless mode='reply').
+    Modes:
+      'reply'    -> (reply,) where reply = ((doc_key, score), ...)
+      'collapse' -> query_row + (data_col_tuple, ...) + (scores, ids)
+      'flat'     -> one row per match: query_row + data_row + (score, id)
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        inputs: Sequence[Node],
+        host_index: Any,
+        index_fn: Callable[[Key, tuple], tuple],  # -> (data, metadata | None)
+        query_fn: Callable[[Key, tuple], tuple],  # -> (qdata, k, filter | None)
+        mode: str = "reply",
+        asof_now: bool = True,
+        data_width: int = 0,
+    ):
+        super().__init__(graph, inputs)
+        self.host_index = host_index
+        self.index_fn = index_fn
+        self.query_fn = query_fn
+        self.mode = mode
+        self.asof_now = asof_now
+        self.data_width = data_width
+        self.query_state = KeyedState()
+        self.data_state = KeyedState()
+        self.indexed: dict[Key, Any] = {}  # doc key -> data fed to the index
+        # emitted: qkey -> list[(out_key, out_row)]
+        self.emitted: dict[Key, list[tuple[Key, tuple]]] = {}
+        # raw matches memo: qkey -> [(doc_key, score)] — lets data-only waves
+        # re-pack rows without re-running the search
+        self.matches: dict[Key, list] = {}
+
+    def _search_many(
+        self, queries: list[tuple[Key, tuple]]
+    ) -> dict[Key, list] | None:
+        """Run a wave's searches in ONE batched index call (the TPU index
+        fuses the whole batch into a single matmul+top-k program).
+
+        Returns qkey -> [(doc_key, score)] with [] for unanswerable queries,
+        or None when the whole batched search failed (callers must then keep
+        previously emitted answers instead of dropping them).
+        """
+        results: dict[Key, list] = {}
+        prepared: list[tuple[Key, tuple]] = []
+        for qkey, qrow in queries:
+            try:
+                qdata, k, flt = self.query_fn(qkey, qrow)
+            except Exception as e:  # noqa: BLE001
+                self.graph.log_error(f"index query: {type(e).__name__}: {e}")
+                results[qkey] = []
+                continue
+            if isinstance(qdata, ErrorValue) or qdata is None:
+                results[qkey] = []
+                continue
+            prepared.append((qkey, (qdata, int(k), flt)))
+        if not prepared:
+            return results
+        try:
+            if hasattr(self.host_index, "search_batch"):
+                all_matches = self.host_index.search_batch(
+                    [item for _k, item in prepared]
+                )
+            else:
+                all_matches = [
+                    self.host_index.search(q, k, f) for _key, (q, k, f) in prepared
+                ]
+        except Exception as e:  # noqa: BLE001
+            self.graph.log_error(f"index search: {type(e).__name__}: {e}")
+            return None
+        for (qkey, _item), matches in zip(prepared, all_matches):
+            results[qkey] = matches
+        return results
+
+    def _repack(
+        self, qkey: Key, qrow: tuple, matches: list
+    ) -> list[tuple[Key, tuple]]:
+        if self.mode == "reply":
+            reply = tuple((dk, float(s)) for dk, s in matches)
+            return [(qkey, (reply,))]
+        data_rows = []
+        for dk, s in matches:
+            drow = self.data_state.get(dk)
+            if drow is None:
+                drow = (None,) * self.data_width
+            data_rows.append((dk, float(s), drow))
+        if self.mode == "collapse":
+            cols = tuple(
+                tuple(dr[i] for (_dk, _s, dr) in data_rows)
+                for i in range(self.data_width)
+            )
+            scores = tuple(s for (_dk, s, _dr) in data_rows)
+            ids = tuple(dk for (dk, _s, _dr) in data_rows)
+            return [(qkey, qrow + cols + (scores, ids))]
+        # flat
+        out = []
+        for rank, (dk, s, drow) in enumerate(data_rows):
+            out.append(
+                (Key(hash_values(qkey, rank)), qrow + drow + (s, dk))
+            )
+        return out
+
+    def finish_time(self, time: int) -> None:
+        idx_batch = self.take_input(0)
+        q_batch = self.take_input(1)
+        d_batch = self.take_input(2) if len(self.inputs) > 2 else []
+        if not idx_batch and not q_batch and not d_batch:
+            return
+        # Apply index mutations: removals before additions so a same-wave
+        # (-old, +new) update nets to the new value, and a retraction only
+        # evicts when it matches what is actually indexed (KeyedState-style
+        # equality guard — an unordered (+new, -old) pair must not delete
+        # the fresh document).
+        index_changed = False
+        idx_batch = consolidate(idx_batch)
+        for phase in (0, 1):  # 0: removals, 1: additions
+            for key, row, diff in idx_batch:
+                if (diff < 0) != (phase == 0):
+                    continue
+                try:
+                    data, meta = self.index_fn(key, row)
+                except Exception as e:  # noqa: BLE001
+                    self.graph.log_error(f"index row: {type(e).__name__}: {e}")
+                    continue
+                try:
+                    if diff > 0:
+                        self.host_index.add(key, data, meta)
+                        self.indexed[key] = data
+                        index_changed = True
+                    elif key in self.indexed and freeze_value(
+                        self.indexed[key]
+                    ) == freeze_value(data):
+                        self.host_index.remove(key)
+                        del self.indexed[key]
+                        index_changed = True
+                except Exception as e:  # noqa: BLE001
+                    self.graph.log_error(f"index update: {type(e).__name__}: {e}")
+        if d_batch:
+            self.data_state.update(d_batch)
+        out: list[Entry] = []
+
+        def retract(qkey: Key) -> None:
+            for okey, orow in self.emitted.pop(qkey, []):
+                out.append((okey, orow, -1))
+
+        # group the query batch per key so an update (-old, +new) in one
+        # wave retracts once and answers once, regardless of entry order
+        q_batch = consolidate(q_batch)
+        self.query_state.update(q_batch)
+        changed_queries: dict[Key, None] = {k: None for k, _r, _d in q_batch}
+        repack_only: list[Key] = []
+        if not self.asof_now and (index_changed or d_batch):
+            for qkey in self.query_state.rows:
+                if qkey in changed_queries:
+                    continue
+                if index_changed or qkey not in self.matches:
+                    changed_queries[qkey] = None
+                else:
+                    # data-table-only change: the match set is intact, only
+                    # the attached rows need re-packing — skip the search
+                    repack_only.append(qkey)
+        to_search = [
+            (qkey, qrow)
+            for qkey in changed_queries
+            if (qrow := self.query_state.get(qkey)) is not None
+        ]
+        searched = self._search_many(to_search)
+        if searched is None:
+            # batched search failed: keep existing answers for live queries,
+            # only retract queries that were themselves removed
+            for qkey in changed_queries:
+                if self.query_state.get(qkey) is None:
+                    retract(qkey)
+                    self.matches.pop(qkey, None)
+            searched = {}
+        else:
+            for qkey in changed_queries:
+                retract(qkey)
+                self.matches.pop(qkey, None)
+        for qkey, matches in searched.items():
+            qrow = self.query_state.get(qkey)
+            if qrow is None:
+                continue
+            self.matches[qkey] = matches
+            results = self._repack(qkey, qrow, matches)
+            if results:
+                self.emitted[qkey] = results
+            for okey, orow in results:
+                out.append((okey, orow, 1))
+        for qkey in repack_only:
+            qrow = self.query_state.get(qkey)
+            if qrow is None:
+                continue
+            retract(qkey)
+            results = self._repack(qkey, qrow, self.matches[qkey])
+            if results:
+                self.emitted[qkey] = results
+            for okey, orow in results:
+                out.append((okey, orow, 1))
+        self.emit(time, consolidate(out))

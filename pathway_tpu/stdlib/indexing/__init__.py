@@ -1,1 +1,79 @@
-"""Placeholder — implemented with the index layer."""
+"""pw.indexing — the index layer: KNN / BM25 / hybrid retrieval + sorting.
+
+Reference parity: python/pathway/stdlib/indexing/__init__.py. The vector
+backends are TPU-native (HBM-resident bf16 slab + fused matmul/top-k XLA
+programs) instead of usearch/tantivy CPU libraries; see host_indexes.py.
+"""
+
+from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25, TantivyBM25Factory
+from pathway_tpu.stdlib.indexing.colnames import (
+    _INDEX_REPLY,
+    _INDEX_REPLY_ID,
+    _INDEX_REPLY_SCORE,
+    _MATCHED_ID,
+    _SCORE,
+)
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.full_text_document_index import (
+    default_full_text_document_index,
+)
+from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex, HybridIndexFactory
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    BruteForceKnnFactory,
+    BruteForceKnnMetricKind,
+    LshKnn,
+    LshKnnFactory,
+    USearchMetricKind,
+    UsearchKnn,
+    UsearchKnnFactory,
+)
+from pathway_tpu.stdlib.indexing.retrievers import (
+    InnerIndex,
+    InnerIndexFactory,
+    build_index_query,
+)
+from pathway_tpu.stdlib.indexing.sorting import (
+    build_sorted_index,
+    retrieve_prev_next_values,
+    sort_from_index,
+)
+from pathway_tpu.stdlib.indexing.vector_document_index import (
+    VectorDocumentIndex,
+    default_brute_force_knn_document_index,
+    default_lsh_knn_document_index,
+    default_usearch_knn_document_index,
+    default_vector_document_index,
+)
+
+# reference-compat alias (reference class is named USearchKnn)
+USearchKnn = UsearchKnn
+
+__all__ = [
+    "DataIndex",
+    "InnerIndex",
+    "InnerIndexFactory",
+    "BruteForceKnn",
+    "BruteForceKnnFactory",
+    "BruteForceKnnMetricKind",
+    "UsearchKnn",
+    "USearchKnn",
+    "UsearchKnnFactory",
+    "USearchMetricKind",
+    "LshKnn",
+    "LshKnnFactory",
+    "TantivyBM25",
+    "TantivyBM25Factory",
+    "HybridIndex",
+    "HybridIndexFactory",
+    "VectorDocumentIndex",
+    "default_vector_document_index",
+    "default_brute_force_knn_document_index",
+    "default_usearch_knn_document_index",
+    "default_lsh_knn_document_index",
+    "default_full_text_document_index",
+    "build_index_query",
+    "build_sorted_index",
+    "sort_from_index",
+    "retrieve_prev_next_values",
+]

@@ -1,0 +1,105 @@
+"""Hybrid retrieval — reciprocal-rank fusion of several retrievers.
+
+Reference parity: stdlib/indexing/hybrid_index.py `HybridIndex` (:14) +
+`HybridIndexFactory`: each retriever ranks the query; a doc's fused score is
+sum over retrievers of 1/(k + rank), higher = better, negated into the
+uniform smaller-is-better convention. The reference fuses in Python dataflow
+(flatten + groupby over reply tuples); here fusion happens inside one hybrid
+host index so the whole thing stays a single engine operator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    MakeTupleExpression,
+)
+from pathway_tpu.internals.keys import Key
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.retrievers import InnerIndex, InnerIndexFactory
+
+
+class _HybridHostIndex:
+    """Fans add/remove/search out to sub-indexes and fuses rankings.
+
+    `add` receives a tuple with one data payload per sub-index (their data
+    columns may differ — e.g. embeddings + raw text); `search` passes the
+    same query payload to every sub-index, like the reference.
+    """
+
+    def __init__(self, subs: list[Any], rrf_k: float, per_sub_factor: int = 2):
+        self.subs = subs
+        self.rrf_k = rrf_k
+        self.per_sub_factor = per_sub_factor
+
+    def add(self, key: Key, data: Any, metadata: Any = None) -> None:
+        for sub, payload in zip(self.subs, data):
+            sub.add(key, payload, metadata)
+
+    def remove(self, key: Key) -> None:
+        for sub in self.subs:
+            sub.remove(key)
+
+    def search(self, query: Any, k: int, metadata_filter: str | None = None):
+        scores: dict[Key, float] = {}
+        fetch = max(k * self.per_sub_factor, k)
+        for sub in self.subs:
+            for rank, (key, _score) in enumerate(
+                sub.search(query, fetch, metadata_filter)
+            ):
+                scores[key] = scores.get(key, 0.0) + 1.0 / (self.rrf_k + rank + 1)
+        ranked = sorted(scores.items(), key=lambda kv: -kv[1])[:k]
+        return [(key, -s) for key, s in ranked]
+
+
+@dataclass(frozen=True)
+class HybridIndex(InnerIndex):
+    """RRF fusion index. All retrievers must index the same table (the data
+    payloads are zipped row-wise into the engine)."""
+
+    retrievers: tuple[InnerIndex, ...] = ()
+    k: float = 60.0  # the RRF constant
+
+    def __init__(self, retrievers: list[InnerIndex], k: float = 60.0):
+        if len(retrievers) < 2:
+            raise ValueError("HybridIndex requires at least two retrievers")
+        first = retrievers[0]
+        tables = {id(r._data_table()) for r in retrievers}
+        if len(tables) != 1:
+            raise ValueError("all HybridIndex retrievers must index one table")
+        object.__setattr__(self, "data_column", first.data_column)
+        object.__setattr__(self, "metadata_column", first.metadata_column)
+        object.__setattr__(self, "retrievers", tuple(retrievers))
+        object.__setattr__(self, "k", k)
+
+    def _data_table(self) -> Table:
+        return self.retrievers[0]._data_table()
+
+    def _data_expr(self) -> ColumnExpression:
+        return MakeTupleExpression(*[r._data_expr() for r in self.retrievers])
+
+    def _host_index_factory(self) -> Callable:
+        factories = [r._host_index_factory() for r in self.retrievers]
+        rrf_k = self.k
+        return lambda: _HybridHostIndex([f() for f in factories], rrf_k)
+
+
+@dataclass(frozen=True)
+class HybridIndexFactory(InnerIndexFactory):
+    retriever_factories: list[InnerIndexFactory] = field(default_factory=list)
+    k: float = 60.0
+
+    def build_inner_index(
+        self,
+        data_column: ColumnReference,
+        metadata_column: ColumnExpression | None = None,
+    ) -> HybridIndex:
+        retrievers = [
+            f.build_inner_index(data_column, metadata_column)
+            for f in self.retriever_factories
+        ]
+        return HybridIndex(retrievers, k=self.k)

@@ -1,0 +1,124 @@
+"""Preset builders for vector document indexes.
+
+Reference parity: stdlib/indexing/vector_document_index.py —
+`default_vector_document_index` plus the deprecated `VectorDocumentIndex`
+alias, and the per-backend variants.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any
+
+from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    LshKnn,
+    UsearchKnn,
+)
+
+
+def _embedded_column(
+    data_column: ColumnReference, data_table: Table, embedder: Any
+) -> tuple[ColumnReference, Table]:
+    if embedder is None:
+        return data_column, data_table
+    enriched = data_table.with_columns(_pw_embedding=embedder(data_column))
+    return enriched._pw_embedding, enriched
+
+
+def default_vector_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    dimensions: int,
+    embedder: Any | None = None,
+    metadata_column: ColumnExpression | None = None,
+) -> DataIndex:
+    """The default: exact KNN on the HBM vector slab (the TPU fast path)."""
+    return default_brute_force_knn_document_index(
+        data_column,
+        data_table,
+        dimensions=dimensions,
+        embedder=embedder,
+        metadata_column=metadata_column,
+    )
+
+
+def default_brute_force_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    dimensions: int,
+    embedder: Any | None = None,
+    metadata_column: ColumnExpression | None = None,
+    metric: str = "cos",
+) -> DataIndex:
+    col, table = _embedded_column(data_column, data_table, embedder)
+    inner = BruteForceKnn(
+        data_column=col,
+        metadata_column=metadata_column,
+        dimensions=dimensions,
+        metric=metric,
+    )
+    return DataIndex(data_table=table, inner_index=inner)
+
+
+def default_usearch_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    dimensions: int,
+    embedder: Any | None = None,
+    metadata_column: ColumnExpression | None = None,
+    metric: str = "cos",
+) -> DataIndex:
+    col, table = _embedded_column(data_column, data_table, embedder)
+    inner = UsearchKnn(
+        data_column=col,
+        metadata_column=metadata_column,
+        dimensions=dimensions,
+        metric=metric,
+    )
+    return DataIndex(data_table=table, inner_index=inner)
+
+
+def default_lsh_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    dimensions: int,
+    embedder: Any | None = None,
+    metadata_column: ColumnExpression | None = None,
+) -> DataIndex:
+    col, table = _embedded_column(data_column, data_table, embedder)
+    inner = LshKnn(
+        data_column=col,
+        metadata_column=metadata_column,
+        dimensions=dimensions,
+    )
+    return DataIndex(data_table=table, inner_index=inner)
+
+
+def VectorDocumentIndex(  # noqa: N802 — reference-compat alias
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    dimensions: int,
+    embedder: Any | None = None,
+    metadata_column: ColumnExpression | None = None,
+) -> DataIndex:
+    warnings.warn(
+        "VectorDocumentIndex is deprecated; use default_vector_document_index",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    return default_vector_document_index(
+        data_column,
+        data_table,
+        dimensions=dimensions,
+        embedder=embedder,
+        metadata_column=metadata_column,
+    )

@@ -1,0 +1,284 @@
+"""Tests for stdlib.indexing: KNN / BM25 / hybrid / filters / DataIndex."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing import (
+    BruteForceKnn,
+    BruteForceKnnFactory,
+    DataIndex,
+    HybridIndex,
+    LshKnn,
+    TantivyBM25,
+    TantivyBM25Factory,
+    UsearchKnn,
+)
+
+
+def _docs():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(vec=object, text=str),
+        [
+            ((1.0, 0.0), "the x axis"),
+            ((0.0, 1.0), "the y axis"),
+            ((0.7, 0.7), "the diagonal"),
+        ],
+    )
+
+
+def _queries():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(qvec=object), [((0.9, 0.1),), ((0.1, 0.9),)]
+    )
+
+
+def test_brute_force_knn_collapse():
+    docs = _docs()
+    queries = _queries()
+    index = DataIndex(docs, BruteForceKnn(data_column=docs.vec, dimensions=2))
+    res = index.query_as_of_now(queries.qvec, number_of_matches=2)
+    df = pw.debug.table_to_pandas(res, include_id=False)
+    rows = {r.qvec: r.text for r in df.itertuples()}
+    assert rows[(0.9, 0.1)] == ("the x axis", "the diagonal")
+    assert rows[(0.1, 0.9)] == ("the y axis", "the diagonal")
+
+
+def test_brute_force_knn_flat_with_distances():
+    docs = _docs()
+    queries = _queries()
+    index = DataIndex(docs, BruteForceKnn(data_column=docs.vec, dimensions=2))
+    res = index.query_as_of_now(
+        queries.qvec, number_of_matches=2, collapse_rows=False, with_distances=True
+    )
+    df = pw.debug.table_to_pandas(res, include_id=False)
+    assert len(df) == 4  # 2 queries x 2 matches
+    assert set(df.columns) >= {"qvec", "vec", "text", "_pw_dist", "_pw_matched_id"}
+    # best match for (0.9, 0.1) is x-axis with near-zero distance
+    best = df[df.text == "the x axis"]
+    assert (best._pw_dist < 0.05).all()
+
+
+def test_usearch_knn_same_ranking():
+    docs = _docs()
+    queries = _queries()
+    index = DataIndex(docs, UsearchKnn(data_column=docs.vec, dimensions=2))
+    res = index.query_as_of_now(queries.qvec, number_of_matches=2)
+    df = pw.debug.table_to_pandas(res, include_id=False)
+    rows = {r.qvec: r.text for r in df.itertuples()}
+    assert rows[(0.9, 0.1)][0] == "the x axis"
+
+
+def test_lsh_knn_finds_close_neighbor():
+    rng = np.random.default_rng(0)
+    base = rng.normal(size=(20, 8))
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(vec=object, name=str),
+        [(tuple(map(float, base[i])), f"doc{i}") for i in range(20)],
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qvec=object),
+        [(tuple(map(float, base[7] + 0.001)),)],
+    )
+    inner = LshKnn(data_column=docs.vec, dimensions=8, n_or=8, n_and=4, bucket_length=4.0)
+    res = DataIndex(docs, inner).query_as_of_now(queries.qvec, number_of_matches=1)
+    df = pw.debug.table_to_pandas(res, include_id=False)
+    assert df.iloc[0]["name"] == ("doc7",)
+
+
+def test_bm25_ranking():
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(text=str),
+        [
+            ("the quick brown fox jumps over the lazy dog",),
+            ("a fast auburn fox leaps across",),
+            ("completely unrelated text about databases",),
+        ],
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(q=str), [("quick fox",), ("databases",)]
+    )
+    index = DataIndex(docs, TantivyBM25(data_column=docs.text))
+    res = index.query_as_of_now(queries.q, number_of_matches=1)
+    df = pw.debug.table_to_pandas(res, include_id=False)
+    rows = {r.q: r.text for r in df.itertuples()}
+    assert rows["quick fox"][0].startswith("the quick brown")
+    assert rows["databases"][0].endswith("databases")
+
+
+def test_hybrid_index_rrf():
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(vec=object, text=str),
+        [
+            ((1.0, 0.0), "alpha beta"),
+            ((0.0, 1.0), "gamma delta"),
+            ((0.7, 0.7), "alpha delta"),
+        ],
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(q=object), [((0.95, 0.05),)]
+    )
+    hybrid = HybridIndex(
+        [
+            BruteForceKnn(data_column=docs.vec, dimensions=2),
+            BruteForceKnn(data_column=docs.vec, dimensions=2, metric="l2sq"),
+        ]
+    )
+    res = DataIndex(docs, hybrid).query_as_of_now(queries.q, number_of_matches=2)
+    df = pw.debug.table_to_pandas(res, include_id=False)
+    assert df.iloc[0]["text"][0] == "alpha beta"
+
+
+def test_metadata_filter():
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(vec=object, text=str, meta=object),
+        [
+            ((1.0, 0.0), "a", {"owner": "alice", "path": "docs/a.txt"}),
+            ((0.99, 0.01), "b", {"owner": "bob", "path": "docs/b.txt"}),
+            ((0.98, 0.02), "c", {"owner": "alice", "path": "img/c.png"}),
+        ],
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(q=object, flt=str),
+        [
+            ((1.0, 0.0), "owner == 'bob'"),
+            ((1.0, 0.0), "globmatch('docs/*', path)"),
+        ],
+    )
+    inner = BruteForceKnn(data_column=docs.vec, metadata_column=docs.meta, dimensions=2)
+    res = DataIndex(docs, inner).query_as_of_now(
+        queries.q, number_of_matches=3, metadata_filter=queries.flt
+    )
+    df = pw.debug.table_to_pandas(res, include_id=False)
+    by_flt = {r.flt: r.text for r in df.itertuples()}
+    assert by_flt["owner == 'bob'"] == ("b",)
+    assert set(by_flt["globmatch('docs/*', path)"]) == {"a", "b"}
+
+
+def test_query_updates_with_index_changes():
+    """Non-asof query results update when better docs arrive later."""
+    docs = pw.debug.table_from_markdown(
+        """
+        vec    | __time__
+        first  | 2
+        second | 4
+        """,
+        schema=pw.schema_from_types(vec=str),
+    )
+    # encode strings as 1-d vectors via apply
+    enc = {"first": (1.0, 0.0), "second": (0.9, 0.1), "query": (0.89, 0.11)}
+    docs = docs.select(v=pw.apply(lambda s: enc[s], docs.vec), name=docs.vec)
+    queries = pw.debug.table_from_markdown(
+        """
+        q     | __time__
+        query | 2
+        """,
+        schema=pw.schema_from_types(q=str),
+    )
+    queries = queries.select(qv=pw.apply(lambda s: enc[s], queries.q))
+    index = DataIndex(docs, BruteForceKnn(data_column=docs.v, dimensions=2))
+    updating = index.query(queries.qv, number_of_matches=1)
+    frozen = index.query_as_of_now(queries.qv, number_of_matches=1)
+    df_u = pw.debug.table_to_pandas(updating, include_id=False)
+    df_f = pw.debug.table_to_pandas(frozen, include_id=False)
+    assert df_u.iloc[0]["name"] == ("second",)  # updated to the closer doc
+    assert df_f.iloc[0]["name"] == ("first",)  # frozen at time 2
+
+
+def test_knnindex_facade():
+    from pathway_tpu.stdlib.ml.index import KNNIndex
+
+    docs = _docs()
+    queries = _queries()
+    knn = KNNIndex(docs.vec, docs, n_dimensions=2, distance_type="cosine")
+    res = knn.get_nearest_items_asof_now(queries.qvec, k=1, with_distances=True)
+    df = pw.debug.table_to_pandas(res, include_id=False)
+    # reference shape: only data columns + dist, one row per query
+    assert sorted(df.columns) == ["dist", "text", "vec"]
+    assert {r.text for r in df.itertuples()} == {("the x axis",), ("the y axis",)}
+    assert all(d[0] < 0.1 for d in df.dist)
+
+
+def test_index_survives_same_wave_doc_update():
+    """A (-old, +new) doc update in one commit must not evict the doc."""
+    docs = pw.debug.table_from_markdown(
+        """
+        name | vx   | vy  | __time__ | __diff__
+        a    | 1.0  | 0.0 | 2        | 1
+        a    | 1.0  | 0.0 | 4        | -1
+        a    | 0.9  | 0.1 | 4        | 1
+        """,
+        schema=pw.schema_from_types(name=str, vx=float, vy=float),
+    )
+    docs = docs.select(docs.name, v=pw.make_tuple(docs.vx, docs.vy))
+    queries = pw.debug.table_from_markdown(
+        """
+        q | qx  | qy  | __time__
+        q | 0.9 | 0.1 | 6
+        """,
+        schema=pw.schema_from_types(q=str, qx=float, qy=float),
+    )
+    queries = queries.select(qv=pw.make_tuple(queries.qx, queries.qy))
+    index = DataIndex(docs, BruteForceKnn(data_column=docs.v, dimensions=2))
+    res = index.query_as_of_now(queries.qv, number_of_matches=1, with_distances=True)
+    df = pw.debug.table_to_pandas(res, include_id=False)
+    assert len(df) == 1
+    assert df.iloc[0]["name"] == ("a",)
+    assert df.iloc[0]["_pw_index_reply_score"][0] < 1e-3  # matched NEW vector
+
+
+def test_inner_index_reply_mode():
+    docs = _docs()
+    queries = _queries()
+    inner = BruteForceKnn(data_column=docs.vec, dimensions=2)
+    raw = inner.query_as_of_now(queries.qvec, number_of_matches=2)
+    df = pw.debug.table_to_pandas(raw, include_id=False)
+    assert list(df.columns) == ["_pw_index_reply"]
+    reply = df.iloc[0]["_pw_index_reply"]
+    assert len(reply) == 2 and isinstance(reply[0][1], float)
+
+
+def test_factories():
+    docs = _docs()
+    f = BruteForceKnnFactory(dimensions=2)
+    idx = f.build_index(docs.vec, docs)
+    assert isinstance(idx, DataIndex)
+    f2 = TantivyBM25Factory()
+    assert isinstance(f2.build_inner_index(docs.text), TantivyBM25)
+
+
+# ----------------------------------------------------------------- filters
+
+
+def test_filter_evaluator():
+    from pathway_tpu.stdlib.indexing.filters import compile_filter
+
+    f = compile_filter("owner == 'alice' && size > `100`")
+    assert f({"owner": "alice", "size": 200})
+    assert not f({"owner": "alice", "size": 50})
+    assert not f({"owner": "bob", "size": 200})
+
+    f2 = compile_filter("contains(path, 'foo') || modified_at >= `1702840800`")
+    assert f2({"path": "a/foo/b", "modified_at": 0})
+    assert f2({"path": "x", "modified_at": 1702840801})
+    assert not f2({"path": "x", "modified_at": 5})
+
+    f3 = compile_filter("globmatch('**/*.pdf', path)")
+    assert f3({"path": "a/b/c.pdf"})
+    assert f3({"path": "c.pdf"})
+    assert not f3({"path": "a/b/c.txt"})
+
+    f4 = compile_filter("!(owner == 'alice')")
+    assert f4({"owner": "bob"})
+
+    # json-string metadata is parsed
+    assert compile_filter("owner == 'a'")('{"owner": "a"}')
+
+
+def test_glob_star_does_not_cross_slash():
+    from pathway_tpu.stdlib.indexing.filters import glob_match
+
+    assert glob_match("docs/*.txt", "docs/a.txt")
+    assert not glob_match("docs/*.txt", "docs/sub/a.txt")
+    assert glob_match("docs/**/*.txt", "docs/sub/a.txt")
+    assert glob_match("*.txt", "a.txt")
