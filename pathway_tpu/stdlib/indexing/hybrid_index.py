@@ -27,8 +27,9 @@ class _HybridHostIndex:
     """Fans add/remove/search out to sub-indexes and fuses rankings.
 
     `add` receives a tuple with one data payload per sub-index (their data
-    columns may differ — e.g. embeddings + raw text); `search` passes the
-    same query payload to every sub-index, like the reference.
+    columns may differ — e.g. embeddings + raw text); `search` receives a
+    tuple with one query payload per sub-index (each retriever's own query
+    transform — embedded vector for KNNs, raw text for BM25).
     """
 
     def __init__(self, subs: list[Any], rrf_k: float, per_sub_factor: int = 2):
@@ -47,9 +48,9 @@ class _HybridHostIndex:
     def search(self, query: Any, k: int, metadata_filter: str | None = None):
         scores: dict[Key, float] = {}
         fetch = max(k * self.per_sub_factor, k)
-        for sub in self.subs:
+        for sub, payload in zip(self.subs, query):
             for rank, (key, _score) in enumerate(
-                sub.search(query, fetch, metadata_filter)
+                sub.search(payload, fetch, metadata_filter)
             ):
                 scores[key] = scores.get(key, 0.0) + 1.0 / (self.rrf_k + rank + 1)
         ranked = sorted(scores.items(), key=lambda kv: -kv[1])[:k]
@@ -68,7 +69,9 @@ class HybridIndex(InnerIndex):
         if len(retrievers) < 2:
             raise ValueError("HybridIndex requires at least two retrievers")
         first = retrievers[0]
-        tables = {id(r._data_table()) for r in retrievers}
+        # compare the USER-facing source table: embedder retrievers derive
+        # fresh embedded tables, which would never be identical
+        tables = {id(r.data_column.table) for r in retrievers}
         if len(tables) != 1:
             raise ValueError("all HybridIndex retrievers must index one table")
         object.__setattr__(self, "data_column", first.data_column)
@@ -77,10 +80,17 @@ class HybridIndex(InnerIndex):
         object.__setattr__(self, "k", k)
 
     def _data_table(self) -> Table:
-        return self.retrievers[0]._data_table()
+        return self.retrievers[0].data_column.table
 
     def _data_expr(self) -> ColumnExpression:
         return MakeTupleExpression(*[r._data_expr() for r in self.retrievers])
+
+    def _query_expr(self, query_column: ColumnExpression) -> ColumnExpression:
+        # each sub-index gets its own query transform (embedder KNNs embed,
+        # BM25 passes the raw text) — zipped with subs in _HybridHostIndex
+        return MakeTupleExpression(
+            *[r._query_expr(query_column) for r in self.retrievers]
+        )
 
     def _host_index_factory(self) -> Callable:
         factories = [r._host_index_factory() for r in self.retrievers]

@@ -15,7 +15,7 @@ does not pay for itself on this hardware (see bench.py).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable
+from typing import Any, Callable
 
 from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
 from pathway_tpu.stdlib.indexing.host_indexes import LshIndex, VectorSlabIndex
@@ -33,14 +33,49 @@ class USearchMetricKind:
     IP = "dot"
 
 
+def _calculate_embeddings(column: ColumnReference, embedder) -> ColumnReference:
+    """Attach an embedding column when an embedder UDF is configured
+    (reference: nearest_neighbors.py:52 `_calculate_embeddings`)."""
+    if embedder is None:
+        return column
+    table = column.table.with_columns(_pw_embedded_column=embedder(column))
+    return table._pw_embedded_column
+
+
+class _EmbeddingKnn(InnerIndex):
+    """Shared embed-the-query/data behavior of the vector indexes."""
+
+    embedder: Any = None
+
+    def _data_table(self):
+        return self._data_ref().table
+
+    def _data_expr(self):
+        return self._data_ref()
+
+    def _data_ref(self) -> ColumnReference:
+        # memoized: _data_table()/_data_expr() must share ONE derived table,
+        # otherwise every document is embedded once per call site and
+        # same-table identity checks (HybridIndex) break
+        cached = self.__dict__.get("_cached_data_ref")
+        if cached is None:
+            cached = _calculate_embeddings(self.data_column, self.embedder)
+            object.__setattr__(self, "_cached_data_ref", cached)
+        return cached
+
+    def _query_expr(self, query_column: ColumnReference) -> ColumnReference:
+        return _calculate_embeddings(query_column, self.embedder)
+
+
 @dataclass(frozen=True)
-class BruteForceKnn(InnerIndex):
+class BruteForceKnn(_EmbeddingKnn):
     """Exact KNN over an HBM-resident vector slab (reference: BruteForceKnn,
     stdlib/indexing/nearest_neighbors.py:170)."""
 
     dimensions: int | None = None
     reserved_space: int = 1024
     metric: str = BruteForceKnnMetricKind.COS
+    embedder: Any = None
 
     def _host_index_factory(self) -> Callable:
         dims, space, metric = self.dimensions, self.reserved_space, self.metric
@@ -50,7 +85,7 @@ class BruteForceKnn(InnerIndex):
 
 
 @dataclass(frozen=True)
-class UsearchKnn(InnerIndex):
+class UsearchKnn(_EmbeddingKnn):
     """Approximate KNN (reference: USearchKnn HNSW,
     stdlib/indexing/nearest_neighbors.py:65). On TPU "approximate" selects
     `lax.approx_max_k`; the HNSW tuning knobs are accepted for API
@@ -62,6 +97,7 @@ class UsearchKnn(InnerIndex):
     connectivity: int = 0  # unused on TPU
     expansion_add: int = 0  # unused on TPU
     expansion_search: int = 0  # unused on TPU
+    embedder: Any = None
 
     def _host_index_factory(self) -> Callable:
         dims, space, metric = self.dimensions, self.reserved_space, self.metric
@@ -71,7 +107,7 @@ class UsearchKnn(InnerIndex):
 
 
 @dataclass(frozen=True)
-class LshKnn(InnerIndex):
+class LshKnn(_EmbeddingKnn):
     """LSH-bucketed approximate KNN (reference: LshKnn,
     stdlib/indexing/nearest_neighbors.py:262 over ml/classifiers/_knn_lsh.py)."""
 
@@ -80,6 +116,7 @@ class LshKnn(InnerIndex):
     n_and: int = 8
     bucket_length: float = 2.0
     distance_type: str = "l2"
+    embedder: Any = None
 
     def _host_index_factory(self) -> Callable:
         cfg = (self.dimensions, self.n_or, self.n_and, self.bucket_length,
@@ -95,6 +132,7 @@ class BruteForceKnnFactory(InnerIndexFactory):
     dimensions: int | None = None
     reserved_space: int = 1024
     metric: str = BruteForceKnnMetricKind.COS
+    embedder: Any = None
 
     def build_inner_index(
         self,
@@ -107,6 +145,7 @@ class BruteForceKnnFactory(InnerIndexFactory):
             dimensions=self.dimensions,
             reserved_space=self.reserved_space,
             metric=self.metric,
+            embedder=self.embedder,
         )
 
 
@@ -118,6 +157,7 @@ class UsearchKnnFactory(InnerIndexFactory):
     connectivity: int = 0
     expansion_add: int = 0
     expansion_search: int = 0
+    embedder: Any = None
 
     def build_inner_index(
         self,
@@ -130,6 +170,7 @@ class UsearchKnnFactory(InnerIndexFactory):
             dimensions=self.dimensions,
             reserved_space=self.reserved_space,
             metric=self.metric,
+            embedder=self.embedder,
         )
 
 
@@ -140,6 +181,7 @@ class LshKnnFactory(InnerIndexFactory):
     n_and: int = 8
     bucket_length: float = 2.0
     distance_type: str = "l2"
+    embedder: Any = None
 
     def build_inner_index(
         self,
@@ -154,4 +196,5 @@ class LshKnnFactory(InnerIndexFactory):
             n_and=self.n_and,
             bucket_length=self.bucket_length,
             distance_type=self.distance_type,
+            embedder=self.embedder,
         )

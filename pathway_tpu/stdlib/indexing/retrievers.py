@@ -51,6 +51,11 @@ class InnerIndex(ABC):
     def _data_expr(self) -> ColumnExpression:
         return self.data_column
 
+    def _query_expr(self, query_column: ColumnReference) -> ColumnExpression:
+        """Hook: vector indexes with an embedder transform the query column
+        (reference: nearest_neighbors.py:132 `_calculate_embeddings`)."""
+        return query_column
+
     def query(
         self,
         query_column: ColumnReference,
@@ -131,11 +136,15 @@ def build_index_query(
             else wrap_arg(None),
         }
     )
+    # the rowwise lowering resolves same-universe side tables, so selecting
+    # off query_table works even when query_expr lives on a derived
+    # (embedded) table
+    query_expr = inner._query_expr(query_column)
     query_table = query_column.table
     if mode == "reply":
         q_selected = query_table.select(
             **{
-                _Q: query_column,
+                _Q: query_expr,
                 _K: wrap_arg(number_of_matches),
                 _FILTER: metadata_filter
                 if metadata_filter is not None
@@ -163,7 +172,7 @@ def build_index_query(
         q_selected = query_table.select(
             *[query_table[n] for n in q_names],
             **{
-                _Q: query_column,
+                _Q: query_expr,
                 _K: wrap_arg(number_of_matches),
                 _FILTER: metadata_filter
                 if metadata_filter is not None

@@ -2,7 +2,8 @@
 
 Reference parity: stdlib/indexing/vector_document_index.py —
 `default_vector_document_index` plus the deprecated `VectorDocumentIndex`
-alias, and the per-backend variants.
+alias, and the per-backend variants. The embedder rides on the inner index
+(`embedder=` field), which embeds both the data column and every query.
 """
 
 from __future__ import annotations
@@ -18,15 +19,6 @@ from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     LshKnn,
     UsearchKnn,
 )
-
-
-def _embedded_column(
-    data_column: ColumnReference, data_table: Table, embedder: Any
-) -> tuple[ColumnReference, Table]:
-    if embedder is None:
-        return data_column, data_table
-    enriched = data_table.with_columns(_pw_embedding=embedder(data_column))
-    return enriched._pw_embedding, enriched
 
 
 def default_vector_document_index(
@@ -56,14 +48,14 @@ def default_brute_force_knn_document_index(
     metadata_column: ColumnExpression | None = None,
     metric: str = "cos",
 ) -> DataIndex:
-    col, table = _embedded_column(data_column, data_table, embedder)
     inner = BruteForceKnn(
-        data_column=col,
+        data_column=data_column,
         metadata_column=metadata_column,
         dimensions=dimensions,
         metric=metric,
+        embedder=embedder,
     )
-    return DataIndex(data_table=table, inner_index=inner)
+    return DataIndex(data_table=data_table, inner_index=inner)
 
 
 def default_usearch_knn_document_index(
@@ -75,14 +67,14 @@ def default_usearch_knn_document_index(
     metadata_column: ColumnExpression | None = None,
     metric: str = "cos",
 ) -> DataIndex:
-    col, table = _embedded_column(data_column, data_table, embedder)
     inner = UsearchKnn(
-        data_column=col,
+        data_column=data_column,
         metadata_column=metadata_column,
         dimensions=dimensions,
         metric=metric,
+        embedder=embedder,
     )
-    return DataIndex(data_table=table, inner_index=inner)
+    return DataIndex(data_table=data_table, inner_index=inner)
 
 
 def default_lsh_knn_document_index(
@@ -93,13 +85,13 @@ def default_lsh_knn_document_index(
     embedder: Any | None = None,
     metadata_column: ColumnExpression | None = None,
 ) -> DataIndex:
-    col, table = _embedded_column(data_column, data_table, embedder)
     inner = LshKnn(
-        data_column=col,
+        data_column=data_column,
         metadata_column=metadata_column,
         dimensions=dimensions,
+        embedder=embedder,
     )
-    return DataIndex(data_table=table, inner_index=inner)
+    return DataIndex(data_table=data_table, inner_index=inner)
 
 
 def VectorDocumentIndex(  # noqa: N802 — reference-compat alias

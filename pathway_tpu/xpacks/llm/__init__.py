@@ -1,1 +1,33 @@
-"""LLM xpack — populated with the RAG stack."""
+"""pw.xpacks.llm — the RAG stack: embedders, chats, splitters, parsers,
+rerankers, DocumentStore, QA pipelines, REST servers.
+
+Reference parity: python/pathway/xpacks/llm/ (SURVEY.md §2.4). The local
+model paths run on TPU via pathway_tpu.models instead of torch.
+"""
+
+from pathway_tpu.xpacks.llm import (
+    embedders,
+    llms,
+    parsers,
+    prompts,
+    question_answering,
+    rerankers,
+    servers,
+    splitters,
+    vector_store,
+)
+from pathway_tpu.xpacks.llm.document_store import DocumentStore, SlidesDocumentStore
+
+__all__ = [
+    "embedders",
+    "llms",
+    "parsers",
+    "prompts",
+    "question_answering",
+    "rerankers",
+    "servers",
+    "splitters",
+    "vector_store",
+    "DocumentStore",
+    "SlidesDocumentStore",
+]

@@ -1,0 +1,239 @@
+"""Chat wrappers — LLMs as UDFs on tables.
+
+Reference parity: xpacks/llm/llms.py — `BaseChat` (:27), `OpenAIChat` (:84),
+`LiteLLMChat` (:313), `HFPipelineChat` (:441), `CohereChat` (:544). Each is a
+`pw.UDF` whose async `__wrapped__` calls the provider; capacity/retry/cache
+come from the UDF executor machinery.
+
+TPU addition: `JaxLMChat` runs generation on-TPU with the framework's own
+causal transformer (`pathway_tpu.models.transformer`) — the local-model path
+the reference delegates to HF torch pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pathway_tpu as pw
+from pathway_tpu.internals import udfs
+from pathway_tpu.internals.expression import ColumnExpression
+from pathway_tpu.internals.json import Json
+
+
+def _prep_message_log(messages: Any, verbose: bool) -> str:
+    if verbose:
+        return repr(messages)
+    return repr(messages)[:500]
+
+
+def prompt_chat_single_qa(question: str) -> Json:
+    """Wrap a plain question into the single-turn chat message format."""
+    return Json([{"role": "user", "content": question}])
+
+
+class BaseChat(pw.UDF):
+    """Common chat surface: __wrapped__(messages, **kwargs) -> str."""
+
+    kwargs: dict[str, Any]
+
+    def __init__(
+        self,
+        *,
+        capacity: int | None = None,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        **chat_kwargs: Any,
+    ):
+        executor = udfs.async_executor(
+            capacity=capacity, retry_strategy=retry_strategy
+        )
+        super().__init__(executor=executor, cache_strategy=cache_strategy)
+        self.kwargs = dict(chat_kwargs)
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return True
+
+    def __call__(self, messages: ColumnExpression, **kwargs: Any) -> ColumnExpression:
+        return super().__call__(messages, **kwargs)
+
+
+class OpenAIChat(BaseChat):
+    """OpenAI chat-completions (reference: llms.py:84). Requires the
+    `openai` package and network access; construction fails fast otherwise."""
+
+    def __init__(self, model: str | None = "gpt-4o-mini", **kwargs: Any):
+        super().__init__(**kwargs)
+        self.kwargs["model"] = model
+        try:
+            import openai  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "OpenAIChat requires the `openai` package; use JaxLMChat for "
+                "on-TPU generation or mocks.FakeChatModel in tests"
+            ) from e
+
+    async def __wrapped__(self, messages: Any, **kwargs: Any) -> str | None:
+        import openai
+
+        msgs = messages.value if isinstance(messages, Json) else messages
+        client = openai.AsyncOpenAI()
+        merged = {**self.kwargs, **kwargs}
+        ret = await client.chat.completions.create(messages=msgs, **merged)
+        return ret.choices[0].message.content
+
+
+class LiteLLMChat(BaseChat):
+    """LiteLLM multi-provider chat (reference: llms.py:313)."""
+
+    def __init__(self, model: str | None = None, **kwargs: Any):
+        super().__init__(**kwargs)
+        self.kwargs["model"] = model
+        try:
+            import litellm  # noqa: F401
+        except ImportError as e:
+            raise ImportError("LiteLLMChat requires the `litellm` package") from e
+
+    async def __wrapped__(self, messages: Any, **kwargs: Any) -> str | None:
+        import litellm
+
+        msgs = messages.value if isinstance(messages, Json) else messages
+        merged = {**self.kwargs, **kwargs}
+        ret = await litellm.acompletion(messages=msgs, **merged)
+        return ret.choices[0].message.content
+
+
+class CohereChat(BaseChat):
+    """Cohere chat with citations (reference: llms.py:544)."""
+
+    def __init__(self, model: str | None = "command", **kwargs: Any):
+        super().__init__(**kwargs)
+        self.kwargs["model"] = model
+        try:
+            import cohere  # noqa: F401
+        except ImportError as e:
+            raise ImportError("CohereChat requires the `cohere` package") from e
+
+    async def __wrapped__(
+        self, messages: Any, documents: Any = None, **kwargs: Any
+    ) -> tuple:
+        import cohere
+
+        msgs = messages.value if isinstance(messages, Json) else messages
+        client = cohere.AsyncClient()
+        merged = {**self.kwargs, **kwargs}
+        docs = (
+            [d.value if isinstance(d, Json) else d for d in documents]
+            if documents
+            else None
+        )
+        message = msgs[-1]["content"]
+        chat_history = msgs[:-1]
+        ret = await client.chat(
+            message=message, chat_history=chat_history, documents=docs, **merged
+        )
+        cited = [
+            {"text": c.text, "start": c.start, "end": c.end}
+            for c in (ret.citations or [])
+        ]
+        return ret.text, cited
+
+
+class HFPipelineChat(BaseChat):
+    """Local HuggingFace text-generation pipeline (reference: llms.py:441).
+
+    Runs on CPU torch in this image; prefer JaxLMChat for the TPU path.
+    """
+
+    def __init__(
+        self,
+        model: str | None = "gpt2",
+        call_kwargs: dict | None = None,
+        device: str = "cpu",
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        try:
+            from transformers import pipeline
+        except ImportError as e:
+            raise ImportError("HFPipelineChat requires `transformers`") from e
+        self.pipeline = pipeline("text-generation", model=model, device=device)
+        self.tokenizer = self.pipeline.tokenizer
+        self.call_kwargs = call_kwargs or {}
+
+    def crop_to_max_length(self, input_string: str, max_prompt_length: int = 500) -> str:
+        tokens = self.tokenizer.tokenize(input_string)
+        if len(tokens) > max_prompt_length:
+            tokens = tokens[-max_prompt_length:]
+        return self.tokenizer.convert_tokens_to_string(tokens)
+
+    def __wrapped__(self, messages: Any, **kwargs: Any) -> str | None:
+        msgs = messages.value if isinstance(messages, Json) else messages
+        if isinstance(msgs, list):
+            prompt = "\n".join(m["content"] for m in msgs)
+        else:
+            prompt = str(msgs)
+        merged = {**self.call_kwargs, **kwargs}
+        merged.setdefault("max_new_tokens", 64)
+        merged.setdefault("return_full_text", False)
+        out = self.pipeline(prompt, **merged)
+        return out[0]["generated_text"]
+
+
+class JaxLMChat(BaseChat):
+    """On-TPU generation with the framework's causal transformer.
+
+    The reference has no analog — its local path is a torch HF pipeline
+    (llms.py:441). Here the model is a JAX program: batched prefill + scanned
+    decode with a KV cache (models/transformer.py), jit-compiled once.
+    Pass trained `params`, or leave None for random weights (testing).
+    """
+
+    def __init__(
+        self,
+        config: Any = None,
+        params: Any = None,
+        tokenizer: Any = None,
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        from pathway_tpu.models import lm_config, transformer
+        from pathway_tpu.models.tokenizer import HashTokenizer
+
+        self._tfm = transformer
+        self.config = config or lm_config(
+            vocab_size=32768, d_model=256, n_heads=8, n_layers=4, d_ff=1024,
+            max_len=512,
+        )
+        if params is None:
+            import jax
+
+            params = transformer.init_params(jax.random.PRNGKey(0), self.config)
+        self.params = params
+        self.tokenizer = tokenizer or HashTokenizer(
+            vocab_size=self.config.vocab_size, max_len=self.config.max_len
+        )
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+
+    def __wrapped__(self, messages: Any, **kwargs: Any) -> str:
+        import jax.numpy as jnp
+
+        msgs = messages.value if isinstance(messages, Json) else messages
+        if isinstance(msgs, list):
+            prompt = "\n".join(m["content"] for m in msgs)
+        else:
+            prompt = str(msgs)
+        ids = self.tokenizer.tokenize(prompt)
+        budget = self.config.max_len - self.max_new_tokens
+        ids = ids[-budget:]
+        out = self._tfm.generate(
+            self.params,
+            jnp.asarray([ids], jnp.int32),
+            n_steps=self.max_new_tokens,
+            cfg=self.config,
+            temperature=self.temperature,
+        )
+        toks = [int(t) for t in out[0, len(ids):]]
+        return " ".join(f"<{t}>" for t in toks)

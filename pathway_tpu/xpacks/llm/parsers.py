@@ -1,0 +1,110 @@
+"""Document parsers: bytes -> list[(text, metadata)].
+
+Reference parity: xpacks/llm/parsers.py — `ParseUtf8` (:53),
+`ParseUnstructured` (:79), `OpenParse` (:235), `ImageParser` (:396),
+`SlideParser` (:569), `PypdfParser` (:746). The heavyweight backends
+(unstructured/openparse/vision LLMs) are optional imports; `ParseUtf8` is
+dependency-free and `PypdfParser` works when `pypdf` is importable.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pathway_tpu as pw
+
+
+class ParseUtf8(pw.UDF):
+    """Decode bytes as UTF-8, one document chunk (reference: parsers.py:53)."""
+
+    def __init__(self) -> None:
+        super().__init__(deterministic=True)
+
+    def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
+        if isinstance(contents, bytes):
+            text = contents.decode("utf-8", errors="replace")
+        else:
+            text = str(contents)
+        return [(text, {})]
+
+
+# reference alias
+Utf8Parser = ParseUtf8
+
+
+class ParseUnstructured(pw.UDF):
+    """unstructured.io-based parsing of arbitrary file types
+    (reference: parsers.py:79). Requires the `unstructured` package."""
+
+    def __init__(self, mode: str = "single", **unstructured_kwargs: Any):
+        super().__init__()
+        try:
+            import unstructured.partition.auto  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "ParseUnstructured requires `unstructured`; ParseUtf8 handles "
+                "plain text without extra dependencies"
+            ) from e
+        if mode not in ("single", "elements", "paged"):
+            raise ValueError(f"mode must be single|elements|paged, got {mode!r}")
+        self.mode = mode
+        self.kwargs = unstructured_kwargs
+
+    def __wrapped__(self, contents: bytes, **kwargs: Any) -> list[tuple[str, dict]]:
+        import io
+
+        from unstructured.partition.auto import partition
+
+        elements = partition(file=io.BytesIO(contents), **{**self.kwargs, **kwargs})
+        if self.mode == "single":
+            return [("\n\n".join(str(e) for e in elements), {})]
+        out = []
+        for e in elements:
+            meta = e.metadata.to_dict() if hasattr(e, "metadata") else {}
+            meta["category"] = getattr(e, "category", None)
+            out.append((str(e), meta))
+        return out
+
+
+class PypdfParser(pw.UDF):
+    """PDF text extraction via pypdf (reference: parsers.py:746)."""
+
+    def __init__(self, apply_text_cleanup: bool = True):
+        super().__init__()
+        try:
+            import pypdf  # noqa: F401
+        except ImportError as e:
+            raise ImportError("PypdfParser requires `pypdf`") from e
+        self.apply_text_cleanup = apply_text_cleanup
+
+    def __wrapped__(self, contents: bytes, **kwargs: Any) -> list[tuple[str, dict]]:
+        import io
+
+        import pypdf
+
+        reader = pypdf.PdfReader(io.BytesIO(contents))
+        out = []
+        for i, page in enumerate(reader.pages):
+            text = page.extract_text() or ""
+            if self.apply_text_cleanup:
+                text = " ".join(text.split())
+            out.append((text, {"page": i}))
+        return out
+
+
+class ImageParser(pw.UDF):
+    """Vision-LLM image description (reference: parsers.py:396). Needs a
+    multimodal chat; gated on construction."""
+
+    def __init__(self, llm: Any, prompt: str = "Describe the image contents."):
+        super().__init__()
+        self.llm = llm
+        self.prompt = prompt
+        raise NotImplementedError(
+            "ImageParser requires a multimodal LLM endpoint, unavailable in "
+            "this build; parse images upstream or use ParseUtf8 for text"
+        )
+
+
+class SlideParser(ImageParser):
+    """Slide-deck parsing via vision LLM (reference: parsers.py:569)."""

@@ -1,0 +1,137 @@
+"""VectorStoreServer / VectorStoreClient — the legacy self-contained
+embed + index + REST service.
+
+Reference parity: xpacks/llm/vector_store.py `VectorStoreServer` (:38,
+run_server :456) and `VectorStoreClient` (:629). Internally delegates to
+DocumentStore with a KNN retriever over the given embedder (the reference
+kept a parallel implementation; one code path is enough here).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Any, Callable
+
+import pathway_tpu as pw
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.nearest_neighbors import BruteForceKnnFactory
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+
+
+class VectorStoreServer:
+    def __init__(
+        self,
+        *docs: Table,
+        embedder: Any = None,
+        parser: Any = None,
+        splitter: Any = None,
+        doc_post_processors: list[Callable] | None = None,
+        index_factory: Any = None,
+    ):
+        if embedder is None and index_factory is None:
+            from pathway_tpu.xpacks.llm.embedders import JaxEmbedder
+
+            embedder = JaxEmbedder()
+        self.embedder = embedder
+        if index_factory is None:
+            dim = embedder.get_embedding_dimension()
+            index_factory = BruteForceKnnFactory(dimensions=dim, embedder=embedder)
+        self.document_store = DocumentStore(
+            list(docs),
+            retriever_factory=index_factory,
+            parser=parser,
+            splitter=splitter,
+            doc_post_processors=doc_post_processors,
+        )
+
+    RetrieveQuerySchema = DocumentStore.RetrieveQuerySchema
+    StatisticsQuerySchema = DocumentStore.StatisticsQuerySchema
+    InputsQuerySchema = DocumentStore.InputsQuerySchema
+
+    def retrieve_query(self, queries: Table) -> Table:
+        return self.document_store.retrieve_query(queries)
+
+    def statistics_query(self, queries: Table) -> Table:
+        return self.document_store.statistics_query(queries)
+
+    def inputs_query(self, queries: Table) -> Table:
+        return self.document_store.inputs_query(queries)
+
+    @property
+    def index(self):
+        return self.document_store.index
+
+    def run_server(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        threaded: bool = False,
+        with_cache: bool = True,
+        cache_backend: Any = None,
+        **kwargs: Any,
+    ):
+        from pathway_tpu.xpacks.llm.servers import DocumentStoreServer
+
+        server = DocumentStoreServer(host, port, self.document_store)
+        if threaded:
+            t = threading.Thread(target=pw.run, daemon=True)
+            t.start()
+            return t
+        return pw.run()
+
+
+class VectorStoreClient:
+    """Thin HTTP client for the vector-store endpoints
+    (reference: vector_store.py:629)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000, url: str | None = None,
+                 timeout: float = 15.0):
+        self.url = url or f"http://{host}:{port}"
+        self.timeout = timeout
+
+    def _post(self, route: str, payload: dict) -> Any:
+        req = urllib.request.Request(
+            self.url + route,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read().decode())
+
+    def query(
+        self,
+        query: str,
+        k: int = 3,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ) -> list[dict]:
+        return self._post(
+            "/v1/retrieve",
+            {
+                "query": query,
+                "k": k,
+                "metadata_filter": metadata_filter,
+                "filepath_globpattern": filepath_globpattern,
+            },
+        )
+
+    __call__ = query
+
+    def get_vectorstore_statistics(self) -> dict:
+        return self._post("/v1/statistics", {})
+
+    def get_input_files(
+        self,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ) -> list[dict]:
+        return self._post(
+            "/v1/inputs",
+            {
+                "metadata_filter": metadata_filter,
+                "filepath_globpattern": filepath_globpattern,
+            },
+        )

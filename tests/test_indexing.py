@@ -238,6 +238,58 @@ def test_inner_index_reply_mode():
     assert len(reply) == 2 and isinstance(reply[0][1], float)
 
 
+def test_hybrid_with_embedder_and_bm25():
+    """Embedder KNN + BM25 hybrid: data embedded once, queries transformed
+    per-retriever (regression for the double-embed / raw-query bugs)."""
+    from pathway_tpu.xpacks.llm.mocks import FakeEmbedder
+
+    calls = []
+
+    class CountingEmbedder(FakeEmbedder):
+        def __wrapped__(self, text, **kwargs):
+            calls.append(text)
+            return super().__wrapped__(text, **kwargs)
+
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(text=str),
+        [("quick brown fox",), ("lazy dog sleeps",), ("stream of data",)],
+    )
+    emb = CountingEmbedder(dim=8)
+    hybrid = HybridIndex(
+        [
+            BruteForceKnn(data_column=docs.text, dimensions=8, embedder=emb),
+            TantivyBM25(data_column=docs.text),
+        ]
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(q=str), [("quick fox",)]
+    )
+    res = DataIndex(docs, hybrid).query_as_of_now(queries.q, number_of_matches=1)
+    df = pw.debug.table_to_pandas(res, include_id=False)
+    assert df.iloc[0]["text"] == ("quick brown fox",)
+    # 3 docs embedded exactly once each + 1 query
+    assert sorted(calls) == sorted(
+        ["quick brown fox", "lazy dog sleeps", "stream of data", "quick fox"]
+    )
+
+
+def test_preset_embeds_queries():
+    from pathway_tpu.stdlib.indexing import default_vector_document_index
+    from pathway_tpu.xpacks.llm.mocks import FakeEmbedder
+
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(text=str), [("alpha beta",), ("gamma delta",)]
+    )
+    index = default_vector_document_index(
+        docs.text, docs, dimensions=8, embedder=FakeEmbedder(dim=8)
+    )
+    queries = pw.debug.table_from_rows(pw.schema_from_types(q=str), [("alpha",)])
+    df = pw.debug.table_to_pandas(
+        index.query_as_of_now(queries.q, number_of_matches=1), include_id=False
+    )
+    assert df.iloc[0]["text"] == ("alpha beta",)
+
+
 def test_factories():
     docs = _docs()
     f = BruteForceKnnFactory(dimensions=2)

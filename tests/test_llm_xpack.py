@@ -1,0 +1,287 @@
+"""Tests for the LLM xpack: splitters, embedders, DocumentStore, RAG, server."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.mocks import FakeChatModel, FakeEmbedder, IdentityMockChat
+
+
+def _doc_table(rows):
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(data=bytes, _metadata=object), rows
+    )
+
+
+def _store(docs, dim=8, **kwargs):
+    return DocumentStore(
+        docs,
+        retriever_factory=BruteForceKnnFactory(
+            dimensions=dim, embedder=FakeEmbedder(dim=dim)
+        ),
+        **kwargs,
+    )
+
+
+# ---------------------------------------------------------------- splitters
+
+
+def test_token_count_splitter_chunks():
+    from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+
+    sp = TokenCountSplitter(min_tokens=3, max_tokens=6)
+    text = "one two three. four five six. seven eight nine. ten eleven twelve."
+    chunks = sp.chunk(text)
+    assert len(chunks) >= 2
+    joined = " ".join(c for c, _m in chunks)
+    for w in ("one", "twelve"):
+        assert w in joined
+    for chunk, _meta in chunks:
+        assert len(chunk.split()) <= 8
+
+
+def test_splitter_oversize_sentence():
+    from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+
+    sp = TokenCountSplitter(min_tokens=1, max_tokens=5)
+    words = " ".join(f"w{i}" for i in range(17))
+    chunks = sp.chunk(words)
+    assert all(len(c.split()) <= 5 for c, _m in chunks)
+    assert sum(len(c.split()) for c, _m in chunks) == 17
+
+
+# ---------------------------------------------------------------- embedders
+
+
+def test_jax_embedder_batches_and_is_deterministic():
+    from pathway_tpu.models import embedder_config
+    from pathway_tpu.xpacks.llm.embedders import JaxEmbedder
+
+    emb = JaxEmbedder(
+        config=embedder_config(
+            vocab_size=512, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+            max_len=32, embed_dim=32,
+        )
+    )
+    v1, v2 = emb.encode_many(["hello world", "hello world"])
+    np.testing.assert_allclose(v1, v2)
+    assert emb.get_embedding_dimension() == 32
+    # similar inputs embed closer than dissimilar ones
+    a, b, c = emb.encode_many(
+        ["the cat sat on the mat", "the cat sat on a mat", "quantum flux capacitor"]
+    )
+    assert np.dot(a, b) > np.dot(a, c)
+
+
+def test_jax_embedder_in_dataflow():
+    from pathway_tpu.models import embedder_config
+    from pathway_tpu.xpacks.llm.embedders import JaxEmbedder
+
+    emb = JaxEmbedder(
+        config=embedder_config(
+            vocab_size=512, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+            max_len=32, embed_dim=32,
+        )
+    )
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(text=str), [("alpha",), ("beta",), ("gamma",)]
+    )
+    out = t.select(v=emb(t.text))
+    df = pw.debug.table_to_pandas(out, include_id=False)
+    assert len(df) == 3
+    assert all(np.asarray(v).shape == (32,) for v in df.v)
+
+
+# ------------------------------------------------------------ DocumentStore
+
+
+def test_document_store_retrieve_and_filters():
+    docs = _doc_table(
+        [
+            (b"quick brown fox", {"path": "docs/a.txt", "modified_at": 10, "seen_at": 11}),
+            (b"stream processing engine", {"path": "docs/b.txt", "modified_at": 20, "seen_at": 21}),
+            (b"quick stream fox", {"path": "img/c.txt", "modified_at": 30, "seen_at": 31}),
+        ]
+    )
+    store = _store(docs)
+    queries = pw.debug.table_from_rows(
+        DocumentStore.RetrieveQuerySchema,
+        [
+            ("quick brown fox", 1, None, None),
+            ("quick brown fox", 3, None, "docs/*"),
+        ],
+    )
+    df = pw.debug.table_to_pandas(store.retrieve_query(queries), include_id=False)
+    results = [r.result.value if hasattr(r.result, "value") else r.result for r in df.itertuples()]
+    top = results[0]
+    assert top[0]["text"] == "quick brown fox"
+    filtered = results[1]
+    assert {d["metadata"]["path"] for d in filtered} <= {"docs/a.txt", "docs/b.txt"}
+
+
+def test_document_store_statistics_and_inputs():
+    docs = _doc_table(
+        [
+            (b"alpha", {"path": "a.txt", "modified_at": 10, "seen_at": 11}),
+            (b"beta", {"path": "b.txt", "modified_at": 20, "seen_at": 21}),
+        ]
+    )
+    store = _store(docs)
+    sq = pw.debug.table_from_rows(pw.schema_from_types(), [()])
+    stats = pw.debug.table_to_pandas(store.statistics_query(sq), include_id=False)
+    s = stats.iloc[0]["result"].value
+    assert s["file_count"] == 2 and s["last_modified"] == 20 and s["last_indexed"] == 21
+
+    iq = pw.debug.table_from_rows(
+        DocumentStore.InputsQuerySchema, [(None, "a.*")]
+    )
+    inputs = pw.debug.table_to_pandas(store.inputs_query(iq), include_id=False)
+    listed = inputs.iloc[0]["result"].value
+    assert [m["path"] for m in listed] == ["a.txt"]
+
+
+# --------------------------------------------------------------------- RAG
+
+
+def _qa_queries(rows):
+    from pathway_tpu.xpacks.llm.question_answering import AnswerQuerySchema
+
+    return pw.debug.table_from_rows(AnswerQuerySchema, rows)
+
+
+def test_base_rag_question_answerer():
+    from pathway_tpu.xpacks.llm.question_answering import BaseRAGQuestionAnswerer
+
+    docs = _doc_table(
+        [
+            (b"the capital of France is Paris", {"path": "a.txt"}),
+            (b"bananas are yellow", {"path": "b.txt"}),
+        ]
+    )
+    store = _store(docs)
+    qa = BaseRAGQuestionAnswerer(IdentityMockChat(), store, search_topk=1)
+    queries = _qa_queries([("capital France Paris", None, False)])
+    df = pw.debug.table_to_pandas(qa.answer_query(queries), include_id=False)
+    response = df.iloc[0]["result"].value["response"]
+    # identity chat echoes the prompt -> retrieved doc must be inside it
+    assert "the capital of France is Paris" in response
+    assert "bananas" not in response
+
+
+def test_adaptive_rag_expands_context():
+    from pathway_tpu.xpacks.llm.question_answering import AdaptiveRAGQuestionAnswerer
+
+    calls = []
+
+    class CountingChat(pw.UDF):
+        def __wrapped__(self, messages, **kwargs):
+            msgs = messages.value if hasattr(messages, "value") else messages
+            content = msgs[-1]["content"]
+            calls.append(content)
+            # only answers when the relevant doc made it into the prompt
+            if "magic number is 42" in content:
+                return "42"
+            return "No information found."
+
+    # similar docs crowd out the relevant one at k=1; adaptive retry reaches it
+    docs = _doc_table(
+        [
+            (b"magic magic magic noise", {"path": "noise.txt"}),
+            (b"the magic number is 42", {"path": "real.txt"}),
+        ]
+    )
+    store = _store(docs)
+    qa = AdaptiveRAGQuestionAnswerer(
+        CountingChat(), store, n_starting_documents=1, factor=2, max_iterations=3
+    )
+    queries = _qa_queries([("magic magic magic number", None, False)])
+    df = pw.debug.table_to_pandas(qa.answer_query(queries), include_id=False)
+    assert df.iloc[0]["result"].value["response"] == "42"
+    assert len(calls) >= 2  # needed at least one expansion
+
+
+def test_geometric_strategy_unit():
+    import asyncio
+
+    from pathway_tpu.xpacks.llm.question_answering import (
+        answer_with_geometric_rag_strategy,
+    )
+
+    class Chat(pw.UDF):
+        def __wrapped__(self, messages, **kwargs):
+            msgs = messages.value if hasattr(messages, "value") else messages
+            return "found it" if "needle" in msgs[-1]["content"] else "No information found."
+
+    answer = asyncio.run(
+        answer_with_geometric_rag_strategy(
+            "where is it?", ["hay", "hay", "hay", "needle"], Chat(),
+            n_starting_documents=1, factor=2, max_iterations=4,
+        )
+    )
+    assert answer == "found it"
+
+
+def test_rerank_topk_filter_and_llm_reranker():
+    from pathway_tpu.xpacks.llm.rerankers import rerank_topk_filter
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(docs=object, scores=object),
+        [((("a", "b", "c"), (1.0, 3.0, 2.0)),)],
+    ).select(pair=pw.this.docs)
+    # direct function behavior via the UDF's wrapped fn
+    docs, scores = rerank_topk_filter.__wrapped__(
+        ["a", "b", "c"], [1.0, 3.0, 2.0], 2
+    )
+    assert docs == ["b", "c"] and scores == [3.0, 2.0]
+
+
+# ------------------------------------------------------------------ server
+
+
+def test_qa_rest_server_end_to_end():
+    from pathway_tpu.xpacks.llm.question_answering import BaseRAGQuestionAnswerer
+
+    docs = _doc_table(
+        [
+            (b"the moon orbits the earth", {"path": "space.txt"}),
+            (b"fish live in water", {"path": "bio.txt"}),
+        ]
+    )
+    store = _store(docs)
+    qa = BaseRAGQuestionAnswerer(IdentityMockChat(), store, search_topk=1)
+    port = 18791
+    qa.build_server("127.0.0.1", port)
+    t = threading.Thread(target=pw.run, daemon=True)
+    t.start()
+
+    def post(route, payload, tries=40):
+        last = None
+        for _ in range(tries):
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}{route}",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return json.loads(r.read().decode())
+            except Exception as e:  # noqa: BLE001 — server still starting
+                last = e
+                time.sleep(0.25)
+        raise last
+
+    ans = post("/v1/pw_ai_answer", {"prompt": "moon orbits earth"})
+    assert "moon orbits the earth" in str(ans)
+    retrieved = post(
+        "/v1/retrieve", {"query": "fish water", "k": 1}
+    )
+    assert "fish live in water" in str(retrieved)
+    stats = post("/v1/statistics", {})
+    assert "file_count" in str(stats)
