@@ -1,0 +1,272 @@
+"""ctypes loader for the native z-set kernel.
+
+Builds `zset.cpp` with g++ on first import (cached next to the source,
+keyed by a source hash), exposes typed wrappers, and degrades to None when
+no compiler is available — engine call sites keep a pure-Python fallback.
+Disable with PATHWAY_TPU_NATIVE=0.
+
+Reference parity: the reference's native layer is the Rust engine + vendored
+differential dataflow (/root/reference/src/, external/); this kernel covers
+the same hot loops (consolidation, arrangement state, delta join, line/CSV
+tokenization) behind a C ABI.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_HERE = Path(__file__).resolve().parent
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+u64p = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
+i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+
+
+def _build() -> Path | None:
+    src = _HERE / "zset.cpp"
+    tag = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    out = _HERE / f"libzset-{tag}.so"
+    if out.exists():
+        return out
+    for stale in _HERE.glob("libzset-*.so"):
+        try:
+            stale.unlink()
+        except OSError:
+            pass
+    cmd = [
+        "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+        str(src), "-o", str(out),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        # retry without -march=native (unsupported on some toolchains)
+        try:
+            cmd.remove("-march=native")
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            return None
+    return out
+
+
+def _load() -> ctypes.CDLL | None:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("PATHWAY_TPU_NATIVE", "1") == "0":
+            return None
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            return None
+        lib.zs_consolidate.restype = ctypes.c_int64
+        lib.zs_consolidate.argtypes = [ctypes.c_int64, u64p, u64p, u64p, i64p]
+        lib.zs_keyed_new.restype = ctypes.c_void_p
+        lib.zs_keyed_free.argtypes = [ctypes.c_void_p]
+        lib.zs_keyed_update.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, u64p, u64p, u64p, i64p,
+        ]
+        lib.zs_keyed_get.argtypes = [ctypes.c_void_p, ctypes.c_int64, u64p, u64p, u64p]
+        lib.zs_keyed_len.restype = ctypes.c_int64
+        lib.zs_keyed_len.argtypes = [ctypes.c_void_p]
+        lib.zs_keyed_items.restype = ctypes.c_int64
+        lib.zs_keyed_items.argtypes = [ctypes.c_void_p, u64p, u64p, u64p]
+        lib.zs_arr_new.restype = ctypes.c_void_p
+        lib.zs_arr_free.argtypes = [ctypes.c_void_p]
+        lib.zs_arr_update.argtypes = [ctypes.c_void_p, ctypes.c_int64, u64p, u64p, i64p]
+        lib.zs_arr_group_size.restype = ctypes.c_int64
+        lib.zs_arr_group_size.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.zs_arr_get.restype = ctypes.c_int64
+        lib.zs_arr_get.argtypes = [ctypes.c_void_p, ctypes.c_uint64, u64p, i64p]
+        lib.zs_arr_group_count.restype = ctypes.c_int64
+        lib.zs_arr_group_count.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.zs_arr_delta_join.restype = ctypes.c_int64
+        lib.zs_arr_delta_join.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, u64p, ctypes.c_int64, i64p, u64p, i64p,
+        ]
+        lib.zs_split_lines.restype = ctypes.c_int64
+        lib.zs_split_lines.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p, i64p,
+        ]
+        lib.zs_split_csv_records.restype = ctypes.c_int64
+        lib.zs_split_csv_records.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p, i64p,
+        ]
+        lib.zs_split_csv_fields.restype = ctypes.c_int64
+        lib.zs_split_csv_fields.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, ctypes.c_int64,
+            i64p, i64p, i64p,
+        ]
+        _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ------------------------------------------------------------ typed wrappers
+
+
+def consolidate_tokens(
+    key_lo: np.ndarray, key_hi: np.ndarray, token: np.ndarray, diff: np.ndarray
+) -> int:
+    """In-place token consolidation; returns the compacted length."""
+    lib = _load()
+    assert lib is not None
+    return lib.zs_consolidate(len(key_lo), key_lo, key_hi, token, diff)
+
+
+class NativeKeyedState:
+    """C++ keyed state: 128-bit key -> payload token."""
+
+    def __init__(self) -> None:
+        lib = _load()
+        assert lib is not None
+        self._lib = lib
+        self._h = lib.zs_keyed_new()
+
+    def __del__(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.zs_keyed_free(self._h)
+            self._h = None
+
+    def update(self, key_lo, key_hi, token, diff) -> None:
+        self._lib.zs_keyed_update(self._h, len(key_lo), key_lo, key_hi, token, diff)
+
+    def get(self, key_lo, key_hi) -> np.ndarray:
+        out = np.empty(len(key_lo), np.uint64)
+        self._lib.zs_keyed_get(self._h, len(key_lo), key_lo, key_hi, out)
+        return out
+
+    def __len__(self) -> int:
+        return self._lib.zs_keyed_len(self._h)
+
+    def items_arrays(self):
+        n = len(self)
+        lo = np.empty(n, np.uint64)
+        hi = np.empty(n, np.uint64)
+        tok = np.empty(n, np.uint64)
+        self._lib.zs_keyed_items(self._h, lo, hi, tok)
+        return lo, hi, tok
+
+
+class NativeArrangement:
+    """C++ arrangement: dkey token -> multiset of payload tokens."""
+
+    def __init__(self) -> None:
+        lib = _load()
+        assert lib is not None
+        self._lib = lib
+        self._h = lib.zs_arr_new()
+
+    def __del__(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.zs_arr_free(self._h)
+            self._h = None
+
+    def update(self, dkey, token, diff) -> None:
+        self._lib.zs_arr_update(self._h, len(dkey), dkey, token, diff)
+
+    def get(self, dkey: int):
+        n = self._lib.zs_arr_group_size(self._h, dkey)
+        if n == 0:
+            return np.empty(0, np.uint64), np.empty(0, np.int64)
+        tok = np.empty(n, np.uint64)
+        cnt = np.empty(n, np.int64)
+        m = self._lib.zs_arr_get(self._h, dkey, tok, cnt)
+        return tok[:m], cnt[:m]
+
+    def group_count(self, dkey: int) -> int:
+        return self._lib.zs_arr_group_count(self._h, dkey)
+
+    def delta_join(self, dkeys: np.ndarray):
+        """For each dkeys[i], cross with this arrangement's group.
+
+        Returns (input_idx, token, count) arrays of the flattened matches.
+        """
+        cap = max(len(dkeys) * 4, 256)
+        while True:
+            idx = np.empty(cap, np.int64)
+            tok = np.empty(cap, np.uint64)
+            cnt = np.empty(cap, np.int64)
+            m = self._lib.zs_arr_delta_join(self._h, len(dkeys), dkeys, cap, idx, tok, cnt)
+            if m >= 0:
+                return idx[:m], tok[:m], cnt[:m]
+            cap = -m
+
+
+def split_lines(data: bytes):
+    """Returns (start, end) offset arrays of lines in `data`."""
+    lib = _load()
+    assert lib is not None
+    cap = max(data.count(b"\n") + 2, 16)
+    start = np.empty(cap, np.int64)
+    end = np.empty(cap, np.int64)
+    n = lib.zs_split_lines(data, len(data), cap, start, end)
+    if n < 0:  # shouldn't happen given the count-based cap
+        cap = -n
+        start = np.empty(cap, np.int64)
+        end = np.empty(cap, np.int64)
+        n = lib.zs_split_lines(data, len(data), cap, start, end)
+    return start[:n], end[:n]
+
+
+def split_csv_records(data: bytes):
+    """(start, end) offsets of CSV records — newlines inside quoted fields
+    do not split."""
+    lib = _load()
+    assert lib is not None
+    cap = max(data.count(b"\n") + 2, 16)
+    start = np.empty(cap, np.int64)
+    end = np.empty(cap, np.int64)
+    n = lib.zs_split_csv_records(data, len(data), cap, start, end)
+    if n < 0:
+        cap = -n
+        start = np.empty(cap, np.int64)
+        end = np.empty(cap, np.int64)
+        n = lib.zs_split_csv_records(data, len(data), cap, start, end)
+    return start[:n], end[:n]
+
+
+def split_csv_line(line: bytes, delim: bytes = b","):
+    """Returns list of decoded CSV fields of one line (RFC-4180 quoting)."""
+    lib = _load()
+    assert lib is not None
+    cap = line.count(delim) + 2
+    start = np.empty(cap, np.int64)
+    end = np.empty(cap, np.int64)
+    quoted = np.empty(cap, np.int64)
+    n = lib.zs_split_csv_fields(line, len(line), delim, cap, start, end, quoted)
+    if n < 0:
+        cap = -n
+        start = np.empty(cap, np.int64)
+        end = np.empty(cap, np.int64)
+        quoted = np.empty(cap, np.int64)
+        n = lib.zs_split_csv_fields(line, len(line), delim, cap, start, end, quoted)
+    fields = []
+    for i in range(n):
+        raw = line[start[i]:end[i]]
+        if quoted[i]:
+            raw = raw.strip()
+            if raw.startswith(b'"') and raw.endswith(b'"') and len(raw) >= 2:
+                raw = raw[1:-1]
+            raw = raw.replace(b'""', b'"')
+        fields.append(raw.decode("utf-8", errors="replace"))
+    return fields

@@ -28,10 +28,6 @@ def run(
     session.graph.terminate_on_error = terminate_on_error or get_config().terminate_on_error
     if autocommit_duration_ms:
         session.autocommit_ms = autocommit_duration_ms
-    if persistence_config is not None:
-        from pathway_tpu.persistence import attach_persistence
-
-        attach_persistence(session, persistence_config)
     for hook in G.pre_run_hooks:
         hook()
     for sink in G.sinks:
@@ -59,6 +55,12 @@ def run(
         from pathway_tpu.internals.monitoring import attach_monitor
 
         attach_monitor(session)
+    if persistence_config is not None:
+        # wrap AFTER lowering: session.connectors only exist once the sinks
+        # above have been lowered into engine nodes
+        from pathway_tpu.persistence import attach_persistence
+
+        attach_persistence(session, persistence_config)
     session.execute()
 
 

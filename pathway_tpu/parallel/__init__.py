@@ -1,0 +1,31 @@
+"""pw.parallel — device-mesh scale-out primitives.
+
+Reference parity: the reference scales out with timely's communication crate
+(hash-partitioned exchange over shared-memory channels / TCP,
+external/timely-dataflow/communication/, SURVEY.md §2.2). The TPU-native
+equivalent keeps a host control plane but moves the numeric data plane onto
+the chip interconnect: records are bucketized by key hash in XLA and shuffled
+with `all_to_all` over the mesh (ICI intra-pod, DCN across pods).
+"""
+
+from pathway_tpu.parallel.mesh import (
+    default_mesh,
+    make_mesh,
+    replicate,
+    shard_rows,
+)
+from pathway_tpu.parallel.exchange import (
+    ExchangeResult,
+    exchange_by_key,
+    partition_counts,
+)
+
+__all__ = [
+    "default_mesh",
+    "make_mesh",
+    "replicate",
+    "shard_rows",
+    "ExchangeResult",
+    "exchange_by_key",
+    "partition_counts",
+]

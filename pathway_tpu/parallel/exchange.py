@@ -1,0 +1,118 @@
+"""Key-hash record exchange over the device mesh — the ICI data plane.
+
+Reference parity: timely's exchange pacts route each record to the worker
+owning hash(key) % n_workers over shared-memory channels or TCP
+(external/timely-dataflow/communication/src/networking.rs). Here the shuffle
+of a batch of (key, payload) rows is ONE jit-compiled XLA program: each
+shard sorts its rows into per-destination buckets (static capacity, padded)
+and a single `all_to_all` moves the buckets across the interconnect. Scalar
+control traffic stays on host; bulk numeric payloads ride ICI.
+
+Static-shape design: XLA needs fixed shapes, so each shard sends exactly
+`capacity` slots to every destination, padding unused slots with a validity
+flag. capacity defaults to the full per-shard row count (worst case: all
+rows hash to one destination); callers with balanced keys can pass a
+smaller capacity and check `overflowed`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+Array = jax.Array
+
+
+class ExchangeResult(NamedTuple):
+    keys: Array  # [shards, cap * shards] u32 — received keys per shard slot
+    payloads: Array  # [shards, cap * shards, d] — received payloads
+    valid: Array  # [shards, cap * shards] bool — slot occupancy
+    overflowed: Array  # [] bool — some bucket exceeded capacity
+
+
+def _bucketize(keys: Array, payloads: Array, n_shards: int, cap: int):
+    """Sort one shard's rows into n_shards buckets of `cap` slots each."""
+    dest = keys % n_shards  # [rows]
+    # stable order: rows of destination d, in arrival order
+    order = jnp.argsort(dest, stable=True)
+    sorted_dest = dest[order]
+    # slot within destination bucket = running index among same-destination rows
+    same = sorted_dest[:, None] == jnp.arange(n_shards)[None, :]
+    within = jnp.cumsum(same, axis=0)[jnp.arange(keys.shape[0]), sorted_dest] - 1
+    counts = jnp.sum(same, axis=0)
+    overflow = jnp.any(counts > cap)
+    slot = sorted_dest * cap + jnp.minimum(within, cap - 1)
+    bucket_keys = jnp.zeros((n_shards * cap,), keys.dtype).at[slot].set(keys[order])
+    bucket_pay = (
+        jnp.zeros((n_shards * cap,) + payloads.shape[1:], payloads.dtype)
+        .at[slot]
+        .set(payloads[order])
+    )
+    bucket_valid = (
+        jnp.zeros((n_shards * cap,), bool)
+        .at[slot]
+        .set(within < cap)
+    )
+    return bucket_keys, bucket_pay, bucket_valid, overflow
+
+
+def exchange_by_key(
+    keys: Array,
+    payloads: Array,
+    mesh: Mesh,
+    axis: str = "data",
+    capacity: int | None = None,
+) -> ExchangeResult:
+    """Shuffle rows so shard s receives every row with key % n_shards == s.
+
+    keys: [n] uint32 (row key hashes), sharded over `axis`.
+    payloads: [n, d] numeric payloads, same sharding.
+    Output arrays keep the shard dimension explicit: result.keys[s] are the
+    rows now owned by shard s.
+    """
+    n_shards = mesh.shape[axis]
+    rows_total = keys.shape[0]
+    if rows_total % n_shards != 0:
+        raise ValueError(f"row count {rows_total} not divisible by {n_shards}")
+    rows_local = rows_total // n_shards
+    cap = capacity or rows_local
+
+    def local(k, p):
+        bk, bp, bv, overflow = _bucketize(k, p, n_shards, cap)
+        # [n_shards*cap] -> split into n_shards chunks -> all_to_all
+        bk = bk.reshape(n_shards, cap)
+        bp = bp.reshape((n_shards, cap) + p.shape[1:])
+        bv = bv.reshape(n_shards, cap)
+        rk = jax.lax.all_to_all(bk, axis, 0, 0, tiled=False)
+        rp = jax.lax.all_to_all(bp, axis, 0, 0, tiled=False)
+        rv = jax.lax.all_to_all(bv, axis, 0, 0, tiled=False)
+        ov = jax.lax.pmax(overflow.astype(jnp.int32), axis)
+        return (
+            rk.reshape(1, n_shards * cap),
+            rp.reshape((1, n_shards * cap) + p.shape[1:]),
+            rv.reshape(1, n_shards * cap),
+            ov.reshape(1),
+        )
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis), P(axis)),
+    )
+    rk, rp, rv, ov = jax.jit(fn)(keys, payloads)
+    return ExchangeResult(
+        keys=rk, payloads=rp, valid=rv, overflowed=jnp.any(ov > 0)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n_shards",))
+def partition_counts(keys: Array, n_shards: int) -> Array:
+    """Histogram of destination shards — the host scheduler uses this to
+    spot skew before committing to a capacity."""
+    dest = keys % n_shards
+    return jnp.bincount(dest, length=n_shards)

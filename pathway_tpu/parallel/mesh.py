@@ -1,0 +1,44 @@
+"""Mesh construction + sharding helpers.
+
+The framework's convention: axis `data` shards rows/batch (dp), axis
+`model` shards tensors (tp). `make_mesh((4, 2))` on 8 devices gives the
+standard dp x tp layout used by models/transformer.py param_specs.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    shape: Sequence[int] | None = None,
+    axis_names: Sequence[str] = ("data", "model"),
+    devices: Sequence | None = None,
+) -> Mesh:
+    devs = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs[:n]).reshape(shape), tuple(axis_names))
+
+
+def default_mesh(axis_names: Sequence[str] = ("data",)) -> Mesh:
+    """All visible devices on one axis."""
+    devs = jax.devices()
+    return Mesh(np.asarray(devs).reshape(len(devs)), tuple(axis_names))
+
+
+def shard_rows(x, mesh: Mesh, axis: str = "data"):
+    """Place an array with its leading dim sharded over `axis`."""
+    spec = P(axis, *([None] * (x.ndim - 1)))
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def replicate(x, mesh: Mesh):
+    return jax.device_put(x, NamedSharding(mesh, P()))

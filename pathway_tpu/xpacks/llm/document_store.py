@@ -159,15 +159,12 @@ class DocumentStore:
         string (reference: document_store.py:356)."""
 
         def _merge(metadata_filter: Any, globpattern: Any) -> Any:
+            # unlike the reference (which rewrites JMESPath backticks for the
+            # jmespath library), our filter grammar evaluates backtick JSON
+            # literals natively — pass the expression through untouched
             parts = []
             if metadata_filter:
-                mf = (
-                    str(metadata_filter)
-                    .replace("'", r"\'")
-                    .replace("`", "'")
-                    .replace('"', "")
-                )
-                parts.append(f"({mf})")
+                parts.append(f"({metadata_filter})")
             if globpattern:
                 parts.append(f"globmatch('{globpattern}', path)")
             return " && ".join(parts) if parts else None

@@ -48,20 +48,20 @@ class OpenAIEmbedder(BaseEmbedder):
         executor = udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy)
         super().__init__(executor=executor, cache_strategy=cache_strategy)
         try:
-            import openai  # noqa: F401
+            import openai
         except ImportError as e:
             raise ImportError(
                 "OpenAIEmbedder requires `openai`; use JaxEmbedder for the "
                 "on-TPU path"
             ) from e
         self.kwargs = {"model": model, **openai_kwargs}
+        # one client for all rows — connection pooling matters on the
+        # hottest path of the pipeline
+        self.client = openai.AsyncOpenAI()
 
     async def __wrapped__(self, input: str, **kwargs: Any) -> np.ndarray:
-        import openai
-
-        client = openai.AsyncOpenAI()
         merged = {**self.kwargs, **kwargs}
-        ret = await client.embeddings.create(input=[input or "."], **merged)
+        ret = await self.client.embeddings.create(input=[input or "."], **merged)
         return np.array(ret.data[0].embedding)
 
 

@@ -65,20 +65,18 @@ class OpenAIChat(BaseChat):
         super().__init__(**kwargs)
         self.kwargs["model"] = model
         try:
-            import openai  # noqa: F401
+            import openai
         except ImportError as e:
             raise ImportError(
                 "OpenAIChat requires the `openai` package; use JaxLMChat for "
                 "on-TPU generation or mocks.FakeChatModel in tests"
             ) from e
+        self.client = openai.AsyncOpenAI()  # shared pool across rows
 
     async def __wrapped__(self, messages: Any, **kwargs: Any) -> str | None:
-        import openai
-
         msgs = messages.value if isinstance(messages, Json) else messages
-        client = openai.AsyncOpenAI()
         merged = {**self.kwargs, **kwargs}
-        ret = await client.chat.completions.create(messages=msgs, **merged)
+        ret = await self.client.chat.completions.create(messages=msgs, **merged)
         return ret.choices[0].message.content
 
 
@@ -109,17 +107,16 @@ class CohereChat(BaseChat):
         super().__init__(**kwargs)
         self.kwargs["model"] = model
         try:
-            import cohere  # noqa: F401
+            import cohere
         except ImportError as e:
             raise ImportError("CohereChat requires the `cohere` package") from e
+        self.client = cohere.AsyncClient()  # shared pool across rows
 
     async def __wrapped__(
         self, messages: Any, documents: Any = None, **kwargs: Any
     ) -> tuple:
-        import cohere
-
         msgs = messages.value if isinstance(messages, Json) else messages
-        client = cohere.AsyncClient()
+        client = self.client
         merged = {**self.kwargs, **kwargs}
         docs = (
             [d.value if isinstance(d, Json) else d for d in documents]
@@ -214,6 +211,11 @@ class JaxLMChat(BaseChat):
         self.tokenizer = tokenizer or HashTokenizer(
             vocab_size=self.config.vocab_size, max_len=self.config.max_len
         )
+        if max_new_tokens >= self.config.max_len:
+            raise ValueError(
+                f"max_new_tokens ({max_new_tokens}) must be smaller than the "
+                f"model context length ({self.config.max_len})"
+            )
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
 

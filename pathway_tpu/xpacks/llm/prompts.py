@@ -54,6 +54,7 @@ def prompt_summarize(texts: tuple) -> str:
     return DEFAULT_SUMMARY_TEMPLATE.format(text="\n\n".join(str(t) for t in texts))
 
 
+@pw.udf
 def prompt_citing_qa(query: str, docs: tuple) -> str:
     context = "\n\n".join(
         f"[{i + 1}] {d}" for i, d in enumerate(str(d) for d in docs)

@@ -45,7 +45,14 @@ class BaseRestServer:
         cache_backend: Any = None,
         **kwargs: Any,
     ):
-        """Start serving (runs pw.run; `threaded=True` returns the thread)."""
+        """Start serving (runs pw.run; `threaded=True` returns the thread).
+
+        `with_cache`+`cache_backend` wire UDF/input caching through the
+        persistence layer (reference: servers.py run with_cache)."""
+        if with_cache and cache_backend is not None:
+            kwargs.setdefault(
+                "persistence_config", pw.persistence.Config(cache_backend)
+            )
         if threaded:
             t = threading.Thread(target=pw.run, kwargs=kwargs, daemon=True)
             t.start()

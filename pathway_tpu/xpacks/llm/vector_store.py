@@ -75,11 +75,12 @@ class VectorStoreServer:
         from pathway_tpu.xpacks.llm.servers import DocumentStoreServer
 
         server = DocumentStoreServer(host, port, self.document_store)
-        if threaded:
-            t = threading.Thread(target=pw.run, daemon=True)
-            t.start()
-            return t
-        return pw.run()
+        return server.run(
+            threaded=threaded,
+            with_cache=with_cache,
+            cache_backend=cache_backend,
+            **kwargs,
+        )
 
 
 class VectorStoreClient:

@@ -2,12 +2,22 @@ import os
 
 # Force JAX onto a virtual 8-device CPU mesh for sharding tests; the real
 # TPU chip is reserved for benchmarks (bench.py), not unit tests.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+#
+# The environment may pre-import jax and pin JAX_PLATFORMS to a hardware
+# plugin at interpreter start (sitecustomize), so an env-var setdefault is
+# not enough: override the config directly before the backend initializes
+# (it is lazy until the first jax.devices()).
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", "tests must run on the CPU mesh"
 
 import pytest  # noqa: E402
 

@@ -206,7 +206,7 @@ def test_index_survives_same_wave_doc_update():
         name | vx   | vy  | __time__ | __diff__
         a    | 1.0  | 0.0 | 2        | 1
         a    | 1.0  | 0.0 | 4        | -1
-        a    | 0.9  | 0.1 | 4        | 1
+        a    | 0.0  | 1.0 | 4        | 1
         """,
         schema=pw.schema_from_types(name=str, vx=float, vy=float),
     )
@@ -214,7 +214,7 @@ def test_index_survives_same_wave_doc_update():
     queries = pw.debug.table_from_markdown(
         """
         q | qx  | qy  | __time__
-        q | 0.9 | 0.1 | 6
+        q | 0.0 | 1.0 | 6
         """,
         schema=pw.schema_from_types(q=str, qx=float, qy=float),
     )
@@ -224,7 +224,8 @@ def test_index_survives_same_wave_doc_update():
     df = pw.debug.table_to_pandas(res, include_id=False)
     assert len(df) == 1
     assert df.iloc[0]["name"] == ("a",)
-    assert df.iloc[0]["_pw_index_reply_score"][0] < 1e-3  # matched NEW vector
+    # matched the NEW vector (distance ~0); the old one would be ~1.0
+    assert df.iloc[0]["_pw_index_reply_score"][0] < 0.05
 
 
 def test_inner_index_reply_mode():

@@ -126,6 +126,24 @@ def test_document_store_retrieve_and_filters():
     assert {d["metadata"]["path"] for d in filtered} <= {"docs/a.txt", "docs/b.txt"}
 
 
+def test_document_store_numeric_backtick_filter():
+    """merge_filters must preserve backtick JSON literals (regression)."""
+    docs = _doc_table(
+        [
+            (b"old doc", {"path": "a.txt", "modified_at": 10, "seen_at": 10}),
+            (b"new doc", {"path": "b.txt", "modified_at": 100, "seen_at": 100}),
+        ]
+    )
+    store = _store(docs)
+    queries = pw.debug.table_from_rows(
+        DocumentStore.RetrieveQuerySchema,
+        [("doc", 5, "modified_at >= `50`", None)],
+    )
+    df = pw.debug.table_to_pandas(store.retrieve_query(queries), include_id=False)
+    result = df.iloc[0]["result"].value
+    assert [d["text"] for d in result] == ["new doc"]
+
+
 def test_document_store_statistics_and_inputs():
     docs = _doc_table(
         [

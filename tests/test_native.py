@@ -1,0 +1,116 @@
+"""Tests for the C++ z-set kernel (engine/native)."""
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native kernel unavailable (no g++)"
+)
+
+
+def test_consolidate_tokens():
+    lo = np.array([1, 1, 2, 1, 3], np.uint64)
+    hi = np.array([0, 0, 0, 0, 9], np.uint64)
+    tok = np.array([10, 10, 20, 11, 30], np.uint64)
+    diff = np.array([1, -1, 2, 1, 0], np.int64)
+    m = native.consolidate_tokens(lo, hi, tok, diff)
+    got = sorted(zip(lo[:m].tolist(), hi[:m].tolist(), tok[:m].tolist(), diff[:m].tolist()))
+    assert got == [(1, 0, 11, 1), (2, 0, 20, 2)]
+
+
+def test_keyed_state_update_guard():
+    ks = native.NativeKeyedState()
+    k = lambda *a: np.array(a, np.uint64)  # noqa: E731
+    d = lambda *a: np.array(a, np.int64)  # noqa: E731
+    ks.update(k(5), k(0), k(100), d(1))
+    # retraction with the WRONG token must not delete
+    ks.update(k(5), k(0), k(999), d(-1))
+    assert len(ks) == 1
+    # retraction with the right token deletes
+    ks.update(k(5), k(0), k(100), d(-1))
+    assert len(ks) == 0
+
+
+def test_keyed_state_items():
+    ks = native.NativeKeyedState()
+    lo = np.array([1, 2, 3], np.uint64)
+    hi = np.array([0, 0, 0], np.uint64)
+    tok = np.array([11, 22, 33], np.uint64)
+    ks.update(lo, hi, tok, np.array([1, 1, 1], np.int64))
+    got_lo, _got_hi, got_tok = ks.items_arrays()
+    assert sorted(zip(got_lo.tolist(), got_tok.tolist())) == [(1, 11), (2, 22), (3, 33)]
+    out = ks.get(np.array([2, 9], np.uint64), np.array([0, 0], np.uint64))
+    assert out[0] == 22 and out[1] == np.iinfo(np.uint64).max
+
+
+def test_arrangement_and_delta_join():
+    arr = native.NativeArrangement()
+    arr.update(
+        np.array([7, 7, 8], np.uint64),
+        np.array([1, 2, 3], np.uint64),
+        np.array([2, 1, 1], np.int64),
+    )
+    toks, cnts = arr.get(7)
+    assert sorted(zip(toks.tolist(), cnts.tolist())) == [(1, 2), (2, 1)]
+    assert arr.group_count(7) == 3
+    # cancel an entry
+    arr.update(np.array([7], np.uint64), np.array([2], np.uint64), np.array([-1], np.int64))
+    toks, cnts = arr.get(7)
+    assert sorted(toks.tolist()) == [1]
+    idx, tok, cnt = arr.delta_join(np.array([7, 9, 8], np.uint64))
+    assert sorted(zip(idx.tolist(), tok.tolist(), cnt.tolist())) == [
+        (0, 1, 2),
+        (2, 3, 1),
+    ]
+
+
+def test_split_lines():
+    s, e = native.split_lines(b"ab\ncd\r\nef\n")
+    assert [(int(a), int(b)) for a, b in zip(s, e)] == [(0, 2), (3, 5), (7, 9)]
+    s, e = native.split_lines(b"")
+    assert len(s) == 0
+    s, e = native.split_lines(b"noeol")
+    assert [(int(a), int(b)) for a, b in zip(s, e)] == [(0, 5)]
+
+
+def test_split_csv_line():
+    assert native.split_csv_line(b"a,b,c") == ["a", "b", "c"]
+    assert native.split_csv_line(b'a,"b,c",d') == ["a", "b,c", "d"]
+    assert native.split_csv_line(b'"quoted ""x""",y') == ['quoted "x"', "y"]
+    assert native.split_csv_line(b"a,,") == ["a", "", ""]
+    assert native.split_csv_line(b"") == [""]
+
+
+def test_split_csv_records_embedded_newlines():
+    data = b'name,desc\na,"line1\nline2"\nb,plain\n'
+    s, e = native.split_csv_records(data)
+    records = [data[a:b] for a, b in zip(s, e)]
+    assert records == [b"name,desc", b'a,"line1\nline2"', b"b,plain"]
+    assert native.split_csv_line(records[1]) == ["a", "line1\nline2"]
+
+
+def test_csv_read_embedded_newline_field(tmp_path):
+    import pathway_tpu as pw
+
+    p = tmp_path / "nl.csv"
+    p.write_text('name,desc\na,"line1\nline2"\nb,plain\n')
+    t = pw.io.csv.read(
+        str(p), schema=pw.schema_from_types(name=str, desc=str), mode="static"
+    )
+    df = pw.debug.table_to_pandas(t, include_id=False).sort_values("name")
+    assert list(df.desc) == ["line1\nline2", "plain"]
+
+
+def test_csv_read_native_matches_python(tmp_path):
+    import pathway_tpu as pw
+
+    p = tmp_path / "data.csv"
+    p.write_text('word,count\nfoo,1\n"bar, baz",2\nqux,"3"\n')
+    schema = pw.schema_from_types(word=str, count=int)
+
+    t = pw.io.csv.read(str(p), schema=schema, mode="static")
+    df = pw.debug.table_to_pandas(t, include_id=False).sort_values("word")
+    assert list(df.word) == ["bar, baz", "foo", "qux"]
+    assert list(df["count"]) == [2, 1, 3]
