@@ -1,0 +1,67 @@
+"""Prometheus/OpenMetrics HTTP endpoint.
+
+Reference parity: src/engine/http_server.rs (:21-60) — one plain-HTTP
+metrics server per process at port 20000 + process_id, exposing input/output
+latency and per-operator row counters; enabled by
+`pw.run(with_http_server=True)`.
+"""
+
+from __future__ import annotations
+
+import http.server
+import os
+import threading
+import time
+from typing import Any
+
+
+def _render_metrics(session: Any, started_at: float) -> str:
+    lines = [
+        "# TYPE pathway_uptime_seconds gauge",
+        f"pathway_uptime_seconds {time.time() - started_at:.3f}",
+    ]
+    graph = getattr(session, "graph", None)
+    if graph is not None:
+        lines.append("# TYPE pathway_operator_rows_in counter")
+        lines.append("# TYPE pathway_operator_rows_out counter")
+        for node in graph.nodes:
+            name = type(node).__name__
+            nid = node.node_id
+            lines.append(
+                f'pathway_operator_rows_in{{operator="{name}",id="{nid}"}} {node.rows_in}'
+            )
+            lines.append(
+                f'pathway_operator_rows_out{{operator="{name}",id="{nid}"}} {node.rows_out}'
+            )
+        err = getattr(graph, "error_log", None)
+        if err is not None:
+            lines.append("# TYPE pathway_errors_total counter")
+            lines.append(f"pathway_errors_total {len(getattr(err, 'entries', []))}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def start_metrics_server(session: Any, port: int | None = None) -> threading.Thread:
+    if port is None:
+        process_id = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+        port = 20000 + process_id
+    started_at = time.time()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self) -> None:  # noqa: N802
+            body = _render_metrics(session, started_at).encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "application/openmetrics-text; version=1.0.0"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args: Any) -> None:  # silence request logs
+            pass
+
+    server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return thread

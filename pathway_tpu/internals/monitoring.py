@@ -1,0 +1,42 @@
+"""Console monitoring: periodic connector/operator stats.
+
+Reference parity: internals/monitoring.py (:56-190) — the rich-based TUI
+showing per-connector lag and latency. This build prints a compact stats
+line per commit wave through the standard logger (rich is optional).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+logger = logging.getLogger("pathway_tpu.monitor")
+
+
+class MonitoringLevel:
+    NONE = "none"
+    IN_OUT = "in_out"
+    ALL = "all"
+
+
+def attach_monitor(session: Any, every_n_waves: int = 50) -> None:
+    state = {"waves": 0, "t0": time.time(), "rows_at_t0": 0}
+
+    def monitor(wave_time: int) -> None:
+        state["waves"] += 1
+        if state["waves"] % every_n_waves:
+            return
+        graph = session.graph
+        rows = sum(n.rows_out for n in graph.nodes)
+        dt = time.time() - state["t0"]
+        rate = (rows - state["rows_at_t0"]) / dt if dt > 0 else 0.0
+        inputs = [n for n in graph.nodes if type(n).__name__ == "InputNode"]
+        logger.info(
+            "t=%d waves=%d operators=%d inputs=%d rows_out=%d rate=%.0f rows/s",
+            wave_time, state["waves"], len(graph.nodes), len(inputs), rows, rate,
+        )
+        state["t0"] = time.time()
+        state["rows_at_t0"] = rows
+
+    session.monitors.append(monitor)
