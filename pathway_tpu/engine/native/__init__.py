@@ -31,9 +31,32 @@ u64p = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
 i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
 
 
+def _cpu_tag() -> str:
+    """Identify the CPU the artifact was built for; -march=native output
+    must never be dlopened on a different microarchitecture (SIGILL)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            # model name (x86) / CPU part+Features (arm) identify the uarch;
+            # 'flags'/'Features' carry the ISA extensions -march=native uses.
+            lines = sorted(
+                {
+                    ln.strip()
+                    for ln in f
+                    if ln.startswith(("model name", "flags", "Features", "CPU part"))
+                }
+            )
+        if lines:
+            return hashlib.sha256("\n".join(lines).encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    import platform
+
+    return hashlib.sha256(platform.machine().encode()).hexdigest()[:8]
+
+
 def _build() -> Path | None:
     src = _HERE / "zset.cpp"
-    tag = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    tag = hashlib.sha256(src.read_bytes()).hexdigest()[:16] + "-" + _cpu_tag()
     out = _HERE / f"libzset-{tag}.so"
     if out.exists():
         return out
