@@ -1,0 +1,134 @@
+"""Fused multi-head attention kernels for the TPU numeric plane.
+
+The flagship embedder runs many short sequences (RAG chunks, seq <= 128)
+at large batch. XLA's stock lowering of that shape materializes the
+[b, h, q, k] score tensor in HBM and inserts relayout copies between the
+fused qkv projection and the per-head batched matmuls — measured ~17 ms
+per layer at (b=4096, s=64, h=6, dh=64) on v5e, ~7x the bandwidth floor.
+
+`fused_qkv_attention` is a Pallas kernel that takes the *fused* qkv
+projection output [b, s, 3*d] straight from the MXU, does the head
+split, scores, masked softmax, and value contraction entirely in VMEM,
+and writes only ctx [b, s, d] back to HBM. Traffic per call is the
+read of qkv and the write of ctx — nothing else.
+
+Reference parity: replaces the torch SDPA used by the reference's local
+embedding models (`/root/reference/python/pathway/xpacks/llm/embedders.py:270`
+runs SentenceTransformer → torch attention); this is the TPU-native
+equivalent of that hot loop.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is optional at import time (host-only wheels)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _attn_kernel(qkv_ref, bias_ref, out_ref, *, n_heads: int, head_dim: int,
+                 scale: float):
+    """One grid step: a [B, s, 3d] qkv block -> [B, s, d] context block.
+
+    Head loop is a static Python loop (n_heads is small); each head does
+    two B-batched (s x dh) matmuls with f32 accumulation and a VMEM-local
+    f32 softmax. `bias_ref` is an additive key-axis mask [B, s] (0 for
+    valid, -1e30 for padding).
+    """
+    d = n_heads * head_dim
+    qkv = qkv_ref[:]  # [B, s, 3d] bf16
+    bias = bias_ref[:]  # [B, s] f32
+    bnum = qkv.shape[0]
+    s = qkv.shape[1]
+    batch_dot = functools.partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    for hi in range(n_heads):
+        lo = hi * head_dim
+        q = qkv[:, :, lo:lo + head_dim]
+        k = qkv[:, :, d + lo:d + lo + head_dim]
+        v = qkv[:, :, 2 * d + lo:2 * d + lo + head_dim]
+        scores = batch_dot(q, k) * scale + bias[:, None, :]  # [B, s, s] f32
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        e = jnp.exp(scores - m)
+        probs = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(qkv.dtype)
+        # ctx: [B, s, dh] — contraction over the key axis
+        ctx = jax.lax.dot_general(
+            probs, v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        out_ref[:, :, lo:lo + head_dim] = ctx.astype(out_ref.dtype)
+
+
+def fused_qkv_attention(
+    qkv: jax.Array,  # [b, s, 3*d] fused projection output
+    token_mask: jax.Array,  # [b, s] 1/0
+    n_heads: int,
+    *,
+    block_b: int = 16,
+    interpret: bool = False,
+) -> jax.Array:
+    """Bidirectional MHA over a fused qkv tensor; returns ctx [b, s, d].
+
+    VMEM per grid step ~ block_b * s * 3d * 2B; default block_b=16 at
+    (s=64, d=384) is ~2.4 MB. Falls back to `reference_attention` when
+    pallas is unavailable.
+    """
+    b, s, d3 = qkv.shape
+    d = d3 // 3
+    head_dim = d // n_heads
+    scale = 1.0 / math.sqrt(head_dim)
+    if not _HAS_PALLAS:
+        return reference_attention(qkv, token_mask, n_heads)
+    while b % block_b != 0:
+        block_b //= 2
+    bias = jnp.where(token_mask == 0, -1e30, 0.0).astype(jnp.float32)
+    kernel = functools.partial(
+        _attn_kernel, n_heads=n_heads, head_dim=head_dim, scale=scale
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(b // block_b,),
+        in_specs=[
+            pl.BlockSpec((block_b, s, d3), lambda i: (i, 0, 0)),
+            pl.BlockSpec((block_b, s), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_b, s, d), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, s, d), qkv.dtype),
+        interpret=interpret,
+    )(qkv, bias)
+
+
+def reference_attention(
+    qkv: jax.Array, token_mask: jax.Array, n_heads: int
+) -> jax.Array:
+    """Plain-XLA einsum attention over the same fused-qkv contract —
+    the CPU/fallback path and the numerical reference for tests."""
+    b, s, d3 = qkv.shape
+    d = d3 // 3
+    dh = d // n_heads
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, n_heads, dh)
+    k = k.reshape(b, s, n_heads, dh)
+    v = v.reshape(b, s, n_heads, dh)
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(dh)
+    scores = jnp.where(token_mask[:, None, None, :] == 0, -1e30, scores)
+    probs = jax.nn.softmax(scores, axis=-1).astype(qkv.dtype)
+    ctx = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
+    ).astype(qkv.dtype)
+    return ctx.reshape(b, s, d)

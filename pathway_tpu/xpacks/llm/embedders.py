@@ -228,7 +228,11 @@ class JaxEmbedder(BaseEmbedder):
         )
         if params is None:
             params = transformer.init_params(jax.random.PRNGKey(0), self.config)
-        self.params = jax.device_put(params)
+        # serving keeps bf16-resident params (half the HBM weight reads;
+        # no per-matmul casts inside the jitted program)
+        self.params = jax.device_put(
+            transformer.cast_params(params, self.config.dtype)
+        )
         self.tokenizer = tokenizer or HashTokenizer(
             vocab_size=self.config.vocab_size, max_len=self.config.max_len
         )

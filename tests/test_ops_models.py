@@ -201,3 +201,36 @@ def test_param_sharding_specs_cover_params():
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
     specs = tfm.param_specs(cfg)
     jax.tree.map(lambda p, s: None, params, specs)  # same treedef or raises
+
+
+def test_fused_qkv_attention_matches_reference():
+    """Pallas kernel (interpret mode on CPU) == einsum reference,
+    including key-padding masks."""
+    from pathway_tpu.ops.attention import fused_qkv_attention, reference_attention
+
+    rng = np.random.default_rng(0)
+    b, s, d, h = 8, 16, 32, 4
+    qkv = jnp.asarray(rng.normal(size=(b, s, 3 * d)), jnp.float32)
+    mask = jnp.asarray(
+        (np.arange(s)[None, :] < rng.integers(1, s + 1, (b, 1))), jnp.int32
+    )
+    ref = reference_attention(qkv, mask, h)
+    out = fused_qkv_attention(qkv, mask, h, block_b=4, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-5)
+
+
+def test_cast_params_bf16():
+    from pathway_tpu.models import transformer as tfm
+
+    cfg = tfm.embedder_config(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=8
+    )
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    cast = tfm.cast_params(params)
+    assert cast["tok_embed"].dtype == jnp.bfloat16
+    assert cast["blocks"][0]["qkv"].dtype == jnp.bfloat16
+    # encode works on the cast tree
+    ids = jnp.zeros((2, 8), jnp.int32)
+    m = jnp.ones((2, 8), jnp.int32)
+    out = tfm.encode(cast, ids, m, cfg)
+    assert out.shape == (2, 32)
