@@ -52,7 +52,21 @@ def freeze_row(row: tuple) -> tuple:
 
 
 def consolidate(entries: Iterable[Entry]) -> list[Entry]:
-    """Sum diffs of identical (key, row) pairs; drop zeros."""
+    """Sum diffs of identical (key, row) pairs; drop zeros.
+
+    Fast path: a batch whose keys are all distinct with diff=1 (the shape
+    every static ingest and reindex produces) is already consolidated —
+    detecting that needs only integer set inserts, not row freezing.
+    """
+    if not isinstance(entries, list):
+        entries = list(entries)
+    seen: set[int] = set()
+    for key, _row, diff in entries:
+        if diff != 1 or key.value in seen:
+            break
+        seen.add(key.value)
+    else:
+        return entries
     acc: dict[tuple, tuple[Key, tuple, int]] = {}
     for key, row, diff in entries:
         token = (key.value, freeze_row(row))
@@ -62,6 +76,39 @@ def consolidate(entries: Iterable[Entry]) -> list[Entry]:
         else:
             acc[token] = (key, row, diff)
     return [(k, r, d) for (k, r, d) in acc.values() if d != 0]
+
+
+def rows_equal(a: tuple, b: tuple) -> bool:
+    """Row equality without the double `freeze_row` round-trip.
+
+    Plain tuple comparison covers the hashable common case. Rows holding
+    ndarrays always go through the frozen comparison: tuple.__eq__ on a
+    size-1 array truth-tests the elementwise result, which would treat
+    dtype/shape changes preserving the value as equal (the frozen form
+    compares dtype + shape + bytes).
+    """
+    for v in a:
+        if isinstance(v, np.ndarray):
+            return freeze_row(a) == freeze_row(b)
+    try:
+        return bool(a == b)
+    except (ValueError, TypeError):
+        return freeze_row(a) == freeze_row(b)
+
+
+def delta_emit(
+    emitted: dict[Key, tuple], out: list[Entry], key: Key, new: tuple | None
+) -> None:
+    """Retract-old / emit-new bookkeeping shared by every keyed node:
+    compares the previously emitted row for `key` against `new` (None =
+    key gone) and appends the retraction/insertion entries to `out`."""
+    old = emitted.get(key)
+    if old is not None and (new is None or not rows_equal(old, new)):
+        out.append((key, old, -1))
+        del emitted[key]
+    if new is not None and (old is None or not rows_equal(old, new)):
+        out.append((key, new, 1))
+        emitted[key] = new
 
 
 class KeyedState:
@@ -78,7 +125,7 @@ class KeyedState:
                 self.rows[key] = row
             elif diff < 0:
                 existing = self.rows.get(key)
-                if existing is not None and freeze_row(existing) == freeze_row(row):
+                if existing is not None and rows_equal(existing, row):
                     del self.rows[key]
 
     def get(self, key: Key) -> tuple | None:
@@ -273,15 +320,9 @@ class RowwiseNode(Node):
         main_state.update(main)
         out: list[Entry] = []
         for key in affected:
-            old = self.emitted.get(key)
             row0 = main_state.get(key)
             new = self._compute(key, row0) if row0 is not None else None
-            if old is not None and (new is None or freeze_row(old) != freeze_row(new)):
-                out.append((key, old, -1))
-                del self.emitted[key]
-            if new is not None and (old is None or freeze_row(old) != freeze_row(new)):
-                out.append((key, new, 1))
-                self.emitted[key] = new
+            delta_emit(self.emitted, out, key, new)
         self.emit(time, out)
 
     def _main_state(self) -> KeyedState:
@@ -413,14 +454,7 @@ class SetOpNode(Node):
         for key in affected:
             row = self.main.get(key)
             present = row is not None and self._present(key)
-            old = self.emitted.get(key)
-            new = row if present else None
-            if old is not None and (new is None or freeze_row(old) != freeze_row(new)):
-                out.append((key, old, -1))
-                del self.emitted[key]
-            if new is not None and (old is None or freeze_row(old) != freeze_row(new)):
-                out.append((key, new, 1))
-                self.emitted[key] = new
+            delta_emit(self.emitted, out, key, row if present else None)
         self.emit(time, out)
 
 
@@ -447,13 +481,7 @@ class UpdateRowsNode(Node):
             new = self.right.get(key)
             if new is None:
                 new = self.left.get(key)
-            old = self.emitted.get(key)
-            if old is not None and (new is None or freeze_row(old) != freeze_row(new)):
-                out.append((key, old, -1))
-                del self.emitted[key]
-            if new is not None and (old is None or freeze_row(old) != freeze_row(new)):
-                out.append((key, new, 1))
-                self.emitted[key] = new
+            delta_emit(self.emitted, out, key, new)
         self.emit(time, out)
 
 
@@ -490,13 +518,7 @@ class UpdateCellsNode(Node):
                         rrow[m] if m is not None else lrow[i]
                         for i, m in enumerate(self.col_map)
                     )
-            old = self.emitted.get(key)
-            if old is not None and (new is None or freeze_row(old) != freeze_row(new)):
-                out.append((key, old, -1))
-                del self.emitted[key]
-            if new is not None and (old is None or freeze_row(old) != freeze_row(new)):
-                out.append((key, new, 1))
-                self.emitted[key] = new
+            delta_emit(self.emitted, out, key, new)
         self.emit(time, out)
 
 
@@ -684,6 +706,8 @@ class GroupByNode(Node):
     Output row = group_values_tuple + (reduced values...).
     """
 
+    _NATIVE_KINDS = {"count": 0, "sum": 1, "avg": 2}
+
     def __init__(
         self,
         graph: Graph,
@@ -692,19 +716,122 @@ class GroupByNode(Node):
         reducers: list[Any],
         arg_fns: list[Callable],
         set_id: bool = False,
+        native_ok: bool = True,
     ):
         super().__init__(graph, [inp])
         self.gk_fn = gk_fn
         self.reducers = reducers
         self.arg_fns = arg_fns
-        self.state = MultisetState()  # gkey -> {token: ((gvals, args...), count)}
-        self.gkeys: dict[Any, tuple[Key, tuple]] = {}  # frozen gval -> (Key, gvals)
         self.emitted: dict[Key, tuple] = {}
-        self.stateful_state: dict[Any, list[Any]] = {}
+        # Native semigroup hot path (C++ zs_agg): all-invertible reducer
+        # sets are delta-aggregated in O(batch) without maintaining the
+        # per-group multiset in Python. `native_ok=False` forces the
+        # Python path when argument dtypes aren't provably scalar numeric
+        # (lowering decides; ndarray sums etc. need the generic reducers).
+        # Reference: semigroup reducer dispatch, src/engine/reduce.rs:40
+        # + dataflow.rs:2715.
+        self._native = None
+        if native_ok and all(
+            type(r).__name__ in ("CountReducer", "SumReducer", "AvgReducer")
+            for r in reducers
+        ):
+            from pathway_tpu.engine import native as _nat
+
+            if _nat.available():
+                self._native = _nat.NativeGroupAgg(
+                    [self._NATIVE_KINDS[r.name] for r in reducers]
+                )
+                self._gid_by_token: dict[Any, int] = {}
+                self._ginfo: list[tuple[Key, tuple]] = []
+        if self._native is None:
+            self.state = MultisetState()  # gkey -> {token: ((gvals,args),cnt)}
+            self.gkeys: dict[Any, tuple[Key, tuple]] = {}  # fzn gval->(Key,gvals)
+            self.stateful_state: dict[Any, list[Any]] = {}
+
+    def _finish_native(self, time: int, entries: list[Entry]) -> None:
+        n = len(entries)
+        n_red = len(self.reducers)
+        gtok = np.empty(n, np.uint64)
+        diffs = np.empty(n, np.int64)
+        vals_i = np.zeros((n_red, n), np.int64)
+        vals_f = np.zeros((n_red, n), np.float64)
+        tags = np.zeros((n_red, n), np.uint8)
+        keep = 0
+        for key, row, diff in entries:
+            try:
+                gvals = self.gk_fn(key, row)
+            except Exception as e:  # noqa: BLE001
+                self.graph.log_error(f"groupby key: {type(e).__name__}: {e}")
+                continue
+            ftok = freeze_value(gvals)
+            gid = self._gid_by_token.get(ftok)
+            if gid is None:
+                gid = len(self._ginfo)
+                self._gid_by_token[ftok] = gid
+                self._ginfo.append((key_for_values(*gvals), gvals))
+            gtok[keep] = gid
+            diffs[keep] = diff
+            for ri, red in enumerate(self.reducers):
+                if red.n_args == 0:
+                    continue  # count: tag 0, value unused
+                try:
+                    v = self.arg_fns[ri](key, row, time)[0]
+                except Exception as e:  # noqa: BLE001
+                    self.graph.log_error(f"reducer arg: {type(e).__name__}: {e}")
+                    v = ERROR
+                if isinstance(v, (bool, np.bool_, int, np.integer)):
+                    try:
+                        vals_i[ri, keep] = int(v)
+                    except OverflowError:
+                        # outside the kernel's i64 domain (the reference's
+                        # Rust IntSum is i64 too) — poison, don't wrap
+                        tags[ri, keep] = 2
+                elif isinstance(v, (float, np.floating)):
+                    vals_f[ri, keep] = float(v)
+                    tags[ri, keep] = 1
+                else:
+                    tags[ri, keep] = 2  # ERROR / None / non-numeric
+            keep += 1
+        if not keep:
+            return
+        g_ids, totals, isum, fsum, cnts, flags = self._native.update(
+            gtok[:keep], vals_i[:, :keep], vals_f[:, :keep],
+            tags[:, :keep], diffs[:keep],
+        )
+        out: list[Entry] = []
+        for j in range(len(g_ids)):
+            gkey, gvals = self._ginfo[int(g_ids[j])]
+            if totals[j] == 0:
+                new = None
+            else:
+                vals = []
+                for ri, red in enumerate(self.reducers):
+                    fl = int(flags[j, ri])
+                    if red.name == "count":
+                        vals.append(int(totals[j]))
+                    elif fl & 2:
+                        vals.append(ERROR)
+                    elif red.name == "sum":
+                        if fl & 1:
+                            vals.append(float(isum[j, ri]) + float(fsum[j, ri]))
+                        else:
+                            vals.append(int(isum[j, ri]))
+                    else:  # avg
+                        c = int(cnts[j, ri])
+                        vals.append(
+                            (float(isum[j, ri]) + float(fsum[j, ri])) / c
+                            if c else None
+                        )
+                new = tuple(gvals) + tuple(vals)
+            delta_emit(self.emitted, out, gkey, new)
+        self.emit(time, out)
 
     def finish_time(self, time: int) -> None:
         entries = self.take_input()
         if not entries:
+            return
+        if self._native is not None:
+            self._finish_native(time, entries)
             return
         affected: dict[Any, None] = {}
         batch_per_group: dict[Any, list[tuple[tuple, int]]] = defaultdict(list)
@@ -734,7 +861,6 @@ class GroupByNode(Node):
             entries_now = self.state.get(token_g)
             from pathway_tpu.internals.reducers import StatefulReducer
 
-            old = self.emitted.get(gkey)
             if not entries_now and not any(
                 isinstance(r, StatefulReducer) for r in self.reducers
             ):
@@ -758,12 +884,7 @@ class GroupByNode(Node):
                 new = tuple(gvals) + tuple(vals)
                 if not entries_now:
                     new = None
-            if old is not None and (new is None or freeze_row(old) != freeze_row(new)):
-                out.append((gkey, old, -1))
-                del self.emitted[gkey]
-            if new is not None and (old is None or freeze_row(old) != freeze_row(new)):
-                out.append((gkey, new, 1))
-                self.emitted[gkey] = new
+            delta_emit(self.emitted, out, gkey, new)
         self.emit(time, out)
 
 
@@ -880,10 +1001,10 @@ class IxNode(Node):
                 else:
                     new = trow
                 old = self.emitted.get(skey)
-                if old is not None and (new is None or freeze_row(old) != freeze_row(new)):
+                if old is not None and (new is None or not rows_equal(old, new)):
                     out.append((skey, old, -1))
                     del self.emitted[skey]
-                if new is not None and c > 0 and (old is None or freeze_row(old) != freeze_row(new)):
+                if new is not None and c > 0 and (old is None or not rows_equal(old, new)):
                     out.append((skey, new, 1))
                     self.emitted[skey] = new
                 if c <= 0 and old is not None:
@@ -930,9 +1051,9 @@ class SortNode(Node):
                 nxt = ordered[i + 1][0] if i + 1 < len(ordered) else None
                 new = (prev, nxt)
                 old = self.emitted.get(key)
-                if old is not None and freeze_row(old) != freeze_row(new):
+                if old is not None and not rows_equal(old, new):
                     out.append((key, old, -1))
-                if old is None or freeze_row(old) != freeze_row(new):
+                if old is None or not rows_equal(old, new):
                     out.append((key, new, 1))
                     self.emitted[key] = new
             # retractions for keys that left the group

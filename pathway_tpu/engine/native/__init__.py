@@ -29,6 +29,8 @@ _TRIED = False
 
 u64p = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
 i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
 
 
 def _cpu_tag() -> str:
@@ -123,6 +125,16 @@ def _load() -> ctypes.CDLL | None:
         lib.zs_arr_delta_join.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, u64p, ctypes.c_int64, i64p, u64p, i64p,
         ]
+        lib.zs_agg_new.restype = ctypes.c_void_p
+        lib.zs_agg_new.argtypes = [ctypes.c_int64, i64p]
+        lib.zs_agg_free.argtypes = [ctypes.c_void_p]
+        lib.zs_agg_update.restype = ctypes.c_int64
+        lib.zs_agg_update.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, u64p, i64p, f64p, u8p, i64p,
+            u64p, i64p, i64p, f64p, i64p, u8p,
+        ]
+        lib.zs_agg_len.restype = ctypes.c_int64
+        lib.zs_agg_len.argtypes = [ctypes.c_void_p]
         lib.zs_split_lines.restype = ctypes.c_int64
         lib.zs_split_lines.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p, i64p,
@@ -233,6 +245,73 @@ class NativeArrangement:
             if m >= 0:
                 return idx[:m], tok[:m], cnt[:m]
             cap = -m
+
+
+class NativeGroupAgg:
+    """C++ semigroup aggregation: gtoken -> per-reducer (isum, fsum, cnt).
+
+    The engine's native groupby hot path for invertible reducers
+    (count/sum/avg). `update` applies a batch and returns the affected
+    groups' post-update aggregates; work is O(batch), independent of
+    group sizes. Flags per (group, reducer): bit0 = saw float
+    contributions, bit1 = has non-numeric rows (ERROR poison).
+    """
+
+    KIND_COUNT = 0
+    KIND_SUM = 1
+    KIND_AVG = 2
+
+    def __init__(self, kinds: list[int]) -> None:
+        lib = _load()
+        assert lib is not None
+        self._lib = lib
+        self._n_red = len(kinds)
+        self._h = lib.zs_agg_new(
+            len(kinds), np.asarray(kinds, np.int64)
+        )
+
+    def __del__(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.zs_agg_free(self._h)
+            self._h = None
+
+    def update(
+        self,
+        gtoken: np.ndarray,  # [n] uint64
+        vals_i: np.ndarray,  # [n_red, n] int64
+        vals_f: np.ndarray,  # [n_red, n] float64
+        vals_tag: np.ndarray,  # [n_red, n] uint8: 0=int 1=float 2=bad
+        diff: np.ndarray,  # [n] int64
+    ):
+        """Returns (gtokens[m], totals[m], isum[m,R], fsum[m,R], cnt[m,R],
+        flags[m,R]) for the affected unique groups."""
+        n = len(gtoken)
+        r = self._n_red
+        out_g = np.empty(n, np.uint64)
+        out_total = np.empty(n, np.int64)
+        out_i = np.empty(max(n * r, 1), np.int64)
+        out_f = np.empty(max(n * r, 1), np.float64)
+        out_cnt = np.empty(max(n * r, 1), np.int64)
+        out_flags = np.empty(max(n * r, 1), np.uint8)
+        m = self._lib.zs_agg_update(
+            self._h, n, gtoken,
+            np.ascontiguousarray(vals_i.reshape(-1)),
+            np.ascontiguousarray(vals_f.reshape(-1)),
+            np.ascontiguousarray(vals_tag.reshape(-1)),
+            diff,
+            out_g, out_total, out_i, out_f, out_cnt, out_flags,
+        )
+        return (
+            out_g[:m],
+            out_total[:m],
+            out_i[: m * r].reshape(m, r),
+            out_f[: m * r].reshape(m, r),
+            out_cnt[: m * r].reshape(m, r),
+            out_flags[: m * r].reshape(m, r),
+        )
+
+    def __len__(self) -> int:
+        return self._lib.zs_agg_len(self._h)
 
 
 def split_lines(data: bytes):

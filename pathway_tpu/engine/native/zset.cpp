@@ -214,6 +214,137 @@ int64_t zs_arr_delta_join(void* other_handle, int64_t n, const uint64_t* dkey,
     return (m <= cap) ? m : -m;
 }
 
+}  // extern "C"
+
+// ---------------------------------------------------------- group reduce
+//
+// Semigroup aggregation: the engine's groupby hot path for invertible
+// reducers (count / sum / avg). Mirrors differential's semigroup reducer
+// dispatch (/root/reference/src/engine/reduce.rs:40 `ReducerImpl` vs
+// `SemigroupReducerImpl`, applied at dataflow.rs:2715) — per-group
+// aggregates are delta-updated in O(batch), never recomputed from the
+// group's full multiset.
+//
+// Value model per (group, reducer): exact int64 sum, double sum, row
+// count, and an `err` count of rows whose argument was not numeric
+// (ERROR poison, None, strings). Bad rows never enter the sums, so when
+// they are retracted the aggregate recovers exactly — same observable
+// behavior as the Python recompute path.
+
+namespace {
+
+struct GroupAgg {
+    int64_t n_red;
+    std::vector<int64_t> kinds;  // 0=count, 1=sum, 2=avg (kind semantics live in Python)
+    struct Slot {
+        int64_t isum = 0;
+        double fsum = 0.0;
+        int64_t cnt = 0;    // sum of diffs of rows contributing to this reducer
+        int64_t fseen = 0;  // count of float-typed contributions (for int/float result typing)
+        int64_t err = 0;    // rows with non-numeric argument
+        // i64 aggregate overflow is unrecoverable (the pre-overflow value
+        // is lost), so it poisons the slot permanently -> ERROR output
+        // rather than a silently wrapped sum.
+        uint8_t overflow = 0;
+    };
+    struct G {
+        int64_t total = 0;  // sum of diffs over the group (row count)
+        std::vector<Slot> slots;
+    };
+    std::unordered_map<uint64_t, G> groups;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* zs_agg_new(int64_t n_red, const int64_t* kinds) {
+    auto* h = new GroupAgg();
+    h->n_red = n_red;
+    h->kinds.assign(kinds, kinds + n_red);
+    return h;
+}
+
+void zs_agg_free(void* h) { delete static_cast<GroupAgg*>(h); }
+
+// Batch update. Value arrays are reducer-major: vals_*[r * n + i].
+// vals_tag: 0 = int64 contribution (vals_i), 1 = double (vals_f),
+// 2 = non-numeric (error bucket). Writes the affected unique groups'
+// post-update state to the out arrays (out_* are [m * n_red] reducer-minor
+// per group; out_g/out_total are [m]); returns m <= n.
+int64_t zs_agg_update(void* h, int64_t n, const uint64_t* gtoken,
+                      const int64_t* vals_i, const double* vals_f,
+                      const uint8_t* vals_tag, const int64_t* diff,
+                      uint64_t* out_g, int64_t* out_total, int64_t* out_i,
+                      double* out_f, int64_t* out_cnt, uint8_t* out_flags) {
+    auto* agg = static_cast<GroupAgg*>(h);
+    const int64_t r_n = agg->n_red;
+    std::vector<uint64_t> order;
+    order.reserve(16);
+    std::unordered_map<uint64_t, char> seen;
+    seen.reserve(16);
+    for (int64_t i = 0; i < n; ++i) {
+        auto& g = agg->groups[gtoken[i]];
+        if (g.slots.empty()) g.slots.resize(static_cast<size_t>(r_n));
+        g.total += diff[i];
+        for (int64_t r = 0; r < r_n; ++r) {
+            auto& s = g.slots[static_cast<size_t>(r)];
+            const int64_t j = r * n + i;
+            switch (vals_tag[j]) {
+                case 0: {
+                    int64_t term, next;
+                    if (__builtin_mul_overflow(vals_i[j], diff[i], &term) ||
+                        __builtin_add_overflow(s.isum, term, &next)) {
+                        s.overflow = 1;
+                    } else {
+                        s.isum = next;
+                    }
+                    s.cnt += diff[i];
+                    break;
+                }
+                case 1:
+                    s.fsum += vals_f[j] * diff[i];
+                    s.cnt += diff[i];
+                    s.fseen += diff[i];
+                    break;
+                default:
+                    s.err += diff[i];
+                    break;
+            }
+        }
+        if (!seen.count(gtoken[i])) {
+            seen.emplace(gtoken[i], 1);
+            order.push_back(gtoken[i]);
+        }
+    }
+    int64_t m = 0;
+    for (uint64_t gt : order) {
+        auto it = agg->groups.find(gt);
+        out_g[m] = gt;
+        if (it == agg->groups.end()) {
+            out_total[m] = 0;
+        } else {
+            auto& g = it->second;
+            out_total[m] = g.total;
+            for (int64_t r = 0; r < r_n; ++r) {
+                auto& s = g.slots[static_cast<size_t>(r)];
+                out_i[m * r_n + r] = s.isum;
+                out_f[m * r_n + r] = s.fsum;
+                out_cnt[m * r_n + r] = s.cnt;
+                out_flags[m * r_n + r] = static_cast<uint8_t>(
+                    ((s.err != 0 || s.overflow) ? 2 : 0) | (s.fseen != 0 ? 1 : 0));
+            }
+            if (g.total == 0) agg->groups.erase(it);
+        }
+        ++m;
+    }
+    return m;
+}
+
+int64_t zs_agg_len(void* h) {
+    return static_cast<int64_t>(static_cast<GroupAgg*>(h)->groups.size());
+}
+
 // --------------------------------------------------------- line tokenizer
 
 // Splits a byte buffer into lines; writes (start, end) offsets per line,

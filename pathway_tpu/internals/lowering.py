@@ -426,8 +426,40 @@ class Session:
                 ))(per_arg)
             )
 
+        # The native semigroup kernel holds int64/double aggregates; only
+        # hand it reducers whose argument dtypes are provably scalar
+        # numeric (ndarray sums, durations, Json etc. keep the Python
+        # recompute path, which supports them).
+        from pathway_tpu.internals import dtype as dt
+        from pathway_tpu.internals.expression import IdReference
+        from pathway_tpu.internals.type_interpreter import infer_dtype
+
+        def _ref_dtype(ref) -> dt.DType:
+            if isinstance(ref, IdReference) or ref.name == "id":
+                return dt.ANY_POINTER
+            return main._dtype_of(ref.name)
+
+        def _scalar_numeric(re_) -> bool:
+            for a in re_._args:
+                if isinstance(a, _EngineTimeMarker):
+                    continue
+                try:
+                    got = infer_dtype(a, _ref_dtype)
+                except Exception:  # noqa: BLE001 - unresolvable -> not provable
+                    return False
+                # exact match only: Optional columns can hold None at
+                # runtime, which the kernel has no clean story for
+                if got not in (dt.INT, dt.FLOAT, dt.BOOL):
+                    return False
+            return True
+
+        native_ok = all(
+            getattr(re_._reducer, "n_args", 1) == 0 or _scalar_numeric(re_)
+            for re_ in reducer_exprs
+        )
         gnode = eng.GroupByNode(
-            self.graph, self.node_of(main), gk_fn, reducers, arg_fns
+            self.graph, self.node_of(main), gk_fn, reducers, arg_fns,
+            native_ok=native_ok,
         )
         # post-processing rowwise over (gvals..., rvals...)
         reducer_slots = {
