@@ -912,6 +912,11 @@ class DeduplicateNode(Node):
         entries = self.take_input()
         if not entries:
             return
+        # canonical within-wave order: batches arrive shard-concatenated
+        # under multi-worker execution, so order-sensitive acceptance must
+        # not depend on arrival order inside one timestamp (worker-count
+        # invariance; engine/workers.py). Across waves, time order rules.
+        entries = sorted(entries, key=lambda e: e[0].value)
         out: list[Entry] = []
         for key, row, diff in entries:
             if diff <= 0:
@@ -1138,11 +1143,18 @@ class BufferNode(Node):
 
     def finish_time(self, time: int) -> None:
         entries = self.take_input()
-        out: list[Entry] = []
-        for key, row, diff in entries:
+        if not entries:
+            return
+        # The watermark ("now") advances once per wave, not per row: every
+        # row in a wave sees the same frontier regardless of batch order
+        # (worker-count invariance; matches the reference's per-timestamp
+        # frontier in time_column.rs — the frontier moves between batches).
+        for key, row, _diff in entries:
             cur = self.current_fn(key, row)
             if self.now is None or cur > self.now:
                 self.now = cur
+        out: list[Entry] = []
+        for key, row, diff in entries:
             thr = self.threshold_fn(key, row)
             if key.value in self.released or (self.now is not None and thr <= self.now):
                 self.released.add(key.value)
@@ -1191,13 +1203,17 @@ class ForgetNode(Node):
 
     def finish_time(self, time: int) -> None:
         entries = self.take_input()
+        if not entries:
+            return
+        # Late-row checks use the PREVIOUS wave's watermark; the watermark
+        # advances once at the end of the wave (order/worker-count
+        # invariant — the reference's frontier moves between batches,
+        # time_column.rs forget:566 + ignore_late:677).
+        now0 = self.now
         out: list[Entry] = []
         for key, row, diff in entries:
-            cur = self.current_fn(key, row)
-            if self.now is None or cur > self.now:
-                self.now = cur
             thr = self.threshold_fn(key, row)
-            if self.now is not None and thr <= self.now and diff > 0:
+            if now0 is not None and thr <= now0 and diff > 0:
                 # late row: ignore
                 continue
             out.append((key, row, diff))
@@ -1205,7 +1221,11 @@ class ForgetNode(Node):
                 self.live[key] = (row, thr)
             else:
                 self.live.pop(key, None)
-        # retract rows that have fallen behind the threshold
+        for key, row, _diff in entries:
+            cur = self.current_fn(key, row)
+            if self.now is None or cur > self.now:
+                self.now = cur
+        # retract rows that have fallen behind the advanced threshold
         if self.now is not None:
             expired = [k for k, (_r, thr) in self.live.items() if thr <= self.now]
             for k in expired:
@@ -1232,15 +1252,21 @@ class FreezeNode(Node):
 
     def finish_time(self, time: int) -> None:
         entries = self.take_input()
+        if not entries:
+            return
+        # freeze checks use the previous wave's watermark; advance at wave
+        # end (order/worker-count invariant; see ForgetNode)
+        now0 = self.now
         out: list[Entry] = []
         for key, row, diff in entries:
-            cur = self.current_fn(key, row)
             thr = self.threshold_fn(key, row)
-            if self.now is not None and thr <= self.now:
+            if now0 is not None and thr <= now0:
                 continue  # frozen region: drop the change
+            out.append((key, row, diff))
+        for key, row, _diff in out:  # only accepted rows advance the clock
+            cur = self.current_fn(key, row)
             if self.now is None or cur > self.now:
                 self.now = cur
-            out.append((key, row, diff))
         self.emit(time, consolidate(out))
 
 
@@ -1267,6 +1293,8 @@ class GradualBroadcastNode(Node):
         if not bb and not sb:
             return
         new_value = self.current[1] if self.current else None
+        # canonical order within the wave (worker-count invariance)
+        sb = sorted(sb, key=lambda e: e[0].value)
         for key, row, diff in sb:
             if diff > 0:
                 lower, value, upper = self.lvu_fn(key, row)

@@ -1,0 +1,181 @@
+"""Multi-worker execution: sharded operators + the wave-boundary exchange.
+
+Reference parity: the reference runs N timely workers, each building the
+same dataflow, with records hash-exchanged between workers on every
+stateful operator's key (docs 10.worker-architecture.md:37-43,
+src/engine/dataflow/shard.rs `Shard` impls; the exchange pact comes from
+vendored timely). Here the same model is expressed per-operator: a
+`ShardedNode` owns N replicas ("workers") of a stateful node, each holding
+the shard of that node's state for the keys routed to it. At every wave
+boundary the node's input batches are exchanged — partitioned by the
+operator's shard key (record key for keyed nodes, join key for joins,
+group key for reductions) — and the replicas run concurrently on the
+worker pool. Worker-count invariance holds because routing partitions
+exactly along each operator's state key: every group/jk/key sees all its
+entries in one replica, in arrival order.
+
+Threads, not processes, execute the replicas (PATHWAY_THREADS=N): pure
+Python sections serialize on the GIL, but the native kernel hot paths
+(zs_agg groupby aggregation, tokenizers — ctypes calls release the GIL)
+and any numeric-plane JAX dispatches genuinely parallelize. The
+TPU-mesh exchange primitive for numeric columns is
+`pathway_tpu.parallel.exchange` (an `all_to_all` over ICI); this module is
+the host-side control-plane equivalent for arbitrary Python rows.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Sequence
+
+from pathway_tpu.engine.core import Entry, Graph, InputNode, Node
+
+# Route functions map (key, row) -> an int or hashable token; the shard is
+# token % n_shards (ints, e.g. Key.value) or hash(token) % n_shards.
+RouteFn = Callable[[Any, tuple], Any]
+
+_POOL: ThreadPoolExecutor | None = None
+_POOL_LOCK = threading.Lock()
+
+
+def worker_threads() -> int:
+    """PATHWAY_THREADS, read per-session so tests can flip it in-process."""
+    try:
+        return max(1, int(os.environ.get("PATHWAY_THREADS", "1")))
+    except ValueError:
+        return 1
+
+
+def _pool() -> ThreadPoolExecutor:
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = ThreadPoolExecutor(
+                max_workers=max(4, (os.cpu_count() or 1)),
+                thread_name_prefix="pw-worker",
+            )
+    return _POOL
+
+
+class _Collector:
+    """Duck-typed downstream sink capturing one replica's emits."""
+
+    __slots__ = ("entries",)
+
+    def __init__(self) -> None:
+        self.entries: list[Entry] = []
+
+    def accept(self, input_idx: int, entries: list[Entry]) -> None:
+        self.entries.extend(entries)
+
+    def take(self) -> list[Entry]:
+        out, self.entries = self.entries, []
+        return out
+
+
+def _shard_of(token: Any, n: int) -> int:
+    if isinstance(token, int):
+        return token % n
+    return hash(token) % n
+
+
+class ShardedNode(Node):
+    """N replicas of a stateful node, each owning one key-range shard.
+
+    `factory(graph, input_nodes) -> Node` builds one replica; replicas are
+    constructed against a private throwaway graph (never stepped) with
+    dummy inputs, and their emits are captured by per-replica collectors.
+    `route_fns[i]` gives the shard key for entries arriving on input i.
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        inputs: Sequence[Node],
+        factory: Callable[[Graph, list[Node]], Node],
+        route_fns: Sequence[RouteFn],
+        n_shards: int,
+    ):
+        super().__init__(graph, inputs)
+        assert len(route_fns) == len(inputs)
+        self.route_fns = list(route_fns)
+        self.n_shards = n_shards
+        self.replicas: list[Node] = []
+        self.collectors: list[_Collector] = []
+        for _ in range(n_shards):
+            shadow = Graph()
+            shadow.terminate_on_error = graph.terminate_on_error
+            dummies = [InputNode(shadow) for _ in inputs]
+            replica = factory(shadow, list(dummies))
+            collector = _Collector()
+            replica.downstream = [(collector, 0)]  # type: ignore[list-item]
+            self.replicas.append(replica)
+            self.collectors.append(collector)
+
+    # -------------------------------------------------------------- exchange
+
+    def _exchange(self, input_idx: int, entries: list[Entry]) -> list[int]:
+        """Partition one input batch across replicas by the shard key.
+
+        Returns the list of replica ids that received data. Entries whose
+        route function fails go to shard 0 (the replica re-evaluates the
+        same expression and logs the error through the normal path).
+        """
+        n = self.n_shards
+        route = self.route_fns[input_idx]
+        buckets: list[list[Entry]] = [[] for _ in range(n)]
+        for entry in entries:
+            key, row, _diff = entry
+            try:
+                s = _shard_of(route(key, row), n)
+            except Exception:  # noqa: BLE001 - replica will log it
+                s = 0
+            buckets[s].append(entry)
+        touched = []
+        for s in range(n):
+            if buckets[s]:
+                self.replicas[s].accept(input_idx, buckets[s])
+                touched.append(s)
+        return touched
+
+    def finish_time(self, time: int) -> None:
+        active: set[int] = set()
+        for i in range(len(self.inputs)):
+            batch = self.take_input(i)
+            if batch:
+                active.update(self._exchange(i, batch))
+        out: list[Entry] = []
+        if active:
+            ordered = sorted(active)
+            if len(ordered) == 1:
+                self.replicas[ordered[0]].finish_time(time)
+            else:
+                futures = [
+                    _pool().submit(self.replicas[s].finish_time, time)
+                    for s in ordered
+                ]
+                for f in futures:
+                    f.result()  # wave barrier; re-raises replica errors
+            for s in ordered:
+                out.extend(self.collectors[s].take())
+        if out:
+            self.emit(time, out)
+
+    def on_end(self, time: int) -> None:
+        # Graph.end runs on_end then finish_time per node in topo order, so
+        # emitting here still reaches downstream buffers before they close.
+        # (No sharded node type currently implements on_end; this keeps the
+        # wrapper correct for any future one.)
+        out: list[Entry] = []
+        for s in range(self.n_shards):
+            self.replicas[s].on_end(time)
+            out.extend(self.collectors[s].take())
+        if out:
+            self.emit(time, out)
+
+    # Aggregate observability over replicas (rows_in counted at exchange).
+    @property
+    def shard_rows(self) -> list[tuple[int, int]]:
+        return [(r.rows_in, r.rows_out) for r in self.replicas]

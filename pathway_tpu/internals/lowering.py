@@ -19,6 +19,7 @@ from pathway_tpu.engine.runtime import (
     OutputNode,
     Runtime,
 )
+from pathway_tpu.engine.workers import ShardedNode, worker_threads
 from pathway_tpu.internals import expression as ex
 from pathway_tpu.internals.expression_compiler import (
     Resolver,
@@ -27,6 +28,11 @@ from pathway_tpu.internals.expression_compiler import (
 )
 from pathway_tpu.internals.keys import Key, hash_values, key_for_values
 from pathway_tpu.internals.table import OpSpec, Table
+
+
+def _route_key(key: Key, row: tuple) -> int:
+    """Default shard key: the record's 128-bit key (keyed-node exchange)."""
+    return key.value
 
 
 class _SlotRef(ex.ColumnExpression):
@@ -97,6 +103,25 @@ class Session:
         self.placeholder_data: dict[str, list] = {}
         self.autocommit_ms = 2
         self.monitors: list[Callable[[int], None]] = []
+        # PATHWAY_THREADS worker shards for stateful operators; read per
+        # session so worker-count-invariance tests can flip it in-process.
+        self.n_workers = worker_threads()
+
+    def _sharded(
+        self,
+        inputs: list[eng.Node],
+        factory: Callable[[eng.Graph, list[eng.Node]], eng.Node],
+        route_fns: list[Callable],
+    ) -> eng.Node:
+        """Build a stateful node, sharded across the session's workers.
+
+        Each worker owns the slice of the operator's state whose shard key
+        routes to it (the multi-worker exchange; engine/workers.py).
+        Single-worker sessions build the node directly on the main graph.
+        """
+        if self.n_workers <= 1:
+            return factory(self.graph, list(inputs))
+        return ShardedNode(self.graph, inputs, factory, route_fns, self.n_workers)
 
     # ---------------------------------------------------------------- build
 
@@ -171,12 +196,13 @@ class Session:
             kwargs = {k: f(key, rows) for k, f in kw_fns.items()}
             return raw_fn(*args, **kwargs)
 
-        return AsyncApplyNode(
-            self.graph,
-            self.node_of(main),
-            call,
-            is_async=True,
-            deterministic=ae._deterministic,
+        deterministic = ae._deterministic
+        return self._sharded(
+            [self.node_of(main)],
+            lambda sg, ins: AsyncApplyNode(
+                sg, ins[0], call, is_async=True, deterministic=deterministic
+            ),
+            [_route_key],
         )
 
     def _build(self, table: Table, spec: OpSpec) -> eng.Node:
@@ -212,7 +238,11 @@ class Session:
         if kind == "rowwise":
             exprs = spec.params["exprs"]
             input_nodes, fn = self._compile_rowwise(spec.inputs[0], exprs)
-            return eng.RowwiseNode(g, input_nodes, fn)
+            return self._sharded(
+                input_nodes,
+                lambda sg, ins: eng.RowwiseNode(sg, ins, fn),
+                [_route_key] * len(input_nodes),
+            )
 
         if kind == "filter":
             main = spec.inputs[0]
@@ -231,7 +261,11 @@ class Session:
             exprs = {n: ex.ColumnReference(main, n) for n in names}
             exprs["__cond__"] = cond
             input_nodes, fn = self._compile_rowwise(main, exprs)
-            rw = eng.RowwiseNode(g, input_nodes, fn)
+            rw = self._sharded(
+                input_nodes,
+                lambda sg, ins: eng.RowwiseNode(sg, ins, fn),
+                [_route_key] * len(input_nodes),
+            )
             flt = eng.FilterNode(g, rw, lambda key, row: row[-1])
             return eng.StatelessNode(
                 g, flt, lambda entries, t: [(k, r[:-1], d) for k, r, d in entries]
@@ -255,27 +289,34 @@ class Session:
             return eng.ConcatNode(g, nodes)
 
         if kind == "update_rows":
-            return eng.UpdateRowsNode(
-                g, self.node_of(spec.inputs[0]), self.node_of(spec.inputs[1])
+            return self._sharded(
+                [self.node_of(spec.inputs[0]), self.node_of(spec.inputs[1])],
+                lambda sg, ins: eng.UpdateRowsNode(sg, ins[0], ins[1]),
+                [_route_key, _route_key],
             )
 
         if kind == "update_cells":
-            return eng.UpdateCellsNode(
-                g,
-                self.node_of(spec.inputs[0]),
-                self.node_of(spec.inputs[1]),
-                spec.params["col_map"],
+            col_map = spec.params["col_map"]
+            return self._sharded(
+                [self.node_of(spec.inputs[0]), self.node_of(spec.inputs[1])],
+                lambda sg, ins: eng.UpdateCellsNode(sg, ins[0], ins[1], col_map),
+                [_route_key, _route_key],
             )
 
         if kind == "setop":
             nodes = [self.node_of(t) for t in spec.inputs]
-            return eng.SetOpNode(g, nodes, spec.params["mode"])
+            mode = spec.params["mode"]
+            return self._sharded(
+                nodes,
+                lambda sg, ins: eng.SetOpNode(sg, ins, mode),
+                [_route_key] * len(nodes),
+            )
 
         if kind == "with_universe_of":
-            return eng.SetOpNode(
-                g,
+            return self._sharded(
                 [self.node_of(spec.inputs[0]), self.node_of(spec.inputs[1])],
-                "restrict",
+                lambda sg, ins: eng.SetOpNode(sg, ins, "restrict"),
+                [_route_key, _route_key],
             )
 
         if kind == "having":
@@ -283,7 +324,11 @@ class Session:
             nodes = [self.node_of(spec.inputs[0])]
             for ref in indexers:
                 nodes.append(self.node_of(ref.table))
-            return eng.SetOpNode(g, nodes, "intersect")
+            return self._sharded(
+                nodes,
+                lambda sg, ins: eng.SetOpNode(sg, ins, "intersect"),
+                [_route_key] * len(nodes),
+            )
 
         if kind == "reindex":
             main = spec.inputs[0]
@@ -308,13 +353,23 @@ class Session:
             context_t, target_t = spec.inputs
             resolver = Resolver([context_t])
             pf = compile_expression(spec.params["pointer"], resolver)
-            return eng.IxNode(
-                g,
-                self.node_of(context_t),
-                self.node_of(target_t),
-                lambda key, row: pf(key, (row,)),
-                optional=spec.params.get("optional", False),
-                target_width=len(target_t._column_names()),
+            optional = spec.params.get("optional", False)
+            target_width = len(target_t._column_names())
+
+            def route_ptr(key: Key, row: tuple) -> Any:
+                # colocate each source row with its lookup target
+                v = pf(key, (row,))
+                return v.value if isinstance(v, Key) else eng.freeze_value(v)
+
+            return self._sharded(
+                [self.node_of(context_t), self.node_of(target_t)],
+                lambda sg, ins: eng.IxNode(
+                    sg, ins[0], ins[1],
+                    lambda key, row: pf(key, (row,)),
+                    optional=optional,
+                    target_width=target_width,
+                ),
+                [route_ptr, _route_key],
             )
 
         if kind == "sort":
@@ -326,11 +381,14 @@ class Session:
                 inf = compile_expression(inst_e, resolver)
             else:
                 inf = lambda key, rows: 0  # noqa: E731
-            return eng.SortNode(
-                g,
-                self.node_of(main),
-                lambda key, row: kf(key, (row,)),
-                lambda key, row: inf(key, (row,)),
+            return self._sharded(
+                [self.node_of(main)],
+                lambda sg, ins: eng.SortNode(
+                    sg, ins[0],
+                    lambda key, row: kf(key, (row,)),
+                    lambda key, row: inf(key, (row,)),
+                ),
+                [lambda key, row: eng.freeze_value(inf(key, (row,)))],
             )
 
         if kind == "deduplicate":
@@ -342,12 +400,16 @@ class Session:
                 instf = compile_expression(inst_e, resolver)
             else:
                 instf = lambda key, rows: 0  # noqa: E731
-            return eng.DeduplicateNode(
-                g,
-                self.node_of(main),
-                lambda key, row: instf(key, (row,)),
-                lambda key, row: vf(key, (row,)),
-                spec.params["acceptor"],
+            acceptor = spec.params["acceptor"]
+            return self._sharded(
+                [self.node_of(main)],
+                lambda sg, ins: eng.DeduplicateNode(
+                    sg, ins[0],
+                    lambda key, row: instf(key, (row,)),
+                    lambda key, row: vf(key, (row,)),
+                    acceptor,
+                ),
+                [lambda key, row: eng.freeze_value(instf(key, (row,)))],
             )
 
         if kind in ("buffer", "forget", "freeze"):
@@ -457,9 +519,13 @@ class Session:
             getattr(re_._reducer, "n_args", 1) == 0 or _scalar_numeric(re_)
             for re_ in reducer_exprs
         )
-        gnode = eng.GroupByNode(
-            self.graph, self.node_of(main), gk_fn, reducers, arg_fns,
-            native_ok=native_ok,
+        gnode = self._sharded(
+            [self.node_of(main)],
+            lambda sg, ins: eng.GroupByNode(
+                sg, ins[0], gk_fn, reducers, arg_fns, native_ok=native_ok
+            ),
+            # exchange on the group key: every group's rows meet in one worker
+            [lambda key, row: eng.freeze_value(gk_fn(key, row))],
         )
         # post-processing rowwise over (gvals..., rvals...)
         reducer_slots = {
@@ -471,7 +537,9 @@ class Session:
         def fn(key: Key, *rows: tuple) -> tuple:
             return tuple(f(key, rows) for f in fns)
 
-        return eng.RowwiseNode(self.graph, [gnode], fn)
+        return self._sharded(
+            [gnode], lambda sg, ins: eng.RowwiseNode(sg, ins, fn), [_route_key]
+        )
 
     # ---------------------------------------------------------------- join
 
@@ -493,17 +561,23 @@ class Session:
         def right_jk(key: Key, row: tuple) -> tuple:
             return tuple(f(key, (row,)) for f in rfns)
 
-        jnode = eng.JoinNode(
-            self.graph,
-            self.node_of(left_t),
-            self.node_of(right_t),
-            left_jk,
-            right_jk,
-            mode=mode,
-            id_mode=id_mode,
-            left_width=len(left_t._column_names()),
-            right_width=len(right_t._column_names()),
-            asof_now=spec.params.get("asof_now", False),
+        left_width = len(left_t._column_names())
+        right_width = len(right_t._column_names())
+        asof_now = spec.params.get("asof_now", False)
+        jnode = self._sharded(
+            [self.node_of(left_t), self.node_of(right_t)],
+            lambda sg, ins: eng.JoinNode(
+                sg, ins[0], ins[1], left_jk, right_jk,
+                mode=mode, id_mode=id_mode,
+                left_width=left_width, right_width=right_width,
+                asof_now=asof_now,
+            ),
+            # exchange both sides on the join key (reference: Shard impls on
+            # join arrangements, src/engine/dataflow/shard.rs)
+            [
+                lambda key, row: eng.freeze_value(left_jk(key, row)),
+                lambda key, row: eng.freeze_value(right_jk(key, row)),
+            ],
         )
         jres = JoinResolver(left_t, right_t)
         fns = [compile_expression(e, jres) for e in out_exprs.values()]
@@ -511,7 +585,9 @@ class Session:
         def fn(key: Key, *rows: tuple) -> tuple:
             return tuple(f(key, rows) for f in fns)
 
-        return eng.RowwiseNode(self.graph, [jnode], fn)
+        return self._sharded(
+            [jnode], lambda sg, ins: eng.RowwiseNode(sg, ins, fn), [_route_key]
+        )
 
     # ------------------------------------------------------------- iterate
 

@@ -33,13 +33,6 @@ def pagerank(edges: Table, steps: int = 50, damping: float = 0.85) -> Table:
 
     def step(ranks: Table) -> dict[str, Table]:
         # contribution of u along each edge = rank(u) / degree(u)
-        with_rank = edges.join(
-            ranks, edges.u == ranks.vid
-        ).select(v=ex.left.v, contrib=ex.right.rank)
-        deg_joined = with_rank  # rank column already divided below
-        flowing = edges.join(ranks, edges.u == ranks.vid).join(
-            degs, ex.left.u == degs.u
-        )
         contribs = (
             edges.join(ranks, edges.u == ranks.vid)
             .select(u=ex.left.u, v=ex.left.v, rank=ex.right.rank)

@@ -185,13 +185,21 @@ class VectorSlabIndex(HostIndex):
         if plain:
             kmax = max(k for _i, _q, k in plain)
             qmat = np.stack([_as_vector(q) for _i, q, _k in plain])
-            top = self._topk(qmat, min(kmax, len(self.slot_of)))
+            # candidates are re-ranked by (score, key) below so equal-score
+            # results never depend on index insertion order (worker-count
+            # invariance). The host path returns all k-th-boundary ties
+            # (exact); the device path over-fetches a headroom instead —
+            # sufficient unless >8 keys tie at the boundary, which for
+            # real-valued embedding scores is a measure-zero event.
+            top = self._topk(qmat, min(kmax + 8, len(self.slot_of)))
             for (i, _q, k), (idxs, dists) in zip(plain, top):
-                results[i] = [
+                matches = [
                     (self.key_of[slot], float(d))
-                    for slot, d in zip(idxs[:k], dists[:k])
+                    for slot, d in zip(idxs, dists)
                     if slot in self.key_of
                 ]
+                matches.sort(key=lambda m: (m[1], m[0].value))
+                results[i] = matches[:k]
         for i, q, k, f in filtered:
             results[i] = self._search_filtered(_as_vector(q), k, f)
         return results
@@ -234,9 +242,16 @@ class VectorSlabIndex(HostIndex):
         part = np.argpartition(dists, k - 1, axis=1)[:, :k]
         out = []
         for r in range(qmat.shape[0]):
-            idxs = part[r][np.argsort(dists[r][part[r]])]
-            keep = np.isfinite(dists[r][idxs])
-            out.append((idxs[keep], dists[r][idxs][keep]))
+            # include EVERY candidate tied with the k-th distance so the
+            # caller's (score, key) re-rank is exact however many ties —
+            # results never depend on slot/insertion order
+            kth = np.max(dists[r][part[r]])
+            if not np.isfinite(kth):
+                finite = np.isfinite(dists[r])
+                cand = np.flatnonzero(finite)
+            else:
+                cand = np.flatnonzero(dists[r] <= kth)
+            out.append((cand, dists[r][cand]))
         return out
 
     def _host_distances(self, qmat: np.ndarray, docs: np.ndarray) -> np.ndarray:
@@ -256,8 +271,9 @@ class VectorSlabIndex(HostIndex):
             return []
         docs = self.vectors[slots]
         dists = self._host_distances(vec[None, :], docs)[0]
-        order = np.argsort(dists)[:k]
-        return [(self.key_of[slots[i]], float(dists[i])) for i in order]
+        matches = [(self.key_of[s], float(d)) for s, d in zip(slots, dists)]
+        matches.sort(key=lambda m: (m[1], m[0].value))
+        return matches[:k]
 
 
 class LshIndex(HostIndex):
@@ -347,8 +363,9 @@ class LshIndex(HostIndex):
             dists = 1.0 - dn @ qn
         else:
             dists = np.linalg.norm(docs - vec[None, :], axis=1) ** 2
-        order = np.argsort(dists)[:k]
-        return [(keys[i], float(dists[i])) for i in order]
+        matches = [(key, float(d)) for key, d in zip(keys, dists)]
+        matches.sort(key=lambda m: (m[1], m[0].value))
+        return matches[:k]
 
 
 _TOKEN_SPLIT = None
@@ -418,5 +435,6 @@ class Bm25Index(HostIndex):
         if metadata_filter:
             pred = self._filters.get(metadata_filter)
             scores = {key: s for key, s in scores.items() if pred(self.metadata.get(key))}
-        ranked = sorted(scores.items(), key=lambda kv: -kv[1])[:k]
+        # key tie-break: scores must not depend on dict/insertion order
+        ranked = sorted(scores.items(), key=lambda kv: (-kv[1], kv[0].value))[:k]
         return [(key, -s) for key, s in ranked]

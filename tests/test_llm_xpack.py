@@ -110,7 +110,10 @@ def test_document_store_retrieve_and_filters():
             (b"quick stream fox", {"path": "img/c.txt", "modified_at": 30, "seen_at": 31}),
         ]
     )
-    store = _store(docs)
+    # dim=12: at dim=8/16 the fake embedder buckets "brown" and "stream"
+    # together, making docs a and c exact-tie for the query — the index
+    # tie-breaks by key (worker-count invariant), not insertion order
+    store = _store(docs, dim=12)
     queries = pw.debug.table_from_rows(
         DocumentStore.RetrieveQuerySchema,
         [
