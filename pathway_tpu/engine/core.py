@@ -217,6 +217,37 @@ class Node:
     def on_end(self, time: int) -> None:
         """Called once when the stream is complete (frontier -> +inf)."""
 
+    # ------------------------------------------------- operator snapshots
+    #
+    # Reference parity: operator persistence
+    # (/root/reference/src/persistence/operator_snapshot.rs) — each
+    # stateful operator can dump/restore its full state so resume does
+    # not replay the whole input journal. `_persist_attrs` names the
+    # attributes that constitute the operator's state; a node with no
+    # state declares none and returns None (nothing to persist).
+
+    _persist_attrs: tuple[str, ...] = ()
+
+    def persist_signature(self) -> str:
+        """Structural identity of this operator for snapshot validity.
+        Subclasses add semantic parameters (reducer set, join mode, …) so
+        a changed pipeline refuses stale state. Caveat (shared with the
+        reference): Python function bodies (UDFs, predicates) are not
+        hashable into the signature — changing only a UDF body while
+        keeping structure reuses the old state."""
+        return f"{type(self).__name__}/{len(self.inputs)}"
+
+    def persist_state(self) -> dict | None:
+        if not self._persist_attrs:
+            return None
+        return {
+            a: getattr(self, a) for a in self._persist_attrs if hasattr(self, a)
+        }
+
+    def restore_state(self, state: dict) -> None:
+        for a, v in state.items():
+            setattr(self, a, v)
+
 
 class Graph:
     """Owns nodes in topological (creation) order."""
@@ -291,6 +322,7 @@ class RowwiseNode(Node):
     ):
         super().__init__(graph, inputs)
         self.fn = fn  # fn(key, *rows) -> out_row
+        self._persist_attrs = ("side_states", "emitted", "deferred", "_main_state_")
         self.side_states = [KeyedState() for _ in range(len(inputs) - 1)]
         self.emitted: dict[Key, tuple] = {}
         self.deferred: dict[Key, int] = {}
@@ -428,6 +460,11 @@ class SetOpNode(Node):
     mode: 'intersect' | 'difference' | 'restrict'
     """
 
+    _persist_attrs = ("main", "others", "emitted")
+
+    def persist_signature(self) -> str:
+        return f"SetOpNode/{len(self.inputs)}/{self.mode}"
+
     def __init__(self, graph: Graph, inputs: Sequence[Node], mode: str):
         super().__init__(graph, inputs)
         self.mode = mode
@@ -461,6 +498,8 @@ class SetOpNode(Node):
 class UpdateRowsNode(Node):
     """union with right-priority (reference: update_rows dataflow.rs)."""
 
+    _persist_attrs = ("left", "right", "emitted")
+
     def __init__(self, graph: Graph, left: Node, right: Node):
         super().__init__(graph, [left, right])
         self.left = KeyedState()
@@ -487,6 +526,11 @@ class UpdateRowsNode(Node):
 
 class UpdateCellsNode(Node):
     """Override selected columns where the right table has the key."""
+
+    _persist_attrs = ("left", "right", "emitted")
+
+    def persist_signature(self) -> str:
+        return f"UpdateCellsNode/{self.col_map}"
 
     def __init__(self, graph: Graph, left: Node, right: Node, col_map: list[int | None]):
         # col_map[i] = index into right row overriding left col i, or None
@@ -529,6 +573,14 @@ class JoinNode(Node):
     join key. Delta rule: d(L ⋈ R) = dL ⋈ R_old + L_new ⋈ dR.
     Output key assignment: 'hash' (new key from (lkey, rkey)), 'left', 'right'.
     """
+
+    _persist_attrs = ("left_state", "right_state")
+
+    def persist_signature(self) -> str:
+        return (
+            f"JoinNode/{self.mode}/{self.id_mode}/{self.left_width}"
+            f"/{self.right_width}/{int(self.asof_now)}"
+        )
 
     def __init__(
         self,
@@ -748,6 +800,46 @@ class GroupByNode(Node):
             self.gkeys: dict[Any, tuple[Key, tuple]] = {}  # fzn gval->(Key,gvals)
             self.stateful_state: dict[Any, list[Any]] = {}
 
+    def persist_signature(self) -> str:
+        reds = ",".join(
+            getattr(r, "name", type(r).__name__) for r in self.reducers
+        )
+        return f"GroupByNode/[{reds}]/native={int(self._native is not None)}"
+
+    def persist_state(self) -> dict:
+        if self._native is not None:
+            return {
+                "native": self._native.export_state(),
+                "gid_by_token": self._gid_by_token,
+                "ginfo": self._ginfo,
+                "emitted": self.emitted,
+            }
+        return {
+            "state": self.state,
+            "gkeys": self.gkeys,
+            "stateful_state": self.stateful_state,
+            "emitted": self.emitted,
+        }
+
+    def restore_state(self, st: dict) -> None:
+        if ("native" in st) != (self._native is not None):
+            # PATHWAY_TPU_NATIVE toggled between runs; the aggregate
+            # representations are not interchangeable
+            raise RuntimeError(
+                "groupby snapshot was taken with a different native-kernel "
+                "setting; cannot restore operator state"
+            )
+        if self._native is not None:
+            self._native.import_state(st["native"])
+            self._gid_by_token = st["gid_by_token"]
+            self._ginfo = st["ginfo"]
+            self.emitted = st["emitted"]
+        else:
+            self.state = st["state"]
+            self.gkeys = st["gkeys"]
+            self.stateful_state = st["stateful_state"]
+            self.emitted = st["emitted"]
+
     def _finish_native(self, time: int, entries: list[Entry]) -> None:
         n = len(entries)
         n_red = len(self.reducers)
@@ -892,6 +984,8 @@ class DeduplicateNode(Node):
     """Keep one accepted row per instance via acceptor(new, old) -> bool
     (reference: deduplicate dataflow.rs:3101)."""
 
+    _persist_attrs = ("accepted", "ikeys")
+
     def __init__(
         self,
         graph: Graph,
@@ -950,6 +1044,8 @@ class DeduplicateNode(Node):
 class IxNode(Node):
     """Pointer lookup: for each source row, fetch the target row at
     pointer_fn(key, row) (reference: ix_table dataflow.rs:2133)."""
+
+    _persist_attrs = ("source_by_ptr", "target_state", "emitted")
 
     def __init__(
         self,
@@ -1022,6 +1118,8 @@ class SortNode(Node):
     """Maintain prev/next pointers over sorted instances
     (reference: operators/prev_next.rs via sort_table)."""
 
+    _persist_attrs = ("instances", "emitted")
+
     def __init__(
         self,
         graph: Graph,
@@ -1070,6 +1168,8 @@ class SortNode(Node):
 
 class CaptureNode(Node):
     """Accumulates the full update stream and final state (debug/capture)."""
+
+    _persist_attrs = ("stream", "state")
 
     def __init__(self, graph: Graph, inp: Node):
         super().__init__(graph, [inp])
@@ -1123,6 +1223,8 @@ class SubscribeNode(Node):
 class BufferNode(Node):
     """Postpone rows until the stream's max threshold passes their release
     time (reference: operators/time_column.rs postpone_core:380)."""
+
+    _persist_attrs = ("now", "pending", "released")
 
     def __init__(
         self,
@@ -1187,6 +1289,8 @@ class ForgetNode(Node):
     """Retract rows older than the moving threshold; drop late arrivals
     (reference: time_column.rs forget:566 + ignore_late:677)."""
 
+    _persist_attrs = ("now", "live")
+
     def __init__(
         self,
         graph: Graph,
@@ -1238,6 +1342,8 @@ class FreezeNode(Node):
     """Ignore updates/retractions to rows past the freeze threshold
     (reference: time_column.rs freeze via dataflow.rs:1555)."""
 
+    _persist_attrs = ("now",)
+
     def __init__(
         self,
         graph: Graph,
@@ -1273,6 +1379,8 @@ class FreezeNode(Node):
 class GradualBroadcastNode(Node):
     """Broadcast (lower, value, upper) from a small table onto every row of a
     big table with hysteresis (reference: operators/gradual_broadcast.rs:65)."""
+
+    _persist_attrs = ("current", "big_state", "emitted")
 
     def __init__(
         self,
@@ -1344,6 +1452,17 @@ class ExternalIndexNode(Node):
       'collapse' -> query_row + (data_col_tuple, ...) + (scores, ids)
       'flat'     -> one row per match: query_row + data_row + (score, id)
     """
+
+    _persist_attrs = (
+        "host_index", "query_state", "data_state", "indexed", "emitted",
+        "matches",
+    )
+
+    def persist_signature(self) -> str:
+        return (
+            f"ExternalIndexNode/{self.mode}/{int(self.asof_now)}"
+            f"/{self.data_width}/{type(self.host_index).__name__}"
+        )
 
     def __init__(
         self,

@@ -135,6 +135,14 @@ def _load() -> ctypes.CDLL | None:
         ]
         lib.zs_agg_len.restype = ctypes.c_int64
         lib.zs_agg_len.argtypes = [ctypes.c_void_p]
+        lib.zs_agg_export.restype = ctypes.c_int64
+        lib.zs_agg_export.argtypes = [
+            ctypes.c_void_p, u64p, i64p, i64p, f64p, i64p, i64p, i64p, u8p,
+        ]
+        lib.zs_agg_import.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, u64p, i64p, i64p, f64p, i64p,
+            i64p, i64p, u8p,
+        ]
         lib.zs_split_lines.restype = ctypes.c_int64
         lib.zs_split_lines.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p, i64p,
@@ -312,6 +320,49 @@ class NativeGroupAgg:
 
     def __len__(self) -> int:
         return self._lib.zs_agg_len(self._h)
+
+    def export_state(self) -> dict:
+        """Full picklable state for operator checkpointing."""
+        m = len(self)
+        r = self._n_red
+        g = np.empty(m, np.uint64)
+        total = np.empty(m, np.int64)
+        isum = np.empty(max(m * r, 1), np.int64)
+        fsum = np.empty(max(m * r, 1), np.float64)
+        cnt = np.empty(max(m * r, 1), np.int64)
+        fseen = np.empty(max(m * r, 1), np.int64)
+        err = np.empty(max(m * r, 1), np.int64)
+        ovf = np.empty(max(m * r, 1), np.uint8)
+        n = self._lib.zs_agg_export(self._h, g, total, isum, fsum, cnt, fseen, err, ovf)
+        assert n == m
+        return {
+            "g": g, "total": total, "isum": isum[: m * r],
+            "fsum": fsum[: m * r], "cnt": cnt[: m * r],
+            "fseen": fseen[: m * r], "err": err[: m * r], "ovf": ovf[: m * r],
+        }
+
+    def import_state(self, st: dict) -> None:
+        m = len(st["g"])
+        r = self._n_red
+        for name in ("isum", "fsum", "cnt", "fseen", "err", "ovf"):
+            if len(st[name]) != m * r:
+                raise ValueError(
+                    f"agg snapshot {name} has {len(st[name])} slots, "
+                    f"expected {m}x{r} — reducer set changed since checkpoint"
+                )
+        if len(st["total"]) != m:
+            raise ValueError("agg snapshot total/group length mismatch")
+        self._lib.zs_agg_import(
+            self._h, m,
+            np.ascontiguousarray(st["g"], np.uint64),
+            np.ascontiguousarray(st["total"], np.int64),
+            np.ascontiguousarray(st["isum"], np.int64),
+            np.ascontiguousarray(st["fsum"], np.float64),
+            np.ascontiguousarray(st["cnt"], np.int64),
+            np.ascontiguousarray(st["fseen"], np.int64),
+            np.ascontiguousarray(st["err"], np.int64),
+            np.ascontiguousarray(st["ovf"], np.uint8),
+        )
 
 
 def split_lines(data: bytes):

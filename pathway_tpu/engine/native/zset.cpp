@@ -345,6 +345,57 @@ int64_t zs_agg_len(void* h) {
     return static_cast<int64_t>(static_cast<GroupAgg*>(h)->groups.size());
 }
 
+// Full-state export/import for operator checkpointing (the engine's
+// equivalent of the reference's operator snapshots,
+// /root/reference/src/persistence/operator_snapshot.rs). Slot arrays are
+// [m * n_red] reducer-minor per group; caller sizes them via zs_agg_len.
+int64_t zs_agg_export(void* h, uint64_t* out_g, int64_t* out_total,
+                      int64_t* out_isum, double* out_fsum, int64_t* out_cnt,
+                      int64_t* out_fseen, int64_t* out_err, uint8_t* out_ovf) {
+    auto* agg = static_cast<GroupAgg*>(h);
+    const int64_t r_n = agg->n_red;
+    int64_t m = 0;
+    for (auto& [gt, g] : agg->groups) {
+        out_g[m] = gt;
+        out_total[m] = g.total;
+        for (int64_t r = 0; r < r_n; ++r) {
+            auto& s = g.slots[static_cast<size_t>(r)];
+            out_isum[m * r_n + r] = s.isum;
+            out_fsum[m * r_n + r] = s.fsum;
+            out_cnt[m * r_n + r] = s.cnt;
+            out_fseen[m * r_n + r] = s.fseen;
+            out_err[m * r_n + r] = s.err;
+            out_ovf[m * r_n + r] = s.overflow;
+        }
+        ++m;
+    }
+    return m;
+}
+
+void zs_agg_import(void* h, int64_t m, const uint64_t* g_in,
+                   const int64_t* total, const int64_t* isum,
+                   const double* fsum, const int64_t* cnt,
+                   const int64_t* fseen, const int64_t* err,
+                   const uint8_t* ovf) {
+    auto* agg = static_cast<GroupAgg*>(h);
+    const int64_t r_n = agg->n_red;
+    agg->groups.clear();
+    for (int64_t i = 0; i < m; ++i) {
+        auto& g = agg->groups[g_in[i]];
+        g.total = total[i];
+        g.slots.resize(static_cast<size_t>(r_n));
+        for (int64_t r = 0; r < r_n; ++r) {
+            auto& s = g.slots[static_cast<size_t>(r)];
+            s.isum = isum[i * r_n + r];
+            s.fsum = fsum[i * r_n + r];
+            s.cnt = cnt[i * r_n + r];
+            s.fseen = fseen[i * r_n + r];
+            s.err = err[i * r_n + r];
+            s.overflow = ovf[i * r_n + r];
+        }
+    }
+}
+
 // --------------------------------------------------------- line tokenizer
 
 // Splits a byte buffer into lines; writes (start, end) offsets per line,

@@ -120,6 +120,8 @@ class Runtime:
         self.time = 0
         self.connectors: list[Connector] = []
         self.monitors: list[Callable[[int], None]] = []
+        # checkpoint/resume orchestration (persistence.CheckpointManager)
+        self.checkpointer: Any = None
 
     def next_time(self) -> int:
         self.time += 2  # even-ms granule, reference timestamp.rs:20-27
@@ -150,6 +152,8 @@ class Runtime:
                 self.graph.step(t)
                 for m in self.monitors:
                     m(t)
+                if self.checkpointer is not None and self.checkpointer.due():
+                    self.checkpointer.checkpoint(t)
             if all(c.done for c in self.connectors):
                 # final drain
                 final: bool = False
@@ -162,6 +166,9 @@ class Runtime:
                 if final:
                     self.graph.step(t)
                 self.graph.end(t)
+                if self.checkpointer is not None:
+                    self.checkpointer.checkpoint(t)
+                    self.checkpointer.close()
                 break
 
     def run_static(self, batches: list[tuple[int, InputNode, list[Entry]]]) -> None:
@@ -203,6 +210,11 @@ class IterateNode(Node):
         iteration_limit: int | None = None,
     ):
         super().__init__(graph, inputs)
+        self._persist_attrs = ("states", "emitted")
+        self.persist_signature = lambda: (  # type: ignore[method-assign]
+            f"IterateNode/{input_names}/{iterated_names}"
+            f"/{output_names}/{iteration_limit}"
+        )
         self.input_names = input_names
         self.iterated_names = iterated_names
         self.output_names = output_names
@@ -290,6 +302,7 @@ class AsyncApplyNode(Node):
         deterministic: bool = False,
     ):
         super().__init__(graph, [inp])
+        self._persist_attrs = ("memo",)
         self.fn = fn
         self.is_async = is_async
         self.deterministic = deterministic

@@ -25,6 +25,7 @@ the host-side control-plane equivalent for arbitrary Python rows.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -75,10 +76,30 @@ class _Collector:
         return out
 
 
+def _canon(v: Any) -> Any:
+    """Normalize a shard token so routing agrees with Python equality:
+    1 == 1.0 == True must route identically (a group key mixing int and
+    float forms is ONE group to the operator's dict state)."""
+    if isinstance(v, tuple):
+        return tuple(_canon(x) for x in v)
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, float) and v.is_integer():
+        return int(v)  # also folds -0.0 -> 0
+    return v
+
+
 def _shard_of(token: Any, n: int) -> int:
+    """Process-stable shard assignment. Python's hash() is salted per
+    process (PYTHONHASHSEED), which would route a group to a different
+    worker after restart — operator snapshots store per-shard state, so
+    routing must be a pure function of the token's content."""
+    if isinstance(token, bool):
+        return int(token) % n
     if isinstance(token, int):
         return token % n
-    return hash(token) % n
+    digest = hashlib.md5(repr(_canon(token)).encode()).digest()
+    return int.from_bytes(digest[:8], "little") % n
 
 
 class ShardedNode(Node):
@@ -174,6 +195,29 @@ class ShardedNode(Node):
             out.extend(self.collectors[s].take())
         if out:
             self.emit(time, out)
+
+    # ----------------------------------------------- operator snapshots
+
+    def persist_signature(self) -> str:
+        return f"Sharded({self.replicas[0].persist_signature()})x{self.n_shards}"
+
+    def persist_state(self) -> dict | None:
+        shards = [r.persist_state() for r in self.replicas]
+        if all(s is None for s in shards):
+            return None
+        return {"n_shards": self.n_shards, "shards": shards}
+
+    def restore_state(self, state: dict) -> None:
+        if state.get("n_shards") != self.n_shards:
+            # resharding persisted per-worker state is not supported; the
+            # checkpoint manager falls back to full journal replay
+            raise RuntimeError(
+                f"snapshot has {state.get('n_shards')} worker shards, "
+                f"session has {self.n_shards} (set PATHWAY_THREADS to match)"
+            )
+        for replica, st in zip(self.replicas, state["shards"]):
+            if st is not None:
+                replica.restore_state(st)
 
     # Aggregate observability over replicas (rows_in counted at exchange).
     @property

@@ -643,6 +643,7 @@ class Session:
     def execute(self) -> None:
         runtime = Runtime(self.graph, autocommit_ms=self.autocommit_ms)
         runtime.monitors = list(self.monitors)
+        runtime.checkpointer = getattr(self, "checkpointer", None)
         if not self.connectors:
             runtime.run_static(self.static_batches)
             return

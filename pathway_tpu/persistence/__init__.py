@@ -1,19 +1,40 @@
-"""Persistence: checkpoint/resume of input streams + metadata.
+"""Persistence: operator snapshots + frontier metadata + input journals.
 
-Reference: python/pathway/persistence/__init__.py (Backend :27, Config :88)
-+ src/persistence/ (input snapshots, metadata, offset antichains).
+Reference parity: src/persistence/ —
+  * operator snapshots with compaction (operator_snapshot.rs:1) →
+    `OperatorSnapshotStore` (per-node pickled state, one file per epoch,
+    old epochs deleted after the metadata commit),
+  * metadata / finalized-frontier store (state.rs:35 MetadataAccessor) →
+    `MetadataStore` (per-connector committed offsets + epoch, written
+    fsync-then-atomic-rename so a crash never yields a torn commit),
+  * per-source offset frontiers (frontier.rs OffsetAntichain) →
+    per-connector event offsets in segmented journals (`*.N.seg`,
+    N = first event offset in the segment),
+plus python/pathway/persistence/__init__.py (Backend :27, Config :88) for
+the user-facing API.
 
-v0 mechanism (input-snapshot replay, the reference's primary free-tier
-path): every connector's parsed event stream is journaled per run to the
-backend; on restart the journal replays before live reading resumes, and
-sources that support seeking skip already-consumed offsets.
+Recovery order (reference: worker.rs bootstrap): metadata → operator
+state → journal tail. The journal head covered by the snapshot epoch is
+deleted at checkpoint time (compaction), so resume replays only the tail
+— O(new events), not O(history).
+
+Modes:
+  * pipeline signature matches + snapshot epoch valid → restore operator
+    states, replay journal events at offsets ≥ committed, seek live
+    sources past everything journaled.
+  * signature mismatch (pipeline changed / PATHWAY_THREADS changed /
+    native kernel toggled) → fall back to FULL journal replay if the head
+    still exists; otherwise fail with a clear error instead of silently
+    recomputing wrong state.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json as _json
 import os
 import pickle
+import time as _time
 from typing import Any
 
 from pathway_tpu.internals.keys import Key
@@ -53,61 +74,404 @@ class Config:
         persistence_mode: str = "PERSISTING",
         snapshot_access: Any = None,
         continue_after_replay: bool = True,
+        operator_snapshots: bool = True,
     ):
         self.backend = backend or Backend.mock()
         self.snapshot_interval_ms = snapshot_interval_ms
         self.persistence_mode = persistence_mode
         self.continue_after_replay = continue_after_replay
+        # UDF-cache-only mode (serving processes) skips input journaling
+        # and operator snapshots entirely
+        self.operator_snapshots = operator_snapshots and persistence_mode not in (
+            "UDF_CACHING",
+            "udf_caching",
+        )
 
     @classmethod
     def simple_config(cls, backend: Backend, **kwargs: Any) -> "Config":
         return cls(backend, **kwargs)
 
+    @classmethod
+    def udf_caching(cls, backend: Backend) -> "Config":
+        """Cache-only persistence for serving processes: UDF results are
+        cached under the backend, but no input journaling / replay /
+        operator snapshots are attached (reference: udf caching mode)."""
+        return cls(backend, persistence_mode="UDF_CACHING")
 
-class SnapshotJournal:
-    """Append-only journal of (connector_name, seq, key, row, diff)."""
+
+def _fsync_write(path: str, data: bytes) -> None:
+    """Write atomically: tmp file, fsync, rename, fsync dir."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    dirfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
+
+
+def _safe(name: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "_" for c in name)
+
+
+class SegmentedJournal:
+    """Per-connector append-only event log in offset-addressed segments.
+
+    Events are globally numbered per connector; segment `{name}.{N}.seg`
+    holds events starting at offset N. At each checkpoint the current
+    segment rolls over and fully-committed older segments are deleted
+    (compaction) once the operator snapshot covering them is durable.
+    """
 
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
 
-    def path_for(self, name: str) -> str:
-        safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in name)
-        return os.path.join(self.root, f"{safe}.snapshot")
-
-    def load(self, name: str) -> list[tuple[int, tuple, int]]:
-        p = self.path_for(name)
-        out: list[tuple[int, tuple, int]] = []
-        if not os.path.exists(p):
-            return out
-        with open(p, "rb") as f:
-            while True:
+    def _segments(self, name: str) -> list[tuple[int, str]]:
+        pre = _safe(name) + "."
+        out = []
+        for fn in os.listdir(self.root):
+            if fn.startswith(pre) and fn.endswith(".seg"):
                 try:
-                    out.append(pickle.load(f))  # noqa: S301
-                except EOFError:
-                    break
+                    start = int(fn[len(pre):-4])
+                except ValueError:
+                    continue
+                out.append((start, os.path.join(self.root, fn)))
+        return sorted(out)
+
+    def load_from(self, name: str, offset: int) -> list[tuple[int, Any, tuple, int]]:
+        """All journaled events with global offset >= `offset`, as
+        (offset, key_value, row, diff)."""
+        out: list[tuple[int, Any, tuple, int]] = []
+        for start, path in self._segments(name):
+            pos = start
+            with open(path, "rb") as f:
+                while True:
+                    try:
+                        (kv, row, diff) = pickle.load(f)  # noqa: S301
+                    except (EOFError, pickle.UnpicklingError):
+                        break  # torn tail write from a crash: discard
+                    if pos >= offset:
+                        out.append((pos, kv, row, diff))
+                    pos += 1
         return out
 
-    def appender(self, name: str) -> Any:
-        return open(self.path_for(name), "ab")
+    def head_offset(self, name: str) -> int:
+        """Offset of the first surviving journal event (>0 after compaction)."""
+        segs = self._segments(name)
+        return segs[0][0] if segs else 0
+
+    def total_events(self, name: str) -> int:
+        segs = self._segments(name)
+        if not segs:
+            return 0
+        last_start, last_path = segs[-1]
+        n = 0
+        with open(last_path, "rb") as f:
+            while True:
+                try:
+                    pickle.load(f)  # noqa: S301
+                except (EOFError, pickle.UnpicklingError):
+                    break
+                n += 1
+        return last_start + n
+
+    def open_segment(self, name: str, start: int):
+        return _SegmentWriter(
+            os.path.join(self.root, f"{_safe(name)}.{start}.seg"), start
+        )
+
+    def compact(self, name: str, committed: int) -> int:
+        """Delete segments whose every event is < committed (covered by a
+        durable operator snapshot). Returns number of segments removed."""
+        segs = self._segments(name)
+        removed = 0
+        for i, (start, path) in enumerate(segs):
+            end = segs[i + 1][0] if i + 1 < len(segs) else None
+            if end is not None and end <= committed:
+                try:
+                    os.unlink(path)
+                    removed += 1
+                except OSError:
+                    pass
+        return removed
+
+
+class _SegmentWriter:
+    def __init__(self, path: str, start: int):
+        self.path = path
+        self.start = start
+        self.count = 0
+        self._f = open(path, "ab")
+
+    @property
+    def next_offset(self) -> int:
+        return self.start + self.count
+
+    def append(self, key_value: int, row: tuple, diff: int) -> None:
+        pickle.dump((key_value, row, diff), self._f)
+        self.count += 1
+
+    def flush(self, sync: bool = False) -> None:
+        self._f.flush()
+        if sync:
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class MetadataStore:
+    """The finalized-frontier record: which epoch of operator snapshots is
+    durable and which journal offset each connector is committed to."""
+
+    FILE = "metadata.json"
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.path = os.path.join(root, self.FILE)
+
+    def load(self) -> dict | None:
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path) as f:
+                return _json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def commit(
+        self, epoch: int, offsets: dict[str, int], signature: str, finalized_time: int
+    ) -> None:
+        _fsync_write(
+            self.path,
+            _json.dumps(
+                {
+                    "epoch": epoch,
+                    "offsets": offsets,
+                    "signature": signature,
+                    "finalized_time": finalized_time,
+                    "committed_at": _time.time(),
+                }
+            ).encode(),
+        )
+
+
+class OperatorSnapshotStore:
+    """Pickled per-operator state, one file per (node, epoch)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.join(root, "operator")
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, pid: str, epoch: int) -> str:
+        return os.path.join(self.root, f"{_safe(pid)}.{epoch}.state")
+
+    def write(self, pid: str, epoch: int, state: dict) -> None:
+        _fsync_write(self._path(pid, epoch), pickle.dumps(state, protocol=4))
+
+    def read(self, pid: str, epoch: int) -> dict | None:
+        p = self._path(pid, epoch)
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return pickle.load(f)  # noqa: S301
+
+    def compact(self, keep_epoch: int) -> None:
+        for fn in os.listdir(self.root):
+            if not fn.endswith(".state"):
+                continue
+            try:
+                epoch = int(fn.rsplit(".", 2)[-2])
+            except (ValueError, IndexError):
+                continue
+            if epoch != keep_epoch:
+                try:
+                    os.unlink(os.path.join(self.root, fn))
+                except OSError:
+                    pass
+
+
+def _pipeline_signature(graph: Any, n_workers: int) -> str:
+    """Stable id of the lowered pipeline: node order + each operator's
+    semantic signature (class, mode, reducer set, widths, …) + worker
+    count + native kernel availability. A change means persisted operator
+    state cannot be mapped back onto the graph. Function bodies (UDFs,
+    predicates) are not capturable — that caveat is documented on
+    Node.persist_signature."""
+    from pathway_tpu.engine import native
+
+    parts = [f"workers={n_workers}", f"native={native.available()}"]
+    for node in graph.nodes:
+        parts.append(f"{node.node_id}:{node.persist_signature()}")
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+def _persistent_id(node: Any) -> str:
+    return f"n{node.node_id}-{type(node).__name__}"
+
+
+class CheckpointManager:
+    """Orchestrates checkpoints: journal fsync → operator snapshots →
+    metadata commit → compaction. Restores in the opposite order."""
+
+    def __init__(self, session: Any, config: Config):
+        self.session = session
+        self.config = config
+        root = config.backend.path
+        assert root is not None
+        self.journal = SegmentedJournal(root)
+        self.metadata = MetadataStore(root)
+        self.ops = OperatorSnapshotStore(root)
+        self.signature = _pipeline_signature(session.graph, session.n_workers)
+        self.epoch = 0
+        self._last_checkpoint = _time.monotonic()
+        self._writers: dict[str, _SegmentWriter] = {}
+        self._restored_offsets: dict[str, int] = {}
+        self.restored = False
+
+    # ------------------------------------------------------------ restore
+
+    def restore(self) -> dict[str, int]:
+        """Returns per-connector replay offsets ({} = cold start). Loads
+        operator snapshots when the pipeline signature matches."""
+        meta = self.metadata.load()
+        if meta is None:
+            return {}
+        offsets: dict[str, int] = {k: int(v) for k, v in meta["offsets"].items()}
+        if meta.get("signature") == self.signature and self.config.operator_snapshots:
+            # Phase 1 — read + validate every snapshot before touching any
+            # node: a corrupt/unreadable file falls back cleanly to journal
+            # replay because nothing has been mutated yet.
+            restored: list[tuple[Any, dict]] = []
+            readable = True
+            try:
+                for node in self.session.graph.nodes:
+                    st = self.ops.read(_persistent_id(node), int(meta["epoch"]))
+                    if st is not None:
+                        restored.append((node, st))
+            except Exception as e:  # noqa: BLE001
+                readable = False
+                self.session.graph.log_error(f"operator snapshot unreadable: {e}")
+            if readable:
+                # Phase 2 — apply. A failure here leaves earlier nodes
+                # mutated; falling back to journal replay would double-count
+                # their state, so fail loudly instead.
+                applied = 0
+                try:
+                    for node, st in restored:
+                        node.restore_state(st)
+                        applied += 1
+                except Exception as e:  # noqa: BLE001
+                    raise RuntimeError(
+                        f"operator state restore failed after {applied} of "
+                        f"{len(restored)} operators ({e}); persisted state is "
+                        "incompatible with this pipeline. Clear the "
+                        "persistence directory or revert the change."
+                    ) from e
+                self.epoch = int(meta["epoch"])
+                self.restored = True
+                self._restored_offsets = offsets
+                return offsets
+        # fall back to full journal replay — only sound if the head exists
+        for name in offsets:
+            head = self.journal.head_offset(name)
+            if head > 0:
+                raise RuntimeError(
+                    f"persisted state for {name!r} was compacted up to offset "
+                    f"{head} but the pipeline changed (signature mismatch); "
+                    "cannot resume. Clear the persistence directory or revert "
+                    "the pipeline/worker configuration."
+                )
+        return {name: 0 for name in offsets}
+
+    # --------------------------------------------------------- journaling
+
+    def open_writer(self, name: str, start: int) -> None:
+        self._writers[name] = self.journal.open_segment(name, start)
+
+    def append(self, name: str, key_value: int, row: tuple, diff: int) -> None:
+        # always via the manager: checkpoints roll segments underneath
+        self._writers[name].append(key_value, row, diff)
+
+    def flush_journal(self, name: str) -> None:
+        self._writers[name].flush()
+
+    # --------------------------------------------------------- checkpoint
+
+    def due(self) -> bool:
+        interval = self.config.snapshot_interval_ms / 1000.0
+        return (_time.monotonic() - self._last_checkpoint) >= interval
+
+    def checkpoint(self, finalized_time: int) -> None:
+        """Durable commit of everything consumed so far."""
+        self._last_checkpoint = _time.monotonic()
+        # 1. journal segments durable
+        offsets: dict[str, int] = {}
+        for name, w in self._writers.items():
+            w.flush(sync=True)
+            offsets[name] = w.next_offset
+        # 2. operator snapshots for the next epoch
+        epoch = self.epoch + 1
+        wrote_ops = False
+        if self.config.operator_snapshots:
+            wrote_ops = True
+            for node in self.session.graph.nodes:
+                st = node.persist_state()
+                if st is not None:
+                    self.ops.write(_persistent_id(node), epoch, st)
+        # 3. metadata commit (the linearization point)
+        self.metadata.commit(epoch, offsets, self.signature, finalized_time)
+        self.epoch = epoch
+        # 4. compaction: journal head + old snapshot epochs are now dead
+        if wrote_ops:
+            self.ops.compact(epoch)
+            for name, committed in offsets.items():
+                self.journal.compact(name, committed)
+                # roll the segment so future compactions can free it
+                w = self._writers[name]
+                if w.count:
+                    w.close()
+                    self._writers[name] = self.journal.open_segment(
+                        name, w.next_offset
+                    )
+
+    def close(self) -> None:
+        for w in self._writers.values():
+            w.close()
 
 
 def attach_persistence(session: Any, config: Config) -> None:
-    """Wire input-snapshot journaling + replay into a lowering session."""
+    """Wire journaling + operator snapshots + replay into a session."""
     if config.backend.kind != "filesystem" or not config.backend.path:
         return
-    journal = SnapshotJournal(config.backend.path)
+    if config.persistence_mode in ("UDF_CACHING", "udf_caching"):
+        return  # cache-only mode: UDF caches use the backend directly
+    manager = CheckpointManager(session, config)
+    replay_offsets = manager.restore()
 
     from pathway_tpu.engine.runtime import Connector
 
     class PersistentConnector(Connector):
+        """Journals the parsed event stream; on restart replays the
+        journal tail (after the committed offset — operator snapshots
+        already contain everything before it) and seeks the live source
+        past every journaled event."""
+
         def __init__(self, inner: Connector, name: str):
             super().__init__(name, inner.session)
             self.inner = inner
-            self.replayed = journal.load(name)
-            self.n_replayed = len(self.replayed)
-            self.skip = self.n_replayed  # offset-seek: skip already-seen events
-            self._appender = journal.appender(name)
+            self.committed = replay_offsets.get(name, 0)
+            self.tail = manager.journal.load_from(name, self.committed)
+            total = manager.journal.total_events(name)
+            # live-source seek: skip events the journal already has
+            self.skip = total
+            manager.open_writer(name, total)
             self._replay_done = False
             self._seen = 0
 
@@ -118,17 +482,20 @@ def attach_persistence(session: Any, config: Config) -> None:
             out = []
             if not self._replay_done:
                 self._replay_done = True
-                for (kv, row, diff) in self.replayed:
+                for (_off, kv, row, diff) in self.tail:
                     out.append((Key(kv), row, diff))
+                self.tail = []
             live = self.inner.poll()
+            wrote = False
             for (key, row, diff) in live:
                 self._seen += 1
                 if self._seen <= self.skip:
-                    continue  # replayed from snapshot already
-                pickle.dump((key.value, row, diff), self._appender)
+                    continue  # journaled in a previous run; replayed above
+                manager.append(self.name, key.value, row, diff)
+                wrote = True
                 out.append((key, row, diff))
-            if live:
-                self._appender.flush()
+            if wrote:
+                manager.flush_journal(self.name)
             return out
 
         @property
@@ -138,6 +505,22 @@ def attach_persistence(session: Any, config: Config) -> None:
     session.connectors = [
         PersistentConnector(c, c.name) for c in session.connectors
     ]
+    session.checkpointer = manager
 
 
-__all__ = ["Backend", "Config", "attach_persistence", "SnapshotJournal"]
+# Backwards-compatible alias used by earlier tests/tools.
+class SnapshotJournal(SegmentedJournal):
+    def load(self, name: str) -> list:
+        return [(kv, row, diff) for (_o, kv, row, diff) in self.load_from(name, 0)]
+
+
+__all__ = [
+    "Backend",
+    "Config",
+    "attach_persistence",
+    "CheckpointManager",
+    "MetadataStore",
+    "OperatorSnapshotStore",
+    "SegmentedJournal",
+    "SnapshotJournal",
+]

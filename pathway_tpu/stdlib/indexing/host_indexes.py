@@ -57,6 +57,11 @@ class _FilterCache:
     def __init__(self) -> None:
         self._cache: dict[str, Callable[[Any], bool]] = {}
 
+    def __reduce__(self):
+        # compiled predicates are closures; rebuild lazily after unpickle
+        # (operator-snapshot persistence pickles whole host indexes)
+        return (_FilterCache, ())
+
     def get(self, expression: str) -> Callable[[Any], bool]:
         fn = self._cache.get(expression)
         if fn is None:
@@ -95,6 +100,14 @@ class VectorSlabIndex(HostIndex):
         self._device_docs = None
         self._device_valid = None
         self._filters = _FilterCache()
+
+    def __getstate__(self):
+        # device mirrors are rebuilt lazily on first search after unpickle
+        st = dict(self.__dict__)
+        st["_device_docs"] = None
+        st["_device_valid"] = None
+        st["_device_dirty"] = True
+        return st
 
     # ------------------------------------------------------------- mutation
 
