@@ -74,7 +74,18 @@ class InputSession:
 class Connector:
     """A data source with its own reader thread (reference:
     src/connectors/mod.rs:427 Connector::run — one thread per input
-    connector, poller drained by the main pump)."""
+    connector, poller drained by the main pump).
+
+    `replay_style` drives persistence resume (reference: seekable vs
+    non-seekable sources in src/persistence/frontier.rs offset logic):
+      * 'seekable' — the source re-reads deterministically from the start
+        on every run (files, scripted subjects); resume skips the first N
+        live events already journaled.
+      * 'live' — the source only ever delivers new events (message
+        queues); nothing is skipped, the journal supplies history.
+    """
+
+    replay_style = "seekable"
 
     def __init__(self, name: str, session: InputSession):
         self.name = name

@@ -10,7 +10,7 @@ importable so templates/YAML configs parse).
 from __future__ import annotations
 
 import importlib
-from typing import Any, Callable
+from typing import Any
 
 
 def require_module(name: str, family: str) -> Any:
@@ -23,21 +23,6 @@ def require_module(name: str, family: str) -> Any:
         ) from e
 
 
-def gated_reader(family: str, module: str) -> Callable:
-    def read(*args: Any, **kwargs: Any) -> Any:
-        require_module(module, family)
-        raise NotImplementedError(
-            f"pw.io.{family}.read: client {module!r} unavailable in this build"
-        )
-
-    return read
-
-
-def gated_writer(family: str, module: str) -> Callable:
-    def write(*args: Any, **kwargs: Any) -> None:
-        require_module(module, family)
-        raise NotImplementedError(
-            f"pw.io.{family}.write: client {module!r} unavailable in this build"
-        )
-
-    return write
+# (the former gated_reader/gated_writer stubs are gone: every connector
+# family now carries a real implementation, raising ImportError only when
+# its client library is genuinely absent)

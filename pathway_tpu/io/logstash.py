@@ -1,9 +1,37 @@
-"""pw.io.logstash — API-parity connector (reference: io/logstash).
+"""pw.io.logstash — stream table updates to Logstash's HTTP input.
 
-Client library gated: see io/_external.py.
+Reference parity: python/pathway/io/logstash/__init__.py:14 — in the
+reference this is a thin delegation to the HTTP writer (flat JSON objects
+with time/diff fields), and it is the same here: the HTTP egress
+connector is fully native (io/http).
 """
 
-from pathway_tpu.io._external import gated_reader, gated_writer
+from __future__ import annotations
 
-read = gated_reader("logstash", "requests")
-write = gated_writer("logstash", "requests")
+from typing import Any
+
+from pathway_tpu.io.http import write as http_write
+
+
+def write(
+    table: Any,
+    endpoint: str,
+    n_retries: int = 0,
+    retry_policy: Any = None,
+    connect_timeout_ms: int | None = None,
+    request_timeout_ms: int | None = None,
+) -> None:
+    """Sends the stream of updates from the table to the HTTP input of
+    Logstash as flat JSON objects with `time` and `diff` fields."""
+    http_write(
+        table,
+        endpoint,
+        method="POST",
+        format="json",
+        n_retries=n_retries,
+        connect_timeout_ms=connect_timeout_ms,
+        request_timeout_ms=request_timeout_ms,
+    )
+
+
+__all__ = ["write"]

@@ -86,6 +86,7 @@ def read(
     format: str = "json",  # noqa: A002
     autocommit_duration_ms: int | None = None,
     name: str | None = None,
+    replay_style: str = "seekable",
     **kwargs: Any,
 ) -> Table:
     if schema is None:
@@ -106,7 +107,9 @@ def read(
                 subject.on_stop()
                 sess.close()
 
-        return ThreadConnector(name or type(subject).__name__, session, run_fn)
+        connector = ThreadConnector(name or type(subject).__name__, session, run_fn)
+        connector.replay_style = replay_style
+        return connector
 
     spec = OpSpec("connector", [], factory=factory, upsert=upsert, name=name)
     return Table(spec, schema, univ.Universe())

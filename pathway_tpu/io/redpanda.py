@@ -1,9 +1,10 @@
-"""pw.io.redpanda — API-parity connector (reference: io/redpanda).
+"""pw.io.redpanda — Kafka-API-compatible source/sink.
 
-Client library gated: see io/_external.py.
+Reference parity: python/pathway/io/redpanda/__init__.py, which is the
+Kafka connector addressed at a Redpanda broker (the wire protocol is the
+same); identical delegation here.
 """
 
-from pathway_tpu.io._external import gated_reader, gated_writer
+from pathway_tpu.io.kafka import read, simple_read, write
 
-read = gated_reader("redpanda", "confluent_kafka")
-write = gated_writer("redpanda", "confluent_kafka")
+__all__ = ["read", "simple_read", "write"]

@@ -1,9 +1,27 @@
-"""pw.io.s3_csv — API-parity connector (reference: io/s3_csv).
+"""pw.io.s3_csv — CSV-specialized S3 reader.
 
-Client library gated: see io/_external.py.
+Reference parity: python/pathway/io/s3_csv/__init__.py, which fixes the
+format of the general S3 reader to CSV; identical delegation here.
 """
 
-from pathway_tpu.io._external import gated_reader, gated_writer
+from __future__ import annotations
 
-read = gated_reader("s3_csv", "boto3")
-write = gated_writer("s3_csv", "boto3")
+from typing import Any
+
+from pathway_tpu.io.s3 import AwsS3Settings
+from pathway_tpu.io.s3 import read as s3_read
+
+
+def read(
+    path: str,
+    *,
+    aws_s3_settings: AwsS3Settings | None = None,
+    schema: Any = None,
+    **kwargs: Any,
+) -> Any:
+    return s3_read(
+        path, "csv", aws_s3_settings=aws_s3_settings, schema=schema, **kwargs
+    )
+
+
+__all__ = ["AwsS3Settings", "read"]

@@ -469,8 +469,10 @@ def attach_persistence(session: Any, config: Config) -> None:
             self.committed = replay_offsets.get(name, 0)
             self.tail = manager.journal.load_from(name, self.committed)
             total = manager.journal.total_events(name)
-            # live-source seek: skip events the journal already has
-            self.skip = total
+            # seekable sources re-read from the start: skip events the
+            # journal already has. Live sources (message queues) only
+            # deliver new events — skip nothing.
+            self.skip = total if inner.replay_style == "seekable" else 0
             manager.open_writer(name, total)
             self._replay_done = False
             self._seen = 0
