@@ -201,13 +201,28 @@ class Runtime:
 
 
 class IterateNode(Node):
-    """Fixpoint iteration (reference: iterate dataflow.rs:3737).
+    """Incremental fixpoint iteration (reference: iterate dataflow.rs:3737,
+    which runs the loop body in a nested product-timestamp scope).
 
-    v0 strategy: per outer timestamp, re-run the loop body over the full
-    accumulated input collections until the iterated collections stop
-    changing, then emit the diff of the outputs versus what was previously
-    emitted. Incremental-within-loop is a later optimization; the semantics
-    (per-time fixpoint, diff-based output) match.
+    One PERSISTENT body graph lives across outer timestamps and
+    iterations; every stateful operator inside it keeps its arrangement,
+    so each round processes only deltas:
+
+      * outer input deltas are pushed into the body's placeholder inputs;
+      * per round, the feedback delta into an iterated placeholder is
+        (capture's wave delta) ⊖ (what was pushed into that placeholder
+        this round) — an O(changes) identity: with P the placeholder's
+        accumulated collection and C = F(P) the capture state, the desired
+        push is C ⊖ P, and after each previous push P equaled C, so the
+        difference is exactly the new wave delta minus this round's push;
+      * the loop stops when the feedback consolidates to nothing (P = C,
+        the fixpoint) or `iteration_limit` rounds elapse.
+
+    An input update therefore re-converges from the previous fixpoint in
+    O(affected) work — e.g. one edge insert into pagerank touches only the
+    vertices whose ranks actually move. The body is expected to be a
+    convergent fixpoint (the reference's iterate contract); with a warm
+    start, `iteration_limit` bounds the re-convergence rounds per update.
     """
 
     def __init__(
@@ -217,82 +232,216 @@ class IterateNode(Node):
         input_names: list[str],
         iterated_names: list[str],
         output_names: list[str],
-        step_fn: Callable[[dict[str, list[Entry]]], dict[str, list[Entry]]],
+        sub_graph: Graph,
+        placeholder_nodes: dict[str, InputNode],
+        captures: dict[str, "CaptureNode"],
+        static_batches: list[tuple[int, InputNode, list[Entry]]],
         iteration_limit: int | None = None,
     ):
         super().__init__(graph, inputs)
-        self._persist_attrs = ("states", "emitted")
         self.persist_signature = lambda: (  # type: ignore[method-assign]
-            f"IterateNode/{input_names}/{iterated_names}"
-            f"/{output_names}/{iteration_limit}"
+            f"IterateNode/{input_names}/{iterated_names}/{output_names}"
+            f"/{iteration_limit}/"
+            + ",".join(n.persist_signature() for n in sub_graph.nodes)
         )
         self.input_names = input_names
         self.iterated_names = iterated_names
         self.output_names = output_names
-        self.step_fn = step_fn
+        self.sub_graph = sub_graph
+        self.placeholder_nodes = placeholder_nodes
+        self.captures = captures
+        self.static_batches = static_batches
         self.iteration_limit = iteration_limit
-        self.states = {name: KeyedState() for name in input_names}
-        self.emitted: dict[str, dict[Key, tuple]] = {name: {} for name in output_names}
         self.out_nodes: dict[str, InputNode] = {}
+        self.inner_t = 0
+        # body-closure static batches not yet released (outer-time gated)
+        self._pending_statics = sorted(static_batches, key=lambda b: b[0])
+        # True when a limit-truncated convergence left feedback queued in
+        # the placeholders; the next wave resumes the loop even without
+        # new outer input
+        self._pending_convergence = False
+        self._ended = False
+        # capture-stream read positions (per output name)
+        self._read_pos = {name: 0 for name in output_names}
+        # mirror of each iterated placeholder's accumulated collection:
+        # outer deltas arrive against the INPUT rows but the placeholder
+        # holds the CONVERGED rows, so updates/retractions must be
+        # translated onto the current iterate value per key (iterate
+        # bodies are key-preserving — the reference requires the returned
+        # iterated table to keep the input universe)
+        self._fed = {name: KeyedState() for name in iterated_names}
 
     def set_output_node(self, name: str, node: InputNode) -> None:
         self.out_nodes[name] = node
 
+    # ------------------------------------------------- operator snapshots
+
+    def persist_state(self) -> dict:
+        return {
+            "inner_t": self.inner_t,
+            "pending_statics": self._pending_statics_state(),
+            "pending_convergence": self._pending_convergence,
+            "read_pos": self._read_pos,
+            "fed": self._fed,
+            "sub": [n.persist_state() for n in self.sub_graph.nodes],
+        }
+
+    def _pending_statics_state(self) -> list:
+        # static batch entries are picklable; node identity maps by index
+        idx = {id(n): i for i, n in enumerate(self.sub_graph.nodes)}
+        return [
+            (t, idx[id(node)], entries) for (t, node, entries) in self._pending_statics
+        ]
+
+    def restore_state(self, st: dict) -> None:
+        self.inner_t = st["inner_t"]
+        self._pending_convergence = st["pending_convergence"]
+        self._pending_statics = [
+            (t, self.sub_graph.nodes[i], entries)
+            for (t, i, entries) in st["pending_statics"]
+        ]
+        self._read_pos = st["read_pos"]
+        self._fed = st["fed"]
+        for node, sub_st in zip(self.sub_graph.nodes, st["sub"]):
+            if sub_st is not None:
+                node.restore_state(sub_st)
+
+    # ------------------------------------------------------------- pumping
+
+    def _translate(self, name: str, batch: list[Entry]) -> list[Entry]:
+        """Map outer input deltas onto the iterated collection's current
+        rows: an update restarts key k's iteration from its new input
+        value; a retraction removes key k's converged row."""
+        fed = self._fed[name]
+        per_key: dict[Key, tuple | None] = {}
+        for key, row, diff in batch:
+            if diff > 0:
+                per_key[key] = row
+            else:
+                per_key.setdefault(key, None)
+        out: list[Entry] = []
+        for key, new_row in per_key.items():
+            cur = fed.get(key)
+            if cur is not None:
+                out.append((key, cur, -1))
+            if new_row is not None:
+                out.append((key, new_row, 1))
+        out = consolidate(out)
+        fed.update(out)
+        return out
+
+    def _wave_delta(self, name: str) -> list[Entry]:
+        """Capture-stream entries appended since the last read."""
+        cap = self.captures[name]
+        pos = self._read_pos.get(name, 0)
+        new = cap.stream[pos:]
+        self._read_pos[name] = len(cap.stream)
+        return [(k, row, d) for (_t, k, row, d) in new]
+
+    def _release_statics(self, time: int) -> bool:
+        """Push body-closure static batches whose scripted time has come
+        (outer and scripted times share the even-ms domain for static
+        runs; streaming wall-clock times release everything at once)."""
+        released = False
+        while self._pending_statics and self._pending_statics[0][0] <= time:
+            _t, node, entries = self._pending_statics.pop(0)
+            node.push(list(entries))
+            released = True
+        return released
+
     def finish_time(self, time: int) -> None:
-        any_change = False
-        for i, name in enumerate(self.input_names):
-            batch = self.take_input(i)
-            if batch:
-                any_change = True
-                self.states[name].update(batch)
-        if not any_change:
+        batches = {
+            name: self.take_input(i) for i, name in enumerate(self.input_names)
+        }
+        released = self._release_statics(time)
+        if not any(batches.values()) and not released and not self._pending_convergence:
             return
-        cur = {name: self.states[name].as_entries() for name in self.input_names}
-        n = 0
+        self._pending_convergence = False
+        # External (outer) pushes put the placeholder out of sync with the
+        # capture; they are compensated exactly once, in the first round's
+        # feedback. Feedback pushes re-establish P = C, so from round 2 on
+        # the feedback is the wave delta alone.
+        external: dict[str, list[Entry]] = {name: [] for name in self.iterated_names}
+        for name, batch in batches.items():
+            if not batch:
+                continue
+            batch = consolidate(batch)
+            if name in external:
+                batch = self._translate(name, batch)
+                external[name] = batch
+            if batch:
+                self.placeholder_nodes[name].push(batch)
+        out_start = {name: self._read_pos[name] for name in self.output_names}
+        rounds = 0
         while True:
-            outs = self.step_fn(cur)
-            n += 1
-            changed = False
+            self.inner_t += 2
+            self.sub_graph.step(self.inner_t)
+            rounds += 1
+            quiescent = True
             for name in self.iterated_names:
-                if name in outs and _collections_differ(cur[name], outs[name]):
-                    changed = True
-                cur[name] = outs.get(name, cur[name])
-            if not changed:
+                delta = self._wave_delta(name)
+                feedback = consolidate(
+                    delta + [(k, row, -d) for (k, row, d) in external.pop(name, [])]
+                )
+                external[name] = []
+                if feedback:
+                    quiescent = False
+                    self._fed[name].update(feedback)
+                    self.placeholder_nodes[name].push(feedback)
+            if quiescent:
                 break
-            if self.iteration_limit is not None and n >= self.iteration_limit:
+            if self.iteration_limit is not None and rounds >= self.iteration_limit:
+                # the final feedback is already queued in the placeholders
+                # (so P tracks C — the loop invariant survives truncation);
+                # convergence resumes on the next wave
+                self._pending_convergence = True
                 break
+        # emit each output's net change over this outer timestamp
         for name in self.output_names:
-            result = outs.get(name, cur.get(name, []))
-            new_state: dict[Key, tuple] = {}
-            for key, row, diff in consolidate(result):
-                if diff > 0:
-                    new_state[key] = row
-            old_state = self.emitted[name]
-            delta: list[Entry] = []
-            for key, row in old_state.items():
-                nrow = new_state.get(key)
-                if nrow is None or freeze_row(nrow) != freeze_row(row):
-                    delta.append((key, row, -1))
-            for key, row in new_state.items():
-                orow = old_state.get(key)
-                if orow is None or freeze_row(orow) != freeze_row(row):
-                    delta.append((key, row, 1))
-            self.emitted[name] = new_state
+            cap = self.captures[name]
+            delta = consolidate(
+                [
+                    (k, row, d)
+                    for (_t, k, row, d) in cap.stream[out_start[name]:]
+                ]
+            )
+            self._read_pos[name] = len(cap.stream)
             out_node = self.out_nodes.get(name)
             if out_node is not None and delta:
                 out_node.push(delta)
                 # downstream of out_node runs later in topo order within
                 # this same wave because out_node was created after self
                 out_node.finish_time(time)
+        # consumed capture prefixes are dead: truncate so memory and
+        # checkpoint size track the live collection, not total history
+        for name in self.output_names:
+            cap = self.captures[name]
+            if self._read_pos[name] == len(cap.stream):
+                cap.stream.clear()
+                self._read_pos[name] = 0
 
-
-def _collections_differ(a: list[Entry], b: list[Entry]) -> bool:
-    def norm(entries: list[Entry]) -> set:
-        return {
-            (key.value, freeze_row(row), diff) for key, row, diff in consolidate(entries)
-        }
-
-    return norm(a) != norm(b)
+    def on_end(self, time: int) -> None:
+        """End-of-stream: release any remaining closure statics, flush the
+        body graph's own on_end behavior (buffers etc.), and run the loop
+        to quiescence one final time. The emission happens in the
+        finish_time that Graph.end calls right after this."""
+        if self._ended:
+            return
+        self._ended = True
+        released = False
+        while self._pending_statics:
+            _t, node, entries = self._pending_statics.pop(0)
+            node.push(list(entries))
+            released = True
+        self.inner_t += 2
+        for node in self.sub_graph.nodes:
+            node.on_end(self.inner_t)
+        # did end-flushing produce anything to process?
+        flushed = any(
+            any(buf for buf in node.buffers) for node in self.sub_graph.nodes
+        ) or any(n.pending for n in self.placeholder_nodes.values())
+        if released or flushed:
+            self._pending_convergence = True
 
 
 class AsyncApplyNode(Node):

@@ -101,6 +101,7 @@ class Session:
         self.connectors: list[Connector] = []
         self.iterate_nodes: dict[int, IterateNode] = {}
         self.placeholder_data: dict[str, list] = {}
+        self.placeholder_nodes: dict[str, eng.InputNode] = {}
         self.autocommit_ms = 2
         self.monitors: list[Callable[[int], None]] = []
         # PATHWAY_THREADS worker shards for stateful operators; read per
@@ -230,6 +231,7 @@ class Session:
         if kind == "iterate_placeholder":
             node = eng.InputNode(g)
             name = spec.params["name"]
+            self.placeholder_nodes[name] = node
             entries = self.placeholder_data.get(name, [])
             if entries:
                 self.static_batches.append((0, node, list(entries)))
@@ -597,17 +599,24 @@ class Session:
         input_nodes = [self.node_of(t) for t in it_spec.inputs.values()]
         input_names = list(it_spec.inputs.keys())
 
-        def step_fn(data: dict[str, list]) -> dict[str, list]:
-            sub = Session()
-            sub.placeholder_data = data
-            captures: dict[str, eng.CaptureNode] = {}
-            for name, t in it_spec.results.items():
-                captures[name] = eng.CaptureNode(sub.graph, sub.node_of(t))
-            runtime = Runtime(sub.graph)
-            runtime.run_static(sub.static_batches)
-            return {
-                name: cap.state.as_entries() for name, cap in captures.items()
-            }
+        # ONE persistent body graph: its stateful operators keep their
+        # arrangements across outer timestamps and iteration rounds, so
+        # every round is delta-driven (see IterateNode).
+        sub = Session()
+        captures: dict[str, eng.CaptureNode] = {}
+        for name, t in it_spec.results.items():
+            captures[name] = eng.CaptureNode(sub.graph, sub.node_of(t))
+        if sub.connectors:
+            raise NotImplementedError(
+                "pw.iterate bodies cannot reference streaming connector "
+                "tables; materialize the stream outside the loop and pass "
+                "it as an iterate input"
+            )
+        # placeholders never lowered (unreachable from the results) still
+        # need a node for the outer deltas to land in
+        for name in input_names:
+            if name not in sub.placeholder_nodes:
+                sub.placeholder_nodes[name] = eng.InputNode(sub.graph)
 
         node = IterateNode(
             self.graph,
@@ -615,7 +624,10 @@ class Session:
             input_names,
             it_spec.iterated_names,
             list(it_spec.results.keys()),
-            step_fn,
+            sub.graph,
+            sub.placeholder_nodes,
+            captures,
+            sub.static_batches,
             it_spec.iteration_limit,
         )
         self.iterate_nodes[id(it_spec)] = node

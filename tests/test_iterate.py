@@ -1,0 +1,106 @@
+"""Incremental fixpoint iteration (engine/runtime.py IterateNode).
+
+VERDICT r1 acceptance: an input update re-converges from the previous
+fixpoint in O(affected), not O(all) — demonstrated by a two-component
+pagerank where an edge change in the small component emits zero updates
+for the large component's vertices.
+"""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.graphs import pagerank
+from tests.utils import T, run_capture
+
+
+def _edges_markdown() -> str:
+    lines = ["u | w | __time__ | __diff__"]
+    # component A: a 40-vertex ring (static, at t=2)
+    for i in range(40):
+        lines.append(f"a{i} | a{(i + 1) % 40} | 2 | 1")
+    # component B: 3 vertices (static at t=2), one edge added at t=4
+    lines.append("b0 | b1 | 2 | 1")
+    lines.append("b1 | b0 | 2 | 1")
+    lines.append("b0 | b2 | 4 | 1")
+    lines.append("b2 | b0 | 4 | 1")
+    return "\n".join(lines)
+
+
+def test_pagerank_edge_update_touches_only_affected_component():
+    edges = T(_edges_markdown()).with_id_from(pw.this.u, pw.this.w)
+    ranks = pagerank(edges.select(u=edges.u, v=edges.w), steps=60)
+    cap = run_capture(ranks)
+
+    # final ranks exist for every vertex
+    vids = {row[0] for row in cap.state.rows.values()}
+    assert vids == {f"a{i}" for i in range(40)} | {"b0", "b1", "b2"}
+
+    # updates emitted after the t=4 edge insert touch ONLY component B:
+    # the iterate body re-converges from the previous fixpoint, so the
+    # 40-vertex ring (unaffected) produces no deltas at all
+    late = [row[0] for (t, _k, row, _d) in cap.stream if t > 2]
+    assert late, "the edge insert must produce some rank updates"
+    assert all(v.startswith("b") for v in late), sorted(set(late))[:10]
+
+    # ring ranks are the uniform fixpoint (in-degree == out-degree == 1)
+    for row in cap.state.rows.values():
+        if row[0].startswith("a"):
+            assert abs(row[1] - 1.0) < 1e-6, row
+
+
+def test_iterate_streaming_new_rows_converge_individually():
+    def collatz_step(t):
+        return {
+            "t": t.select(
+                a=pw.if_else(
+                    t.a == 1, 1,
+                    pw.if_else(t.a % 2 == 0, t.a // 2, 3 * t.a + 1),
+                )
+            )
+        }
+
+    t = T(
+        """
+        a  | __time__ | __diff__
+        3  | 2        | 1
+        7  | 4        | 1
+        27 | 6        | 1
+        """
+    ).with_id_from(pw.this.a)
+    res = pw.iterate(collatz_step, t=t)
+    cap = run_capture(res)
+    assert sorted(r for (r,) in cap.state.rows.values()) == [1, 1, 1]
+    # each arrival converges at its own timestamp
+    times = sorted({t for (t, _k, row, d) in cap.stream if d > 0 and row == (1,)})
+    assert len(times) == 3
+
+
+def test_iterate_retraction_removes_converged_row():
+    def step(t):
+        return {"t": t.select(a=pw.if_else(t.a >= 100, t.a, t.a * 10))}
+
+    t = T(
+        """
+        a | __time__ | __diff__
+        2 | 2        | 1
+        3 | 2        | 1
+        2 | 4        | -1
+        """
+    ).with_id_from(pw.this.a)
+    res = pw.iterate(step, t=t)
+    cap = run_capture(res)
+    assert sorted(r for (r,) in cap.state.rows.values()) == [300]
+
+
+def test_iterate_limit_bounds_rounds():
+    def step(t):
+        return {"t": t.select(a=t.a + 1)}  # never converges
+
+    t = T("a\n0").with_id_from(pw.this.a)
+    res = pw.iterate(step, t=t, iteration_limit=5)
+    cap = run_capture(res)
+    (val,) = [r[0] for r in cap.state.rows.values()]
+    # the limit bounds rounds PER WAVE; a truncated convergence resumes on
+    # the next wave (here: the end-of-stream flush), so a never-converging
+    # body advances limit rounds per wave instead of hanging
+    assert 10 <= val <= 12
