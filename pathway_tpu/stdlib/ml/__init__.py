@@ -1,5 +1,9 @@
-"""pw.ml (reference: stdlib/ml/) — filled in by the index/classifier work."""
+"""pw.ml — machine-learning stdlib (reference: stdlib/ml/).
 
-from pathway_tpu.stdlib.ml import classifiers, index, smart_table_ops, utils
+Subpackages: index (KNNIndex facade), classifiers (kNN-LSH),
+smart_table_ops (fuzzy joins), hmm (Viterbi decoding reducer), utils.
+"""
 
-__all__ = ["classifiers", "index", "smart_table_ops", "utils"]
+from pathway_tpu.stdlib.ml import classifiers, hmm, index, smart_table_ops, utils
+
+__all__ = ["classifiers", "hmm", "index", "smart_table_ops", "utils"]

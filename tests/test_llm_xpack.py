@@ -306,3 +306,57 @@ def test_qa_rest_server_end_to_end():
     assert "fish live in water" in str(retrieved)
     stats = post("/v1/statistics", {})
     assert "file_count" in str(stats)
+
+
+# ---------------------------------------------------------------- parsers
+
+
+def test_image_parser_describes_and_extracts():
+    import io
+
+    from PIL import Image
+
+    from pathway_tpu.xpacks.llm.parsers import ImageParser
+
+    class FakeVisionChat:
+        """Returns the prompt kind it saw; checks multimodal envelope."""
+
+        def __wrapped__(self, messages, **kwargs):
+            (msg,) = messages
+            parts = msg["content"]
+            assert parts[1]["type"] == "image_url"
+            assert parts[1]["image_url"]["url"].startswith("data:image/")
+            if "JSON" in parts[0]["text"]:
+                return '{"title": "a red square"}'
+            return "an image of a red square"
+
+    img = Image.new("RGB", (2400, 600), (255, 0, 0))
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+
+    parser = ImageParser(
+        FakeVisionChat(),
+        detail_parse_schema={"type": "object", "properties": {"title": {"type": "string"}}},
+        downsize_horizontal_width=640,
+    )
+    docs = parser.__wrapped__(buf.getvalue())
+    assert len(docs) == 1
+    text, meta = docs[0]
+    assert "red square" in text
+    assert meta["parsed"] == {"title": "a red square"}
+
+
+def test_slide_parser_gating():
+    import importlib.util
+
+    import pytest as _pytest
+
+    from pathway_tpu.xpacks.llm.parsers import SlideParser
+
+    parser = SlideParser(llm=object())
+    # pptx zip containers always need upstream conversion
+    with _pytest.raises(ValueError, match="PPTX"):
+        parser.__wrapped__(b"PK\x03\x04 fake pptx")
+    if importlib.util.find_spec("fitz") is None:
+        with _pytest.raises(ImportError, match="PyMuPDF"):
+            parser.__wrapped__(b"%PDF-1.4 fake")

@@ -1,0 +1,200 @@
+"""stdlib.ml: kNN-LSH classifiers, fuzzy joins, HMM decoding, accuracy."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import pathway_tpu as pw
+from tests.utils import T, run_capture
+
+
+def _vec_table(rows):
+    # rows: list of (vector, label)
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(data=np.ndarray, label=str),
+        [(np.asarray(v, np.float32), lbl) for v, lbl in rows],
+    )
+
+
+def test_knn_lsh_classifier_majority_vote():
+    from pathway_tpu.stdlib.ml.classifiers import (
+        knn_lsh_classifier_train,
+        knn_lsh_classify,
+    )
+
+    rng = np.random.default_rng(0)
+    reds = [(rng.normal([5, 0], 0.3), "red") for _ in range(12)]
+    blues = [(rng.normal([-5, 0], 0.3), "blue") for _ in range(12)]
+    data = _vec_table(reds + blues)
+    model = knn_lsh_classifier_train(data, L=8, type="euclidean", d=2, M=4, A=4.0)
+
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(data=np.ndarray),
+        [(np.asarray([4.5, 0.2], np.float32),), (np.asarray([-4.4, -0.3], np.float32),)],
+    )
+    predicted = knn_lsh_classify(model, data, queries, k=5)
+    cap = run_capture(predicted)
+    labels = sorted(r[0] for r in cap.state.rows.values())
+    assert labels == ["blue", "red"]
+
+
+def test_classifier_accuracy():
+    from pathway_tpu.stdlib.ml.utils import classifier_accuracy
+
+    exact = T(
+        """
+        uid | label
+        1   | red
+        2   | blue
+        3   | red
+        """
+    ).with_id_from(pw.this.uid)
+    # exact and predicted share keys; one mismatch
+    predicted = exact.select(
+        predicted_label=pw.if_else(pw.this.label == "blue", "red", pw.this.label)
+    )
+    acc = classifier_accuracy(predicted, exact)
+    cap = run_capture(acc)
+    rows = {tuple(r) for r in cap.state.rows.values()}
+    assert rows == {(2, True), (1, False)}
+
+
+def test_fuzzy_match_tables_one_to_one():
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_tables
+
+    left = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str),
+        [("apache kafka streaming",), ("jax tpu compiler",), ("postgres database",)],
+    ).with_id_from(pw.this.name)
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(title=str),
+        [
+            ("the kafka streaming platform",),
+            ("a database called postgres",),
+            ("compiler stack for tpu jax",),
+            ("totally unrelated entry zzz",),
+        ],
+    ).with_id_from(pw.this.title)
+
+    matches = fuzzy_match_tables(left, right)
+    cap = run_capture(matches)
+    # resolve pointers back to texts
+    lmap = {k: r[0] for k, r in run_capture(left).state.rows.items()}
+    rmap = {k: r[0] for k, r in run_capture(right).state.rows.items()}
+    got = {
+        (lmap[row[0]], rmap[row[1]])
+        for row in cap.state.rows.values()
+    }
+    assert got == {
+        ("apache kafka streaming", "the kafka streaming platform"),
+        ("jax tpu compiler", "compiler stack for tpu jax"),
+        ("postgres database", "a database called postgres"),
+    }
+    # one-to-one: no endpoint repeats
+    lefts = [row[0] for row in cap.state.rows.values()]
+    rights = [row[1] for row in cap.state.rows.values()]
+    assert len(set(lefts)) == len(lefts) and len(set(rights)) == len(rights)
+
+
+def test_fuzzy_self_match_excludes_identity():
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_self_match
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str),
+        [("green apple pie",), ("apple pie green",),
+         ("zebra crossing",), ("crossing zebra",)],
+    ).with_id_from(pw.this.name)
+    matches = fuzzy_self_match(t)
+    cap = run_capture(matches)
+    names = {k: r[0] for k, r in run_capture(t).state.rows.items()}
+    got = {
+        frozenset((names[row[0]], names[row[1]]))
+        for row in cap.state.rows.values()
+    }
+    # identity pairs excluded AND the real cross pairs found
+    assert got == {
+        frozenset(("green apple pie", "apple pie green")),
+        frozenset(("zebra crossing", "crossing zebra")),
+    }
+
+
+def test_fuzzy_match_with_hint_keeps_one_to_one():
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_tables
+
+    left = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str),
+        [("kafka streaming",), ("postgres database",)],
+    ).with_id_from(pw.this.name)
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(title=str),
+        [("kafka platform",), ("postgres store",)],
+    ).with_id_from(pw.this.title)
+    lids = {r[0]: k for k, r in run_capture(left).state.rows.items()}
+    rids = {r[0]: k for k, r in run_capture(right).state.rows.items()}
+    # force the CROSSED pairing by hand
+    hint = pw.debug.table_from_rows(
+        pw.schema_from_types(left=pw.Pointer, right=pw.Pointer, weight=float),
+        [(lids["kafka streaming"], rids["postgres store"], 99.0)],
+    )
+    matches = fuzzy_match_tables(left, right, by_hand_match=hint)
+    cap = run_capture(matches)
+    lefts = [row[0] for row in cap.state.rows.values()]
+    rights = [row[1] for row in cap.state.rows.values()]
+    # one-to-one even with the hint: no endpoint appears twice
+    assert len(set(lefts)) == len(lefts), lefts
+    assert len(set(rights)) == len(rights), rights
+    assert (lids["kafka streaming"], rids["postgres store"]) in {
+        (row[0], row[1]) for row in cap.state.rows.values()
+    }
+
+
+def test_hmm_reducer_decodes_path():
+    import networkx as nx
+
+    from pathway_tpu.stdlib.ml.hmm import create_hmm_reducer
+
+    def emission(state):
+        # HUNGRY manuls are grumpy, FULL manuls are happy (mostly)
+        def log_ppb(obs):
+            good = {"HUNGRY": "GRUMPY", "FULL": "HAPPY"}[state]
+            return np.log(0.9 if obs == good else 0.1)
+
+        return log_ppb
+
+    g = nx.DiGraph()
+    for i, s in enumerate(["HUNGRY", "FULL"]):
+        g.add_node(s, idx=i, calc_emission_log_ppb=emission(s))
+    for a in ("HUNGRY", "FULL"):
+        for b in ("HUNGRY", "FULL"):
+            g.add_edge(a, b, log_transition_ppb=np.log(0.7 if a == b else 0.3))
+    g.graph["start_nodes"] = ["HUNGRY", "FULL"]
+
+    obs = T(
+        """
+        observation | __time__
+        HAPPY       | 2
+        HAPPY       | 4
+        GRUMPY      | 6
+        GRUMPY      | 8
+        """
+    )
+    hmm_red = create_hmm_reducer(g)
+    decoded = obs.reduce(path=hmm_red(pw.this.observation))
+    cap = run_capture(decoded)
+    (path,) = [r[0] for r in cap.state.rows.values()]
+    assert path == ("FULL", "FULL", "HUNGRY", "HUNGRY")
+
+    # non-consecutive repeats: the decode must follow EVENT TIME, not the
+    # reducer's (unordered, value-collapsing) multiset combination order
+    obs2 = T(
+        """
+        observation | __time__
+        HAPPY       | 2
+        GRUMPY      | 4
+        GRUMPY      | 6
+        HAPPY       | 8
+        """
+    )
+    decoded2 = obs2.reduce(path=hmm_red(pw.this.observation))
+    (path2,) = [r[0] for r in run_capture(decoded2).state.rows.values()]
+    assert path2 == ("FULL", "HUNGRY", "HUNGRY", "FULL")
