@@ -50,6 +50,18 @@ from pathway_tpu.internals.expression import (
 )
 from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.keys import Key as Pointer
+from pathway_tpu.internals.interactive import (
+    LiveTable,
+    enable_interactive_mode,
+)
+from pathway_tpu.internals.row_transformer import (
+    ClassArg,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
 from pathway_tpu.internals.run import run, run_all
 from pathway_tpu.internals.schema import (
     ColumnDefinition,
@@ -109,6 +121,8 @@ __all__ = [
     "apply", "apply_async", "apply_with_type", "cast", "declare_type",
     "coalesce", "require", "if_else", "make_tuple", "unwrap", "fill_error",
     "assert_table_has_schema", "table_transformer",
+    "transformer", "ClassArg", "input_attribute", "output_attribute",
+    "method", "input_method", "LiveTable", "enable_interactive_mode",
     "udf", "UDF", "udfs", "reducers",
     "column_definition", "ColumnDefinition", "schema_from_types",
     "schema_from_dict", "schema_from_pandas", "schema_builder",

@@ -194,6 +194,14 @@ class Node:
         # observability (reference: OperatorStats graph.rs:520)
         self.rows_in = 0
         self.rows_out = 0
+        # user-frame trace (set by lowering from the op spec) — enriches
+        # runtime error messages with the pipeline call site
+        self.trace: str | None = None
+
+    def log_error(self, message: str) -> None:
+        if self.trace:
+            message = f"{message} (at {self.trace})"
+        self.graph.log_error(message)
 
     def accept(self, input_idx: int, entries: list[Entry]) -> None:
         self.buffers[input_idx].extend(entries)
@@ -377,10 +385,10 @@ class FilterNode(Node):
             try:
                 keep = self.predicate(key, row)
             except Exception as e:  # noqa: BLE001
-                self.graph.log_error(f"filter: {type(e).__name__}: {e}")
+                self.log_error(f"filter: {type(e).__name__}: {e}")
                 keep = False
             if isinstance(keep, ErrorValue):
-                self.graph.log_error("filter: Error value in condition")
+                self.log_error("filter: Error value in condition")
                 keep = False
             if keep:
                 out.append((key, row, diff))
@@ -403,7 +411,7 @@ class ReindexNode(Node):
             try:
                 nk = self.key_fn(key, row)
             except Exception as e:  # noqa: BLE001
-                self.graph.log_error(f"reindex: {type(e).__name__}: {e}")
+                self.log_error(f"reindex: {type(e).__name__}: {e}")
                 continue
             out.append((nk, row, diff))
         self.emit(time, consolidate(out))
@@ -444,7 +452,7 @@ class FlattenNode(Node):
             elif isinstance(seq, (tuple, list)):
                 items = seq
             else:
-                self.graph.log_error(f"flatten: cannot flatten {type(seq).__name__}")
+                self.log_error(f"flatten: cannot flatten {type(seq).__name__}")
                 continue
             for i, item in enumerate(items):
                 new_row = row[: self.flatten_idx] + (item,) + row[self.flatten_idx + 1 :]
@@ -615,7 +623,7 @@ class JoinNode(Node):
         try:
             jk = fn(key, row)
         except Exception as e:  # noqa: BLE001
-            self.graph.log_error(f"join key: {type(e).__name__}: {e}")
+            self.log_error(f"join key: {type(e).__name__}: {e}")
             return None
         if isinstance(jk, ErrorValue) or (isinstance(jk, tuple) and any(isinstance(x, ErrorValue) for x in jk)):
             return None
@@ -853,7 +861,7 @@ class GroupByNode(Node):
             try:
                 gvals = self.gk_fn(key, row)
             except Exception as e:  # noqa: BLE001
-                self.graph.log_error(f"groupby key: {type(e).__name__}: {e}")
+                self.log_error(f"groupby key: {type(e).__name__}: {e}")
                 continue
             ftok = freeze_value(gvals)
             gid = self._gid_by_token.get(ftok)
@@ -869,7 +877,7 @@ class GroupByNode(Node):
                 try:
                     v = self.arg_fns[ri](key, row, time)[0]
                 except Exception as e:  # noqa: BLE001
-                    self.graph.log_error(f"reducer arg: {type(e).__name__}: {e}")
+                    self.log_error(f"reducer arg: {type(e).__name__}: {e}")
                     v = ERROR
                 if isinstance(v, (bool, np.bool_, int, np.integer)):
                     try:
@@ -931,14 +939,14 @@ class GroupByNode(Node):
             try:
                 gvals = self.gk_fn(key, row)
             except Exception as e:  # noqa: BLE001
-                self.graph.log_error(f"groupby key: {type(e).__name__}: {e}")
+                self.log_error(f"groupby key: {type(e).__name__}: {e}")
                 continue
             args = []
             for fn in self.arg_fns:
                 try:
                     args.append(fn(key, row, time))
                 except Exception as e:  # noqa: BLE001
-                    self.graph.log_error(f"reducer arg: {type(e).__name__}: {e}")
+                    self.log_error(f"reducer arg: {type(e).__name__}: {e}")
                     args.append(ERROR)
             token_g = freeze_value(gvals)
             if token_g not in self.gkeys:
@@ -1018,7 +1026,7 @@ class DeduplicateNode(Node):
             try:
                 inst = freeze_value(self.instance_fn(key, row))
             except Exception as e:  # noqa: BLE001
-                self.graph.log_error(f"deduplicate instance: {e}")
+                self.log_error(f"deduplicate instance: {e}")
                 continue
             prev = self.accepted.get(inst)
             try:
@@ -1028,7 +1036,7 @@ class DeduplicateNode(Node):
                     else True
                 )
             except Exception as e:  # noqa: BLE001
-                self.graph.log_error(f"deduplicate acceptor: {e}")
+                self.log_error(f"deduplicate acceptor: {e}")
                 ok = False
             if ok:
                 if inst not in self.ikeys:
@@ -1076,7 +1084,7 @@ class IxNode(Node):
             try:
                 ptr = self.pointer_fn(key, row)
             except Exception as e:  # noqa: BLE001
-                self.graph.log_error(f"ix pointer: {e}")
+                self.log_error(f"ix pointer: {e}")
                 continue
             self.source_by_ptr.update_one(
                 ptr.value if isinstance(ptr, Key) else freeze_value(ptr), (key, row, ptr), diff
@@ -1507,7 +1515,7 @@ class ExternalIndexNode(Node):
             try:
                 qdata, k, flt = self.query_fn(qkey, qrow)
             except Exception as e:  # noqa: BLE001
-                self.graph.log_error(f"index query: {type(e).__name__}: {e}")
+                self.log_error(f"index query: {type(e).__name__}: {e}")
                 results[qkey] = []
                 continue
             if isinstance(qdata, ErrorValue) or qdata is None:
@@ -1526,7 +1534,7 @@ class ExternalIndexNode(Node):
                     self.host_index.search(q, k, f) for _key, (q, k, f) in prepared
                 ]
         except Exception as e:  # noqa: BLE001
-            self.graph.log_error(f"index search: {type(e).__name__}: {e}")
+            self.log_error(f"index search: {type(e).__name__}: {e}")
             return None
         for (qkey, _item), matches in zip(prepared, all_matches):
             results[qkey] = matches
@@ -1580,7 +1588,7 @@ class ExternalIndexNode(Node):
                 try:
                     data, meta = self.index_fn(key, row)
                 except Exception as e:  # noqa: BLE001
-                    self.graph.log_error(f"index row: {type(e).__name__}: {e}")
+                    self.log_error(f"index row: {type(e).__name__}: {e}")
                     continue
                 try:
                     if diff > 0:
@@ -1594,7 +1602,7 @@ class ExternalIndexNode(Node):
                         del self.indexed[key]
                         index_changed = True
                 except Exception as e:  # noqa: BLE001
-                    self.graph.log_error(f"index update: {type(e).__name__}: {e}")
+                    self.log_error(f"index update: {type(e).__name__}: {e}")
         if d_batch:
             self.data_state.update(d_batch)
         out: list[Entry] = []

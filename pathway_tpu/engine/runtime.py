@@ -133,6 +133,8 @@ class Runtime:
         self.monitors: list[Callable[[int], None]] = []
         # checkpoint/resume orchestration (persistence.CheckpointManager)
         self.checkpointer: Any = None
+        # cooperative stop: ends the pump at the next wave boundary
+        self.stop_event: Any = None
 
     def next_time(self) -> int:
         self.time += 2  # even-ms granule, reference timestamp.rs:20-27
@@ -165,7 +167,8 @@ class Runtime:
                     m(t)
                 if self.checkpointer is not None and self.checkpointer.due():
                     self.checkpointer.checkpoint(t)
-            if all(c.done for c in self.connectors):
+            stopped = self.stop_event is not None and self.stop_event.is_set()
+            if stopped or all(c.done for c in self.connectors):
                 # final drain
                 final: bool = False
                 for c in self.connectors:
@@ -482,7 +485,7 @@ class AsyncApplyNode(Node):
                     try:
                         results[(k.value, freeze_row(r))] = self.fn(k, r)
                     except Exception as e:  # noqa: BLE001
-                        self.graph.log_error(f"apply: {type(e).__name__}: {e}")
+                        self.log_error(f"apply: {type(e).__name__}: {e}")
                         results[(k.value, freeze_row(r))] = ERROR
         out: list[Entry] = []
         for key, row, diff in entries:
@@ -501,7 +504,7 @@ class AsyncApplyNode(Node):
                     try:
                         value = self.fn(key, row)
                     except Exception as e:  # noqa: BLE001
-                        self.graph.log_error(f"apply: {type(e).__name__}: {e}")
+                        self.log_error(f"apply: {type(e).__name__}: {e}")
                         value = ERROR
                 else:
                     value = ERROR
@@ -589,7 +592,7 @@ class OutputNode(Node):
             except Exception as e:  # noqa: BLE001
                 last_err = e
                 _time.sleep(0.01)
-        self.graph.log_error(f"output failed after {self.RETRIES} retries: {last_err}")
+        self.log_error(f"output failed after {self.RETRIES} retries: {last_err}")
 
     def on_end(self, time: int) -> None:
         if not self._closed and self.close is not None:

@@ -23,6 +23,31 @@ def _m(name: str, expr: ColumnExpression, *args: Any, fn: Any, rt: Any):
     return MethodCallExpression(f"dt.{name}", expr, *args, fn=fn, return_type=rt)
 
 
+def _utc_to_wall_ns(utc_ns: int, tz_name: str) -> int:
+    """UTC instant (ns) -> local wall-clock ns in tz_name. Offsets are
+    whole minutes, so sub-second precision carries through exactly."""
+    from datetime import datetime, timezone
+    from zoneinfo import ZoneInfo
+
+    sec, rem = divmod(utc_ns, 1_000_000_000)
+    local = datetime.fromtimestamp(sec, timezone.utc).astimezone(ZoneInfo(tz_name))
+    offset = int(local.utcoffset().total_seconds())  # type: ignore[union-attr]
+    return (sec + offset) * 1_000_000_000 + rem
+
+
+def _wall_to_utc_ns(wall_ns: int, tz_name: str) -> int:
+    """Local wall-clock ns in tz_name -> UTC instant ns (ambiguous DST
+    times resolve to the pre-transition offset, like fold=0)."""
+    from datetime import datetime, timezone
+    from zoneinfo import ZoneInfo
+
+    sec, rem = divmod(wall_ns, 1_000_000_000)
+    naive = datetime.fromtimestamp(sec, timezone.utc).replace(tzinfo=None)
+    local = naive.replace(tzinfo=ZoneInfo(tz_name))
+    offset = int(local.utcoffset().total_seconds())  # type: ignore[union-attr]
+    return (sec - offset) * 1_000_000_000 + rem
+
+
 class DateTimeNamespace:
     def __init__(self, expr: ColumnExpression):
         self._expr = expr
@@ -86,9 +111,51 @@ class DateTimeNamespace:
     def to_utc(self, from_timezone: str = "UTC"):
         def f(x):
             if isinstance(x, DateTimeNaive):
-                return DateTimeUtc(ns=x.timestamp_ns())
+                return DateTimeUtc(ns=_wall_to_utc_ns(x.timestamp_ns(), from_timezone))
             return x
         return _m("to_utc", self._expr, fn=f, rt=dt.DATE_TIME_UTC)
+
+    def to_naive_in_timezone(self, timezone: str):
+        """UTC instant -> wall-clock time in `timezone` (reference
+        date_time.py to_naive_in_timezone)."""
+        return _m(
+            "to_naive_in_timezone", self._expr,
+            fn=lambda x: DateTimeNaive(ns=_utc_to_wall_ns(x.timestamp_ns(), timezone)),
+            rt=dt.DATE_TIME_NAIVE,
+        )
+
+    def add_duration_in_timezone(self, duration: Any, timezone: str):
+        """Wall-clock addition: +24h across a DST switch lands on the same
+        local hour (reference date_time.py add_duration_in_timezone)."""
+        def f(x, d):
+            wall = _utc_to_wall_ns(x.timestamp_ns(), timezone)
+            return DateTimeUtc(
+                ns=_wall_to_utc_ns(wall + _to_duration(d).nanoseconds(), timezone)
+            )
+
+        return _m("add_duration_in_timezone", self._expr, wrap_arg(duration),
+                  fn=f, rt=dt.DATE_TIME_UTC)
+
+    def subtract_duration_in_timezone(self, duration: Any, timezone: str):
+        def f(x, d):
+            wall = _utc_to_wall_ns(x.timestamp_ns(), timezone)
+            return DateTimeUtc(
+                ns=_wall_to_utc_ns(wall - _to_duration(d).nanoseconds(), timezone)
+            )
+
+        return _m("subtract_duration_in_timezone", self._expr, wrap_arg(duration),
+                  fn=f, rt=dt.DATE_TIME_UTC)
+
+    def subtract_date_time_in_timezone(self, other: Any, timezone: str):
+        """Difference measured on the wall clock of `timezone` (reference
+        date_time.py subtract_date_time_in_timezone)."""
+        def f(x, y):
+            a = _utc_to_wall_ns(x.timestamp_ns(), timezone)
+            b = _utc_to_wall_ns(y.timestamp_ns(), timezone)
+            return Duration(ns=a - b)
+
+        return _m("subtract_date_time_in_timezone", self._expr, wrap_arg(other),
+                  fn=f, rt=dt.DURATION)
 
     def round(self, duration: Any):
         return _m("round", self._expr, wrap_arg(duration),

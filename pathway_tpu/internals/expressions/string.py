@@ -28,6 +28,14 @@ class StringNamespace:
     def strip(self, chars=None):
         return _m("strip", self._expr, wrap_arg(chars), fn=lambda s, c: s.strip(c), rt=dt.STR)
 
+    def removeprefix(self, prefix):
+        return _m("removeprefix", self._expr, wrap_arg(prefix),
+                  fn=lambda s, p: s.removeprefix(p), rt=dt.STR)
+
+    def removesuffix(self, suffix):
+        return _m("removesuffix", self._expr, wrap_arg(suffix),
+                  fn=lambda s, p: s.removesuffix(p), rt=dt.STR)
+
     def lstrip(self, chars=None):
         return _m("lstrip", self._expr, wrap_arg(chars), fn=lambda s, c: s.lstrip(c), rt=dt.STR)
 

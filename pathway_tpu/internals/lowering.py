@@ -104,6 +104,10 @@ class Session:
         self.placeholder_nodes: dict[str, eng.InputNode] = {}
         self.autocommit_ms = 2
         self.monitors: list[Callable[[int], None]] = []
+        # cooperative stop for background runs (LiveTable.stop)
+        import threading as _threading
+
+        self.stop_event = _threading.Event()
         # PATHWAY_THREADS worker shards for stateful operators; read per
         # session so worker-count-invariance tests can flip it in-process.
         self.n_workers = worker_threads()
@@ -131,11 +135,20 @@ class Session:
         if spec.id in self.cache:
             return self.cache[spec.id]
         node = self._build(table, spec)
+        # user-frame trace for runtime error messages (trace.py parity)
+        trace = getattr(spec, "trace", None)
+        if trace and node.trace is None:
+            node.trace = trace
+            for replica in getattr(node, "replicas", []):
+                replica.trace = trace
         self.cache[spec.id] = node
         return node
 
     def _compile_rowwise(
-        self, main: Table, exprs: dict[str, ex.ColumnExpression]
+        self,
+        main: Table,
+        exprs: dict[str, ex.ColumnExpression],
+        trace: str | None = None,
     ) -> tuple[list[eng.Node], Callable]:
         """Returns (input nodes, fn(key, *rows) -> out_row), handling side
         tables and async sub-expressions."""
@@ -166,12 +179,15 @@ class Session:
 
         def guard(f):
             # per-column poison: a failing expression yields ERROR in its
-            # column only (reference: Value::Error semantics)
+            # column only (reference: Value::Error semantics); messages
+            # carry the user call site (trace.py parity)
+            suffix = f" (at {trace})" if trace else ""
+
             def g(key, rows):
                 try:
                     return f(key, rows)
                 except Exception as e:  # noqa: BLE001
-                    graph.log_error(f"{type(e).__name__}: {e}")
+                    graph.log_error(f"{type(e).__name__}: {e}{suffix}")
                     from pathway_tpu.internals.errors import ERROR
 
                     return ERROR
@@ -239,7 +255,7 @@ class Session:
 
         if kind == "rowwise":
             exprs = spec.params["exprs"]
-            input_nodes, fn = self._compile_rowwise(spec.inputs[0], exprs)
+            input_nodes, fn = self._compile_rowwise(spec.inputs[0], exprs, trace=spec.trace)
             return self._sharded(
                 input_nodes,
                 lambda sg, ins: eng.RowwiseNode(sg, ins, fn),
@@ -262,7 +278,7 @@ class Session:
             names = main._column_names()
             exprs = {n: ex.ColumnReference(main, n) for n in names}
             exprs["__cond__"] = cond
-            input_nodes, fn = self._compile_rowwise(main, exprs)
+            input_nodes, fn = self._compile_rowwise(main, exprs, trace=spec.trace)
             rw = self._sharded(
                 input_nodes,
                 lambda sg, ins: eng.RowwiseNode(sg, ins, fn),
@@ -435,6 +451,17 @@ class Session:
             it_node.set_output_node(name, out_node)
             return out_node
 
+        if kind == "row_transformer":
+            raise AssertionError("lowered via row_transformer_output")
+
+        if kind == "row_transformer_output":
+            parent = spec.params["parent"]
+            name = spec.params["name"]
+            tnode = self._get_transformer_node(parent)
+            out_node = eng.InputNode(self.graph)
+            tnode.set_output_node(name, out_node)
+            return out_node
+
         if kind == "external_index":
             from pathway_tpu.stdlib.indexing.lowering import build_external_index
 
@@ -591,6 +618,25 @@ class Session:
             [jnode], lambda sg, ins: eng.RowwiseNode(sg, ins, fn), [_route_key]
         )
 
+    # ----------------------------------------------------- row transformer
+
+    def _get_transformer_node(self, spec: OpSpec):
+        if not hasattr(self, "_transformer_nodes"):
+            self._transformer_nodes: dict[int, Any] = {}
+        if spec.id in self._transformer_nodes:
+            return self._transformer_nodes[spec.id]
+        from pathway_tpu.engine.transformer import RowTransformerNode
+
+        tf = spec.params["transformer"]
+        table_names = spec.params["table_names"]
+        input_nodes = [self.node_of(t) for t in spec.inputs]
+        node = RowTransformerNode(self.graph, input_nodes, dict(tf.classes))
+        for name, table in zip(table_names, spec.inputs):
+            node.set_columns(name, table._column_names())
+        node.trace = getattr(spec, "trace", None)
+        self._transformer_nodes[spec.id] = node
+        return node
+
     # ------------------------------------------------------------- iterate
 
     def _get_iterate_node(self, it_spec: Any) -> IterateNode:
@@ -656,6 +702,7 @@ class Session:
         runtime = Runtime(self.graph, autocommit_ms=self.autocommit_ms)
         runtime.monitors = list(self.monitors)
         runtime.checkpointer = getattr(self, "checkpointer", None)
+        runtime.stop_event = self.stop_event
         if not self.connectors:
             runtime.run_static(self.static_batches)
             return

@@ -14,6 +14,7 @@ computes until a run lowers the IR onto the engine
 from __future__ import annotations
 
 import itertools
+import os
 from typing import Any, Callable, Iterable, Mapping
 
 from pathway_tpu.internals import dtype as dt
@@ -41,9 +42,29 @@ class OpSpec:
         self.kind = kind
         self.inputs = inputs
         self.params = params
+        # user-frame trace: where in USER code this operator was created
+        # (reference: internals/trace.py:140 trace_user_frame) — surfaces
+        # in runtime error messages so failures point at pipeline code
+        self.trace = _user_frame()
 
     def __repr__(self) -> str:
         return f"OpSpec#{self.id}({self.kind})"
+
+
+def _user_frame() -> str | None:
+    """First stack frame outside pathway_tpu — the user call site."""
+    import sys
+
+    frame = sys._getframe(2) if hasattr(sys, "_getframe") else None
+    try:
+        while frame is not None:
+            fname = frame.f_code.co_filename
+            if f"pathway_tpu{os.sep}" not in fname and "importlib" not in fname:
+                return f"{fname}:{frame.f_lineno} in {frame.f_code.co_name}"
+            frame = frame.f_back
+    except Exception:  # noqa: BLE001 — tracing must never break building
+        return None
+    return None
 
 
 class JoinMode:
@@ -84,6 +105,13 @@ class Table:
 
     def column_names(self) -> list[str]:
         return self._column_names()
+
+    def live(self) -> Any:
+        """Start a live-updating view of this table on a background run
+        (reference: interactive.py LiveTable :130)."""
+        from pathway_tpu.internals.interactive import LiveTable
+
+        return LiveTable(self)
 
     def keys(self) -> list[str]:
         return self._column_names()

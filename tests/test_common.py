@@ -344,3 +344,41 @@ def test_sort_prev_next():
         """
     )
     assert_table_equality_wo_index(joined, expected)
+
+
+def test_error_messages_carry_user_trace():
+    """Runtime errors point at the pipeline call site (trace.py parity)."""
+    import pathway_tpu as pw
+
+    t = T("a | b\n6 | 0")
+    bad = t.select(q=t.a // t.b)  # the traced user frame
+    run_capture(bad)
+    entry = pw.global_error_log().entries[-1]
+    assert "ZeroDivisionError" in entry
+    assert "test_common.py" in entry and "test_error_messages_carry_user_trace" in entry
+
+
+def test_live_table_updates_and_finishes():
+    """pw.Table.live(): background run with atomically updated snapshots
+    (interactive.py LiveTable parity)."""
+    import time as _t
+
+    import pathway_tpu as pw
+    from pathway_tpu.io.python import ConnectorSubject
+
+    class Nums(ConnectorSubject):
+        def run(self):
+            for i in range(6):
+                self.next(g=f"g{i % 2}", v=i)
+                _t.sleep(0.01)
+
+    t = pw.io.python.read(Nums(), schema=pw.schema_from_types(g=str, v=int))
+    agg = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v))
+    lt = agg.live()
+    assert lt.wait(timeout=30)
+    rows = {r["g"]: r["s"] for r in lt.snapshot()}
+    assert rows == {"g0": 6, "g1": 9}  # 0+2+4, 1+3+5
+    assert not lt.failed
+    assert "g0" in str(lt)
+    df = lt.to_pandas()
+    assert set(df.g) == {"g0", "g1"}
