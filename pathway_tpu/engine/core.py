@@ -191,9 +191,11 @@ class Node:
         self.node_id = graph.register(self)
         for i, inp in enumerate(self.inputs):
             inp.downstream.append((self, i))
-        # observability (reference: OperatorStats graph.rs:520)
+        # observability (reference: OperatorStats graph.rs:520 + the
+        # per-operator probes of graph.rs:988-995)
         self.rows_in = 0
         self.rows_out = 0
+        self.time_ns = 0  # cumulative finish_time latency
         # user-frame trace (set by lowering from the op spec) — enriches
         # runtime error messages with the pipeline call site
         self.trace: str | None = None
@@ -275,8 +277,12 @@ class Graph:
         self.error_log.log(message)
 
     def step(self, time: int) -> None:
+        from time import perf_counter_ns
+
         for node in self.nodes:
+            t0 = perf_counter_ns()
             node.finish_time(time)
+            node.time_ns += perf_counter_ns() - t0
 
     def end(self, time: int) -> None:
         for node in self.nodes:

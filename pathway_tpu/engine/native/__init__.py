@@ -417,9 +417,29 @@ def split_csv_line(line: bytes, delim: bytes = b","):
     for i in range(n):
         raw = line[start[i]:end[i]]
         if quoted[i]:
-            raw = raw.strip()
-            if raw.startswith(b'"') and raw.endswith(b'"') and len(raw) >= 2:
-                raw = raw[1:-1]
-            raw = raw.replace(b'""', b'"')
+            raw = _decode_quoted_field(raw.strip())
         fields.append(raw.decode("utf-8", errors="replace"))
     return fields
+
+
+def _decode_quoted_field(raw: bytes) -> bytes:
+    """RFC-4180 quoted field with csv-module junk semantics: '\"a\"x' ->
+    'ax' (text after the closing quote concatenates, quotes dropped)."""
+    if not raw.startswith(b'"'):
+        return raw.replace(b'""', b'"')
+    parts = []
+    pos = 1
+    while True:
+        q = raw.find(b'"', pos)
+        if q == -1:  # unterminated quote: take the rest verbatim
+            parts.append(raw[pos:])
+            pos = len(raw)
+            break
+        if raw[q + 1 : q + 2] == b'"':  # doubled quote -> literal quote
+            parts.append(raw[pos : q + 1])
+            pos = q + 2
+        else:  # closing quote
+            parts.append(raw[pos:q])
+            pos = q + 1
+            break
+    return b"".join(parts) + raw[pos:]

@@ -25,6 +25,15 @@ from pathway_tpu.internals.errors import ERROR
 from pathway_tpu.internals.keys import Key
 
 
+class _DeferEval(BaseException):
+    """Internal control flow: evaluation too deep — compute `token`
+    bottom-up first. BaseException so user-code `except Exception`
+    blocks cannot swallow it."""
+
+    def __init__(self, token: tuple):
+        self.token = token
+
+
 class _RowHandle:
     """`self` inside an output attribute: one row of one member table."""
 
@@ -136,6 +145,11 @@ class RowTransformerNode(Node):
             self.rev_deps.setdefault(target, set()).add(reader[:2])
             self.fwd_deps.setdefault(reader[:2], set()).add(target)
 
+    # Native recursion costs ~3 Python frames per cross-row hop; chains
+    # longer than this budget switch to the defer/worklist driver below
+    # instead of blowing the interpreter stack.
+    _DEPTH_BUDGET = 64
+
     def value_of(self, tname: str, key: Key, attr: str) -> Any:
         meta = self.metas[tname]
         self._record_read((tname, key.value))
@@ -154,6 +168,11 @@ class RowTransformerNode(Node):
                 )
             if row is None:
                 raise KeyError(f"{tname}[{key}] does not exist")
+            if len(self._eval_stack) >= self._DEPTH_BUDGET:
+                # too deep to recurse natively: hand the token to the
+                # worklist driver, which memoizes it bottom-up and
+                # re-runs the shallow evaluations
+                raise _DeferEval(token)
             prev_reader = self._current_reader
             self._current_reader = token
             self._eval_stack.append(token)
@@ -164,7 +183,41 @@ class RowTransformerNode(Node):
                 self._current_reader = prev_reader
             self.memo[token] = value
             return value
+        helper = meta.helpers.get(attr)
+        if helper is not None:
+            if callable(helper):
+                import types
+
+                return types.MethodType(helper, _RowHandle(self, tname, key))
+            return helper
         raise AttributeError(f"{tname} has no attribute {attr!r}")
+
+    def eval_output(self, tname: str, key: Key, attr: str) -> Any:
+        """Worklist driver: evaluates `attr`, resolving arbitrarily deep
+        cross-row dependency chains without native recursion overflow.
+        Each deferred dependency is computed (memoized) first, then the
+        deferring evaluation re-runs — O(chain) total fn executions."""
+        pending: list[tuple] = [(tname, key.value, attr)]
+        keys: dict[int, Key] = {key.value: key}
+        while pending:
+            t, kv, a = pending[-1]
+            if (t, kv, a) in self.memo:
+                pending.pop()
+                continue
+            k = keys.get(kv) or self._key_cache[t].get(kv)
+            if k is None:
+                raise KeyError(f"{t} has no row for key value {kv}")
+            try:
+                self.value_of(t, k, a)
+                pending.pop()
+            except _DeferEval as d:
+                if d.token in pending:
+                    raise RecursionError(
+                        f"row transformer cycle at {d.token[0]}.{d.token[2]}"
+                    ) from None
+                pending.append(d.token)
+                keys.setdefault(d.token[1], self._key_cache[d.token[0]].get(d.token[1]))
+        return self.memo[(tname, key.value, attr)]
 
     def _invalidate(self, changed: set[tuple]) -> set[tuple]:
         """Transitive closure of rows whose outputs may change."""
@@ -219,7 +272,7 @@ class RowTransformerNode(Node):
                 vals = []
                 for attr in meta.outputs:
                     try:
-                        vals.append(self.value_of(tname, key, attr))
+                        vals.append(self.eval_output(tname, key, attr))
                     except Exception as e:  # noqa: BLE001
                         self.log_error(
                             f"transformer {tname}.{attr}: {type(e).__name__}: {e}"

@@ -24,6 +24,7 @@ def _render_metrics(session: Any, started_at: float) -> str:
     if graph is not None:
         lines.append("# TYPE pathway_operator_rows_in counter")
         lines.append("# TYPE pathway_operator_rows_out counter")
+        lines.append("# TYPE pathway_operator_seconds_total counter")
         for node in graph.nodes:
             name = type(node).__name__
             nid = node.node_id
@@ -32,6 +33,10 @@ def _render_metrics(session: Any, started_at: float) -> str:
             )
             lines.append(
                 f'pathway_operator_rows_out{{operator="{name}",id="{nid}"}} {node.rows_out}'
+            )
+            lines.append(
+                f'pathway_operator_seconds_total{{operator="{name}",id="{nid}"}} '
+                f"{node.time_ns / 1e9:.6f}"
             )
         err = getattr(graph, "error_log", None)
         if err is not None:

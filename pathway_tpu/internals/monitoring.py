@@ -32,9 +32,18 @@ def attach_monitor(session: Any, every_n_waves: int = 50) -> None:
         dt = time.time() - state["t0"]
         rate = (rows - state["rows_at_t0"]) / dt if dt > 0 else 0.0
         inputs = [n for n in graph.nodes if type(n).__name__ == "InputNode"]
+        # hottest operators by cumulative latency (the reference TUI's
+        # per-operator latency column)
+        hot = sorted(graph.nodes, key=lambda n: -n.time_ns)[:3]
+        hot_s = ", ".join(
+            f"{type(n).__name__}#{n.node_id}={n.time_ns / 1e6:.0f}ms"
+            for n in hot if n.time_ns
+        )
         logger.info(
-            "t=%d waves=%d operators=%d inputs=%d rows_out=%d rate=%.0f rows/s",
-            wave_time, state["waves"], len(graph.nodes), len(inputs), rows, rate,
+            "t=%d waves=%d operators=%d inputs=%d rows_out=%d rate=%.0f rows/s"
+            " hot=[%s]",
+            wave_time, state["waves"], len(graph.nodes), len(inputs), rows,
+            rate, hot_s,
         )
         state["t0"] = time.time()
         state["rows_at_t0"] = rows

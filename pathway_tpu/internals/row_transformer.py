@@ -85,18 +85,25 @@ class ClassArg:
 
 
 class _ClassMeta:
-    """Parsed member class: ordered input/output attribute specs."""
+    """Parsed member class: ordered input/output attribute specs plus
+    plain helper methods/attributes defined on the class body."""
 
     def __init__(self, name: str, cls: type):
         self.name = name
+        self.cls = cls
         self.inputs: list[str] = []
         self.outputs: dict[str, Callable] = {}
+        self.helpers: dict[str, Any] = {}
         for attr_name, attr in vars(cls).items():
             if isinstance(attr, _InputAttribute):
                 attr.name = attr_name
                 self.inputs.append(attr_name)
             elif isinstance(attr, _OutputAttribute):
                 self.outputs[attr_name] = attr.fn
+            elif not attr_name.startswith("__"):
+                # plain methods/constants: available on row handles like
+                # on a normal instance
+                self.helpers[attr_name] = attr
 
 
 class Transformer:

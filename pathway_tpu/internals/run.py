@@ -61,7 +61,21 @@ def run(
         from pathway_tpu.persistence import attach_persistence
 
         attach_persistence(session, persistence_config)
-    session.execute()
+    # telemetry: OTLP when configured + SDK present, local JSONL via
+    # PATHWAY_TELEMETRY_FILE otherwise (reference: telemetry.rs:436)
+    from pathway_tpu.internals.telemetry import attach_telemetry
+
+    telemetry = attach_telemetry(session, get_config().monitoring_server)
+    try:
+        if telemetry is not None:
+            with telemetry.span("run"):
+                session.execute()
+        else:
+            session.execute()
+    finally:
+        if telemetry is not None:
+            telemetry.operator_stats(session.graph)
+            telemetry.shutdown()
 
 
 def run_all(**kwargs: Any) -> None:

@@ -102,35 +102,62 @@ def _parse_file(
         from pathway_tpu.engine import native
 
         if native.available():
-            # native path: one bytes read, C++ line + RFC-4180 field split
-            # (reference keeps tokenization native too: data_tokenize.rs)
-            with open(path, "rb") as fb:
-                data = fb.read()
-            starts, ends = native.split_csv_records(data)
-            if len(starts) == 0:
-                return
+            # native path: chunked reads, C++ record + RFC-4180 field
+            # split (reference keeps tokenization native too:
+            # data_tokenize.rs) — large files never load whole
             dbytes = delim.encode()
-            header = native.split_csv_line(data[starts[0]:ends[0]], dbytes)
-            col_idx = {h: i for i, h in enumerate(header)}
-            for li in range(1, len(starts)):
-                line = data[starts[li]:ends[li]]
-                if not line:
-                    continue
-                fields = native.split_csv_line(line, dbytes)
-                row = {}
-                for n in names:
-                    if n == "_metadata":
+            col_idx: dict[str, int] | None = None
+            CHUNK = 1 << 22  # 4 MiB
+
+            with open(path, "rb") as fb:
+                pending = b""
+                eof = False
+                while not eof:
+                    chunk = fb.read(CHUNK)
+                    eof = not chunk
+                    data = pending + chunk
+                    if not data:
+                        break
+                    starts, ends = native.split_csv_records(data)
+                    if len(starts) == 0:
+                        pending = b""
                         continue
-                    i = col_idx.get(n)
-                    v = fields[i] if i is not None and i < len(fields) else None
-                    row[n] = (
-                        _coerce(v, schema.__columns__[n].dtype)
-                        if v is not None
-                        else None
-                    )
-                if with_metadata:
-                    row["_metadata"] = meta
-                yield row
+                    if not eof:
+                        # the final record may continue into the next
+                        # chunk — hold it back
+                        limit = len(starts) - 1
+                        pending = data[starts[-1]:]
+                        if limit == 0:
+                            continue
+                    else:
+                        limit = len(starts)
+                        pending = b""
+                    for li in range(limit):
+                        line = data[starts[li]:ends[li]]
+                        if not line:
+                            continue
+                        fields = native.split_csv_line(line, dbytes)
+                        if col_idx is None:  # header record
+                            col_idx = {h: i for i, h in enumerate(fields)}
+                            continue
+                        row = {}
+                        for n in names:
+                            if n == "_metadata":
+                                continue
+                            i = col_idx.get(n)
+                            v = (
+                                fields[i]
+                                if i is not None and i < len(fields)
+                                else None
+                            )
+                            row[n] = (
+                                _coerce(v, schema.__columns__[n].dtype)
+                                if v is not None
+                                else None
+                            )
+                        if with_metadata:
+                            row["_metadata"] = meta
+                        yield row
             return
         with open(path, "r", newline="", errors="replace") as f:
             reader = _csv.DictReader(f, delimiter=delim)

@@ -31,7 +31,12 @@ class ExchangeResult(NamedTuple):
     keys: Array  # [shards, cap * shards] u32 — received keys per shard slot
     payloads: Array  # [shards, cap * shards, d] — received payloads
     valid: Array  # [shards, cap * shards] bool — slot occupancy
-    overflowed: Array  # [] bool — some bucket exceeded capacity
+    # some bucket exceeded capacity: the overflowing rows were scattered
+    # into the bucket's LAST slot with duplicate indices (XLA duplicate
+    # scatter order is unspecified), so the whole result must be treated
+    # as invalid when this is set — use exchange_by_key_checked for the
+    # host wrapper that retries with doubled capacity instead
+    overflowed: Array  # [] bool
 
 
 def _bucketize(keys: Array, payloads: Array, n_shards: int, cap: int):
@@ -107,6 +112,32 @@ def exchange_by_key(
     rk, rp, rv, ov = jax.jit(fn)(keys, payloads)
     return ExchangeResult(
         keys=rk, payloads=rp, valid=rv, overflowed=jnp.any(ov > 0)
+    )
+
+
+def exchange_by_key_checked(
+    keys: Array,
+    payloads: Array,
+    mesh: Mesh,
+    axis: str = "data",
+    capacity: int | None = None,
+    max_retries: int = 3,
+) -> ExchangeResult:
+    """Host wrapper: retries the exchange with doubled capacity while
+    `overflowed` is set (an overflowed result is corrupt — see
+    ExchangeResult). Engine integrations must use this, never the raw
+    primitive, so skewed batches cannot silently drop rows."""
+    n_shards = mesh.shape[axis]
+    cap = capacity or keys.shape[0] // n_shards
+    for _ in range(max_retries + 1):
+        result = exchange_by_key(keys, payloads, mesh, axis, capacity=cap)
+        if not bool(result.overflowed):
+            return result
+        cap *= 2
+    raise RuntimeError(
+        f"exchange overflowed even at capacity {cap // 2} per bucket "
+        f"({max_retries} retries) — key distribution is pathologically "
+        "skewed; pre-aggregate or rebalance keys"
     )
 
 

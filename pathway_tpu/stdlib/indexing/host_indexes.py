@@ -220,10 +220,29 @@ class VectorSlabIndex(HostIndex):
     def _topk(self, qmat: np.ndarray, k: int):
         if self.use_device:
             try:
-                return self._topk_device(qmat, k)
-            except Exception:  # noqa: BLE001 — fall back to host numpy
+                result = self._topk_device(qmat, k)
+                self._device_failures = 0
+                return result
+            except (ImportError, NotImplementedError) as e:
+                # backend genuinely unavailable: disable for good
                 self.use_device = False
+                self._log_device_error(e, permanent=True)
+            except Exception as e:  # noqa: BLE001 — possibly transient (OOM…)
+                failures = getattr(self, "_device_failures", 0) + 1
+                self._device_failures = failures
+                if failures >= 3:
+                    self.use_device = False  # three strikes: stop retrying
+                self._log_device_error(e, permanent=not self.use_device)
         return self._topk_host(qmat, k)
+
+    def _log_device_error(self, e: Exception, permanent: bool) -> None:
+        from pathway_tpu.internals.errors import global_error_log
+
+        state = "disabled" if permanent else "will retry"
+        global_error_log().log(
+            f"KNN device search failed ({type(e).__name__}: {e}); "
+            f"falling back to host scan, device path {state}"
+        )
 
     def _topk_device(self, qmat: np.ndarray, k: int):
         import jax.numpy as jnp

@@ -47,11 +47,16 @@ class BaseRestServer:
     ):
         """Start serving (runs pw.run; `threaded=True` returns the thread).
 
-        `with_cache`+`cache_backend` wire UDF/input caching through the
-        persistence layer (reference: servers.py run with_cache)."""
-        if with_cache and cache_backend is not None:
+        `with_cache`+`cache_backend` wire UDF caching through the
+        persistence layer in cache-only mode — no input journaling /
+        replay attaches to a serving process (reference: servers.py run
+        with_cache, default Backend.filesystem('./Cache'))."""
+        if with_cache:
+            if cache_backend is None:
+                cache_backend = pw.persistence.Backend.filesystem("./Cache")
             kwargs.setdefault(
-                "persistence_config", pw.persistence.Config(cache_backend)
+                "persistence_config",
+                pw.persistence.Config.udf_caching(cache_backend),
             )
         if threaded:
             t = threading.Thread(target=pw.run, kwargs=kwargs, daemon=True)

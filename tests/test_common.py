@@ -382,3 +382,28 @@ def test_live_table_updates_and_finishes():
     assert "g0" in str(lt)
     df = lt.to_pandas()
     assert set(df.g) == {"g0", "g1"}
+
+
+def test_telemetry_local_exporter(tmp_path, monkeypatch):
+    """Telemetry spans/metrics/operator stats export to the local JSONL
+    backend when no OTLP stack is configured (telemetry.rs parity)."""
+    import json as _json
+
+    import pathway_tpu as pw
+
+    tf = tmp_path / "telemetry.jsonl"
+    monkeypatch.setenv("PATHWAY_TELEMETRY_FILE", str(tf))
+    t = T("v\n1\n2\n3")
+    agg = t.reduce(s=pw.reducers.sum(t.v))
+    seen = []
+    pw.io.subscribe(agg, on_change=lambda key, row, time, is_addition: seen.append(row))
+    pw.run()
+    pw.internals.parse_graph.G.clear()
+    records = [_json.loads(line) for line in tf.read_text().splitlines()]
+    kinds = {r["kind"] for r in records}
+    assert "span" in kinds and "operator" in kinds
+    run_spans = [r for r in records if r["kind"] == "span" and r["name"] == "run"]
+    assert run_spans and run_spans[0]["duration_ms"] > 0
+    ops = [r for r in records if r["kind"] == "operator"]
+    assert any(r["rows_in"] > 0 for r in ops)
+    assert all("latency_ms" in r for r in ops)

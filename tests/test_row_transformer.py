@@ -161,3 +161,65 @@ def test_transformer_error_rows_poison_not_crash():
         for r in cap.state.rows.values()
     }
     assert vals == {3, "ERR"}
+
+
+def test_transformer_deep_chain_and_helper_methods():
+    """2000-row cross-row chains evaluate via the worklist driver (no
+    interpreter recursion overflow) and plain helper methods bind to row
+    handles like normal instance methods."""
+
+    @pw.transformer
+    class chain:
+        class nodes(pw.ClassArg):
+            value = pw.input_attribute()
+            nxt = pw.input_attribute()
+
+            def base(self):  # plain helper, not an output attribute
+                return self.value
+
+            @pw.output_attribute
+            def suffix_sum(self):
+                if self.nxt == "END":
+                    return self.base()
+                return (
+                    self.base()
+                    + self.transformer.nodes[self.pointer_from(self.nxt)].suffix_sum
+                )
+
+    n = 2000
+    rows = [(f"n{i}", 1, f"n{i + 1}" if i + 1 < n else "END") for i in range(n)]
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str, value=int, nxt=str), rows
+    ).with_id_from(pw.this.name)
+    res = chain(nodes=t).nodes
+    cap = run_capture(res)
+    from pathway_tpu.internals.errors import ErrorValue
+
+    vals = [r[0] for r in cap.state.rows.values()]
+    assert not any(isinstance(v, ErrorValue) for v in vals)
+    assert max(vals) == n and min(vals) == 1
+
+
+def test_transformer_cycle_detected():
+    @pw.transformer
+    class loop:
+        class nodes(pw.ClassArg):
+            nxt = pw.input_attribute()
+
+            @pw.output_attribute
+            def depth(self):
+                return 1 + self.transformer.nodes[self.pointer_from(self.nxt)].depth
+
+    t = T(
+        """
+        name | nxt
+        a    | b
+        b    | a
+        """
+    ).with_id_from(pw.this.name)
+    res = loop(nodes=t).nodes
+    cap = run_capture(res)
+    from pathway_tpu.internals.errors import ErrorValue
+
+    # a cycle poisons the involved rows instead of hanging or crashing
+    assert all(isinstance(r[0], ErrorValue) for r in cap.state.rows.values())
