@@ -5,11 +5,13 @@ same script as N cooperating processes with `PATHWAY_*` env wiring;
 `replay` (:252) re-runs a script against recorded input snapshots;
 `spawn_from_env` (:283) reads the spawn arguments from PATHWAY_SPAWN_ARGS.
 
-Process model note (v0): each spawned process runs the full pipeline on its
-own; cross-process record exchange lands with the multi-worker engine. The
-env contract (PATHWAY_PROCESSES / PATHWAY_PROCESS_ID / PATHWAY_FIRST_PORT /
-PATHWAY_THREADS) matches the reference so scripts written against it are
-forward-compatible.
+Process model: spawned processes COOPERATE — each builds the same graph,
+sources are partitioned round-robin across processes, and stateful
+operators hash-exchange records over the TCP mesh
+(parallel/process_mesh.py), so every key's state lives on exactly one
+process (and one thread shard within it, PATHWAY_THREADS). The env
+contract (PATHWAY_PROCESSES / PATHWAY_PROCESS_ID / PATHWAY_FIRST_PORT /
+PATHWAY_THREADS) matches the reference.
 """
 
 from __future__ import annotations

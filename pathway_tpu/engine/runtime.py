@@ -135,6 +135,8 @@ class Runtime:
         self.checkpointer: Any = None
         # cooperative stop: ends the pump at the next wave boundary
         self.stop_event: Any = None
+        # inter-process data plane (parallel/process_mesh.py)
+        self.mesh: Any = None
 
     def next_time(self) -> int:
         self.time += 2  # even-ms granule, reference timestamp.rs:20-27
@@ -179,6 +181,73 @@ class Runtime:
                 t = self.next_time()
                 if final:
                     self.graph.step(t)
+                self.graph.end(t)
+                if self.checkpointer is not None:
+                    self.checkpointer.checkpoint(t)
+                    self.checkpointer.close()
+                break
+
+    def run_lockstep(
+        self, static_batches: list[tuple[int, InputNode, list[Entry]]] | None = None
+    ) -> None:
+        """Multi-process pump: every process executes the same wave
+        sequence in lockstep (the exchange operators' per-wave barriers
+        depend on it). A per-round control exchange gives each process
+        the identical (any_data, all_done) view — the progress-protocol
+        stand-in — so wave times and termination agree everywhere."""
+        mesh = self.mesh
+        assert mesh is not None
+        for c in self.connectors:
+            c.start()
+        statics = sorted(static_batches or [], key=lambda b: b[0])
+        # checkpoint cadence must be a deterministic function of the
+        # SHARED round count — per-process wall clocks would snapshot at
+        # different waves, leaving exchange rounds (and therefore resume)
+        # mutually inconsistent
+        ckpt_every = 1
+        if self.checkpointer is not None:
+            interval = self.checkpointer.config.snapshot_interval_ms
+            ckpt_every = max(1, interval // max(self.autocommit_ms, 1))
+        rnd = 0
+        waves = 0
+        while True:
+            has_data = False
+            t_hint = 0
+            if statics:  # feed one scripted timestamp per wave
+                t_hint = statics[0][0]
+                while statics and statics[0][0] == t_hint:
+                    _t, node, entries = statics.pop(0)
+                    node.push(list(entries))
+                    has_data = True
+            for c in self.connectors:
+                entries = c.poll()
+                if entries:
+                    c.session.node.push(entries)
+                    has_data = True
+            stopped = self.stop_event is not None and self.stop_event.is_set()
+            local_done = (
+                not statics
+                and (stopped or all(c.done for c in self.connectors))
+            )
+            any_data, all_done, t_max = mesh.control_round(
+                rnd, has_data, local_done, t_hint
+            )
+            rnd += 1
+            if any_data:
+                # scripted timestamps win (identical everywhere via the
+                # control exchange); live waves use the even-ms counter
+                self.time = max(self.time + 2, t_max)
+                t = self.time
+                self.graph.step(t)
+                waves += 1
+                for m in self.monitors:
+                    m(t)
+                if self.checkpointer is not None and waves % ckpt_every == 0:
+                    self.checkpointer.checkpoint(t)
+            elif not all_done:
+                _time.sleep(self.autocommit_ms / 1000.0)
+            if all_done and not any_data:
+                t = self.next_time()
                 self.graph.end(t)
                 if self.checkpointer is not None:
                     self.checkpointer.checkpoint(t)

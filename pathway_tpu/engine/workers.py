@@ -223,3 +223,71 @@ class ShardedNode(Node):
     @property
     def shard_rows(self) -> list[tuple[int, int]]:
         return [(r.rows_in, r.rows_out) for r in self.replicas]
+
+
+class ProcessExchangeNode(Node):
+    """Inter-process exchange boundary: one per stateful-operator input.
+
+    Every process runs the same graph in lockstep waves; at this node the
+    wave's batch partitions by the operator's shard key across processes
+    (bucket p goes to process p over the TCP mesh), and the node BLOCKS
+    until every peer's bucket for this (node, round) arrives — a per-
+    operator barrier, the timely exchange pact's role. Emits the merged
+    local + received entries, which the downstream operator (optionally
+    thread-sharded on top) then owns exclusively: every key lives on
+    exactly one process.
+
+    `route` maps (key, row) -> shard token; None routes everything to
+    process 0 (operators with global state: buffers, gradual broadcast,
+    external indexes, iterate).
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        mesh: Any,
+        route: RouteFn | None,
+        wire_id: int,
+    ):
+        super().__init__(graph, [inp])
+        self.mesh = mesh
+        self.route = route
+        # wire identity: must match across processes (same program, same
+        # creation order) and be unique across sessions sharing one
+        # process-wide mesh — the lowering allocates it
+        self.wire_id = wire_id
+        self.round = 0
+
+    def persist_signature(self) -> str:
+        return f"ProcessExchange/{self.mesh.n}/{int(self.route is None)}"
+
+    def persist_state(self) -> dict:
+        return {"round": self.round}
+
+    def restore_state(self, st: dict) -> None:
+        self.round = st["round"]
+
+    def finish_time(self, time: int) -> None:
+        entries = self.take_input()
+        n = self.mesh.n
+        me = self.mesh.process_id
+        buckets: list[list[Entry]] = [[] for _ in range(n)]
+        if self.route is None:
+            buckets[0] = entries
+        else:
+            for entry in entries:
+                key, row, _diff = entry
+                try:
+                    p = _shard_of(self.route(key, row), n)
+                except Exception:  # noqa: BLE001 — owner re-evaluates + logs
+                    p = 0
+                buckets[p].append(entry)
+        for p in self.mesh.peers:
+            self.mesh.send_bucket(p, self.wire_id, self.round, buckets[p])
+        merged = list(buckets[me])
+        for p in self.mesh.peers:
+            merged.extend(self.mesh.recv_bucket(p, self.wire_id, self.round))
+        self.round += 1
+        if merged:
+            self.emit(time, merged)

@@ -30,6 +30,11 @@ from pathway_tpu.internals.keys import Key, hash_values, key_for_values
 from pathway_tpu.internals.table import OpSpec, Table
 
 
+import itertools as _itertools
+
+_session_ids = _itertools.count()
+
+
 def _route_key(key: Key, row: tuple) -> int:
     """Default shard key: the record's 128-bit key (keyed-node exchange)."""
     return key.value
@@ -111,6 +116,45 @@ class Session:
         # PATHWAY_THREADS worker shards for stateful operators; read per
         # session so worker-count-invariance tests can flip it in-process.
         self.n_workers = worker_threads()
+        # PATHWAY_PROCESSES inter-process data plane: every process runs
+        # this same graph; stateful-operator inputs exchange over the TCP
+        # mesh (parallel/process_mesh.py) so each key lives on exactly
+        # one process. Wire ids are namespaced per session because the
+        # mesh is process-wide.
+        from pathway_tpu.parallel.process_mesh import get_mesh
+
+        self.mesh = get_mesh()
+        self._session_seq = next(_session_ids)
+        self._connector_seq = 0
+        self._exchange_seq = 0
+
+    def _next_wire_id(self) -> int:
+        """Cross-process-stable, cross-session-unique exchange channel id:
+        sessions and exchange nodes are created in the same order on every
+        process (identical programs), and the session prefix keeps two
+        pipelines sharing one process-wide mesh apart."""
+        self._exchange_seq += 1
+        return self._session_seq * 1_000_000 + self._exchange_seq
+
+    def _process_exchange(
+        self, nodes: list[eng.Node], route_fns: list[Callable] | None
+    ) -> list[eng.Node]:
+        """Wrap operator inputs with inter-process exchange boundaries.
+        route_fns=None pins everything to process 0 (global-state ops)."""
+        if self.mesh is None:
+            return nodes
+        from pathway_tpu.engine.workers import ProcessExchangeNode
+
+        return [
+            ProcessExchangeNode(
+                self.graph,
+                node,
+                self.mesh,
+                None if route_fns is None else route_fns[i],
+                wire_id=self._next_wire_id(),
+            )
+            for i, node in enumerate(nodes)
+        ]
 
     def _sharded(
         self,
@@ -122,8 +166,12 @@ class Session:
 
         Each worker owns the slice of the operator's state whose shard key
         routes to it (the multi-worker exchange; engine/workers.py).
+        Under PATHWAY_PROCESSES > 1, the inputs first cross the
+        inter-process exchange on the same shard keys, so a key's state
+        lives on exactly one process (and one thread shard within it).
         Single-worker sessions build the node directly on the main graph.
         """
+        inputs = self._process_exchange(list(inputs), route_fns)
         if self.n_workers <= 1:
             return factory(self.graph, list(inputs))
         return ShardedNode(self.graph, inputs, factory, route_fns, self.n_workers)
@@ -228,6 +276,11 @@ class Session:
 
         if kind == "static":
             node = eng.InputNode(g)
+            if self.mesh is not None and self.mesh.process_id != 0:
+                # every process builds the same static tables; process 0
+                # owns the rows (exchanges distribute them) — otherwise
+                # each key would arrive N times at its owner
+                return node
             rows = spec.params["rows"]
             by_time: dict[int, list] = {}
             for t, key, row, diff in rows:
@@ -238,6 +291,12 @@ class Session:
 
         if kind == "connector":
             node = eng.InputNode(g)
+            ordinal = self._connector_seq
+            self._connector_seq += 1
+            if self.mesh is not None and ordinal % self.mesh.n != self.mesh.process_id:
+                # another process owns this source; downstream exchange
+                # boundaries distribute its rows here as needed
+                return node
             factory = spec.params["factory"]
             session = InputSession(node, upsert=spec.params.get("upsert", False))
             connector = factory(session)
@@ -436,9 +495,11 @@ class Session:
             tf = compile_expression(spec.params["threshold"], resolver)
             cf = compile_expression(spec.params["current"], resolver)
             cls = {"buffer": eng.BufferNode, "forget": eng.ForgetNode, "freeze": eng.FreezeNode}[kind]
+            # global watermark state: runs whole on process 0
+            (inp,) = self._process_exchange([self.node_of(main)], None)
             return cls(
                 g,
-                self.node_of(main),
+                inp,
                 lambda key, row: tf(key, (row,)),
                 lambda key, row: cf(key, (row,)),
             )
@@ -473,10 +534,14 @@ class Session:
             lf = compile_expression(spec.params["lower"], resolver)
             vf = compile_expression(spec.params["value"], resolver)
             uf = compile_expression(spec.params["upper"], resolver)
+            # hysteresis state is global: runs whole on process 0
+            big_n, small_n = self._process_exchange(
+                [self.node_of(big), self.node_of(small)], None
+            )
             return eng.GradualBroadcastNode(
                 g,
-                self.node_of(big),
-                self.node_of(small),
+                big_n,
+                small_n,
                 lambda key, row: (lf(key, (row,)), vf(key, (row,)), uf(key, (row,))),
             )
 
@@ -629,7 +694,10 @@ class Session:
 
         tf = spec.params["transformer"]
         table_names = spec.params["table_names"]
-        input_nodes = [self.node_of(t) for t in spec.inputs]
+        # cross-row/table access is global: runs whole on process 0
+        input_nodes = self._process_exchange(
+            [self.node_of(t) for t in spec.inputs], None
+        )
         node = RowTransformerNode(self.graph, input_nodes, dict(tf.classes))
         for name, table in zip(table_names, spec.inputs):
             node.set_columns(name, table._column_names())
@@ -642,13 +710,20 @@ class Session:
     def _get_iterate_node(self, it_spec: Any) -> IterateNode:
         if id(it_spec) in self.iterate_nodes:
             return self.iterate_nodes[id(it_spec)]
-        input_nodes = [self.node_of(t) for t in it_spec.inputs.values()]
+        # the loop body is one global scope: runs whole on process 0
+        input_nodes = self._process_exchange(
+            [self.node_of(t) for t in it_spec.inputs.values()], None
+        )
         input_names = list(it_spec.inputs.keys())
 
         # ONE persistent body graph: its stateful operators keep their
         # arrangements across outer timestamps and iteration rounds, so
         # every round is delta-driven (see IterateNode).
         sub = Session()
+        # the body runs WHOLE on process 0 (its inputs are pinned there);
+        # inheriting the mesh would plant exchange barriers inside the
+        # loop that the other processes never step — deadlock
+        sub.mesh = None
         captures: dict[str, eng.CaptureNode] = {}
         for name, t in it_spec.results.items():
             captures[name] = eng.CaptureNode(sub.graph, sub.node_of(t))
@@ -703,6 +778,14 @@ class Session:
         runtime.monitors = list(self.monitors)
         runtime.checkpointer = getattr(self, "checkpointer", None)
         runtime.stop_event = self.stop_event
+        runtime.mesh = self.mesh
+        if self.mesh is not None:
+            # lockstep BSP: exchange barriers require every process to
+            # step every wave together, even static pipelines
+            for c in self.connectors:
+                runtime.add_connector(c)
+            runtime.run_lockstep(self.static_batches)
+            return
         if not self.connectors:
             runtime.run_static(self.static_batches)
             return

@@ -1,0 +1,247 @@
+"""Inter-process data plane: a full TCP mesh for operator exchange.
+
+Reference parity: the reference's multi-process execution rides timely's
+TCP communication fabric (external/timely-dataflow/communication/src/
+networking.rs — one socket pair per worker pair, length-prefixed binary
+frames); processes agree on wave boundaries through the progress
+protocol. Here the equivalents are:
+
+  * ProcessMesh — process i listens on FIRST_PORT + i, dials every peer,
+    and exchanges length-prefixed pickle frames;
+  * data frames — (node_id, round, entries) buckets routed by each
+    exchange operator's shard key (engine/workers.py ProcessExchangeNode);
+  * control frames — per-round (has_data, done) flags, giving every
+    process the same global view to decide lockstep waves and
+    termination (the progress-protocol stand-in).
+
+The host control plane carries arbitrary Python rows; bulk numeric
+columns ride the ICI all_to_all in parallel/exchange.py instead.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any
+
+_LEN = struct.Struct("<Q")
+
+
+class ProcessMesh:
+    """Full mesh between PATHWAY_PROCESSES processes (one host or a
+    cluster — peers resolve via FIRST_PORT + process id)."""
+
+    def __init__(
+        self,
+        process_id: int | None = None,
+        n_processes: int | None = None,
+        first_port: int | None = None,
+        host: str = "127.0.0.1",
+        connect_timeout: float = 60.0,
+    ):
+        self.process_id = (
+            process_id
+            if process_id is not None
+            else int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+        )
+        self.n = (
+            n_processes
+            if n_processes is not None
+            else int(os.environ.get("PATHWAY_PROCESSES", "1"))
+        )
+        self.first_port = (
+            first_port
+            if first_port is not None
+            else int(os.environ.get("PATHWAY_FIRST_PORT", "10000"))
+        )
+        self.host = host
+        self.peers = [p for p in range(self.n) if p != self.process_id]
+        self._send_socks: dict[int, socket.socket] = {}
+        self._send_locks: dict[int, threading.Lock] = {}
+        self._cv = threading.Condition()
+        self._data: dict[tuple[int, int, int], list] = {}  # (node, round, proc)
+        self._ctl: dict[tuple[int, int], tuple[bool, bool, int]] = {}  # (round, proc)
+        self._dead: set[int] = set()
+        self._closed = False
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, self.first_port + self.process_id))
+        self._listener.listen(self.n)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        self._connect_all(connect_timeout)
+
+    # ------------------------------------------------------------ plumbing
+
+    def _connect_all(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        for p in self.peers:
+            while True:
+                try:
+                    s = socket.create_connection(
+                        (self.host, self.first_port + p), timeout=5.0
+                    )
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    s.sendall(_LEN.pack(8) + self.process_id.to_bytes(8, "little"))
+                    self._send_socks[p] = s
+                    self._send_locks[p] = threading.Lock()
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"process {self.process_id}: peer {p} did not "
+                            f"come up on port {self.first_port + p}"
+                        ) from None
+                    time.sleep(0.1)
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._recv_loop, args=(conn,), daemon=True
+            ).start()
+
+    def _recv_exact(self, conn: socket.socket, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        hello = self._recv_exact(conn, _LEN.size)
+        if hello is None:
+            return
+        peer_bytes = self._recv_exact(conn, _LEN.unpack(hello)[0])
+        if peer_bytes is None:
+            return
+        peer = int.from_bytes(peer_bytes, "little")
+        try:
+            while True:
+                head = self._recv_exact(conn, _LEN.size)
+                if head is None:
+                    return
+                body = self._recv_exact(conn, _LEN.unpack(head)[0])
+                if body is None:
+                    return
+                kind, payload = pickle.loads(body)  # noqa: S301 — trusted mesh
+                with self._cv:
+                    if kind == "data":
+                        node_id, rnd, entries = payload
+                        self._data[(node_id, rnd, peer)] = entries
+                    else:  # ctl
+                        rnd, has_data, done, t_hint = payload
+                        self._ctl[(rnd, peer)] = (has_data, done, t_hint)
+                    self._cv.notify_all()
+        finally:
+            if not self._closed:
+                # worker failure detection: a vanished peer unblocks every
+                # barrier with a clear error instead of hanging forever
+                with self._cv:
+                    self._dead.add(peer)
+                    self._cv.notify_all()
+
+    def _send(self, peer: int, kind: str, payload: Any) -> None:
+        body = pickle.dumps((kind, payload), protocol=4)
+        with self._send_locks[peer]:
+            self._send_socks[peer].sendall(_LEN.pack(len(body)) + body)
+
+    # ------------------------------------------------------------ exchange
+
+    def send_bucket(self, peer: int, node_id: int, rnd: int, entries: list) -> None:
+        self._send(peer, "data", (node_id, rnd, entries))
+
+    def recv_bucket(self, peer: int, node_id: int, rnd: int) -> list:
+        """Blocks until the peer's bucket arrives. A slow peer is waited
+        for indefinitely (with periodic warnings — a barrier must not
+        kill a healthy-but-slow pipeline); a DEAD peer (socket closed)
+        raises immediately."""
+        key = (node_id, rnd, peer)
+        waited = 0.0
+        with self._cv:
+            while key not in self._data:
+                if peer in self._dead:
+                    raise ConnectionError(
+                        f"process {self.process_id}: peer {peer} died "
+                        f"(waiting for node {node_id} round {rnd})"
+                    )
+                self._cv.wait(60.0)
+                waited += 60.0
+                if key not in self._data and peer not in self._dead and waited % 300.0 == 0.0:
+                    import logging
+
+                    logging.getLogger("pathway_tpu.mesh").warning(
+                        "process %d still waiting for peer %d (node %d, "
+                        "round %d, %.0fs)",
+                        self.process_id, peer, node_id, rnd, waited,
+                    )
+            return self._data.pop(key)
+
+    # ------------------------------------------------------------- control
+
+    def control_round(
+        self, rnd: int, has_data: bool, done: bool, t_hint: int = 0
+    ) -> tuple[bool, bool, int]:
+        """Broadcast this process's round flags and gather every peer's.
+        Returns (any_has_data, all_done, max_t_hint) — identical on every
+        process. `t_hint` carries scripted static timestamps so wave
+        times agree across processes even though only process 0 holds the
+        scripted batches. Dead peers raise; slow peers are waited for."""
+        for p in self.peers:
+            self._send(p, "ctl", (rnd, has_data, done, t_hint))
+        any_data, all_done, t_max = has_data, done, t_hint
+        with self._cv:
+            for p in self.peers:
+                while (rnd, p) not in self._ctl:
+                    if p in self._dead:
+                        raise ConnectionError(
+                            f"process {self.process_id}: peer {p} died "
+                            f"(control round {rnd})"
+                        )
+                    self._cv.wait(60.0)
+                p_data, p_done, p_hint = self._ctl.pop((rnd, p))
+                any_data = any_data or p_data
+                all_done = all_done and p_done
+                t_max = max(t_max, p_hint)
+        return any_data, all_done, t_max
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for s in self._send_socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+_MESH: ProcessMesh | None = None
+_MESH_LOCK = threading.Lock()
+
+
+def get_mesh() -> ProcessMesh | None:
+    """Process-wide mesh singleton: one socket fabric per process shared
+    by every session (exchange nodes namespace their wire ids). None when
+    PATHWAY_PROCESSES <= 1."""
+    global _MESH
+    if int(os.environ.get("PATHWAY_PROCESSES", "1")) <= 1:
+        return None
+    with _MESH_LOCK:
+        if _MESH is None:
+            _MESH = ProcessMesh()
+    return _MESH
+
+
+__all__ = ["ProcessMesh", "get_mesh"]

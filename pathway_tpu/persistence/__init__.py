@@ -452,6 +452,20 @@ def attach_persistence(session: Any, config: Config) -> None:
         return
     if config.persistence_mode in ("UDF_CACHING", "udf_caching"):
         return  # cache-only mode: UDF caches use the backend directly
+    if getattr(session, "mesh", None) is not None:
+        # each cooperating process owns its shard of operator state and
+        # its own sources: persistence roots are per-process
+        config = Config(
+            Backend.filesystem(
+                os.path.join(
+                    config.backend.path, f"proc-{session.mesh.process_id}"
+                )
+            ),
+            snapshot_interval_ms=config.snapshot_interval_ms,
+            persistence_mode=config.persistence_mode,
+            continue_after_replay=config.continue_after_replay,
+            operator_snapshots=config.operator_snapshots,
+        )
     manager = CheckpointManager(session, config)
     replay_offsets = manager.restore()
 

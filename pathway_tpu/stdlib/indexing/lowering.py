@@ -18,6 +18,8 @@ def build_external_index(session: Any, table: Any, spec: Any) -> eng.Node:
     nodes = [session.node_of(index_t), session.node_of(query_t)]
     if data_t is not None:
         nodes.append(session.node_of(data_t))
+    # one host/device index instance: runs whole on process 0
+    nodes = session._process_exchange(nodes, None)
     mode = spec.params["mode"]
 
     def index_fn(key, row):
