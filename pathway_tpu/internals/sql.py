@@ -1,9 +1,12 @@
 """pw.sql: SQL -> dataflow translation (reference: internals/sql.py:613).
 
-Covers the common analytic subset: SELECT (exprs, aliases), FROM, WHERE,
-GROUP BY, HAVING, JOIN ... ON, UNION ALL. Parsing is hand-rolled (no
-sqlglot in the image); expressions support the usual arithmetic/comparison/
-boolean operators, literals and function calls mapped to reducers.
+Covers the reference's documented subset: SELECT (exprs, aliases), FROM
+(tables and subqueries), WHERE, GROUP BY, HAVING, JOIN ... ON, UNION /
+UNION ALL, INTERSECT, WITH (CTEs). Ordering operations (ORDER BY, LIMIT)
+are unsupported exactly as in the reference — a streaming dataflow has
+no output order. Parsing is hand-rolled (no sqlglot in the image);
+expressions support the usual arithmetic/comparison/boolean operators,
+literals and function calls mapped to reducers.
 """
 
 from __future__ import annotations
@@ -131,18 +134,89 @@ class _Parser:
         return table[tok]
 
 
+def _distinct(table: Table) -> Table:
+    cols = table._column_names()
+    return table.groupby(*[table[c] for c in cols]).reduce(
+        **{c: table[c] for c in cols}
+    )
+
+
+def _toplevel_keyword_last(toks: list[str], words: tuple[str, ...]) -> int:
+    """Index of the LAST depth-0 occurrence of any keyword, or -1 —
+    set operations are left-associative, so the split point is the last
+    operator of the precedence level."""
+    depth = 0
+    found = -1
+    for i, t in enumerate(toks):
+        if t == "(":
+            depth += 1
+        elif t == ")":
+            depth -= 1
+        elif depth == 0 and t.lower() in words:
+            found = i
+    return found
+
+
+def _balanced(toks: list[str], start: int) -> int:
+    """Index just past the ')' matching the '(' at `start`."""
+    assert toks[start] == "("
+    depth = 0
+    for i in range(start, len(toks)):
+        if toks[i] == "(":
+            depth += 1
+        elif toks[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    raise ValueError("unbalanced parentheses in SQL")
+
+
 def sql(query: str, **tables: Table) -> Table:
     """Translate a SQL query over the given tables into a dataflow Table."""
     toks = _tokenize(query.replace("\n", " "))
-    # UNION ALL split
-    lower = [t.lower() for t in toks]
-    if "union" in lower:
-        idx = lower.index("union")
-        if idx + 1 < len(lower) and lower[idx + 1] == "all":
-            left_q = " ".join(toks[:idx])
-            right_q = " ".join(toks[idx + 2 :])
+    tables = dict(tables)
+
+    # WITH name AS ( ... ) [, name AS ( ... )] — CTEs become tables
+    if toks and toks[0].lower() == "with":
+        i = 1
+        while True:
+            name = toks[i]
+            if toks[i + 1].lower() != "as" or toks[i + 2] != "(":
+                raise ValueError("WITH requires `name AS ( SELECT ... )`")
+            end = _balanced(toks, i + 2)
+            tables[name] = sql(" ".join(toks[i + 3 : end - 1]), **tables)
+            i = end
+            if i < len(toks) and toks[i] == ",":
+                i += 1
+                continue
+            break
+        toks = toks[i:]
+
+    # Set operations, standard SQL precedence: UNION/EXCEPT are the outer
+    # (left-associative) level, INTERSECT binds tighter. Splitting at the
+    # LAST top-level keyword of each level yields left association.
+    idx = _toplevel_keyword_last(toks, ("union", "except"))
+    if idx < 0:
+        idx = _toplevel_keyword_last(toks, ("intersect",))
+    if idx >= 0:
+        op = toks[idx].lower()
+        left_q = " ".join(toks[:idx])
+        rest = toks[idx + 1 :]
+        if op == "union" and rest and rest[0].lower() == "all":
+            right_q = " ".join(rest[1:])
             return sql(left_q, **tables).concat_reindex(sql(right_q, **tables))
-        raise NotImplementedError("only UNION ALL is supported")
+        right_q = " ".join(rest)
+        left_t = sql(left_q, **tables)
+        right_t = sql(right_q, **tables)
+        if op == "union":
+            return _distinct(left_t.concat_reindex(right_t))
+        # INTERSECT / EXCEPT by row content: _distinct keys rows by their
+        # column values (groupby keys are content-addressed), so key-level
+        # set ops implement value-level semantics
+        lk, rk = _distinct(left_t), _distinct(right_t)
+        if op == "intersect":
+            return lk.restrict(rk)
+        return lk.difference(rk)
 
     p = _Parser(toks, tables)
     p.expect("select")
@@ -170,10 +244,29 @@ def sql(query: str, **tables: Table) -> Table:
     if cur:
         select_items.append((None, cur))
 
-    tname = p.next()
-    if tname not in tables:
-        raise ValueError(f"unknown table {tname!r}")
-    table = tables[tname]
+    _RESERVED = {
+        "where", "group", "having", "join", "inner", "left", "right",
+        "outer", "on", "union", "intersect", "except", "as", ",",
+    }
+    if p.peek() == "(":
+        # FROM ( SELECT ... ) [AS] [alias] — subquery as a table
+        end = _balanced(p.toks, p.i)
+        sub_table = sql(" ".join(p.toks[p.i + 1 : end - 1]), **tables)
+        p.i = end
+        if p.peek() and p.peek().lower() == "as":
+            p.next()
+        nxt = p.peek()
+        tname = (
+            p.next() if nxt is not None and nxt.lower() not in _RESERVED
+            else "_subquery"
+        )
+        tables[tname] = sub_table
+        table = sub_table
+    else:
+        tname = p.next()
+        if tname not in tables:
+            raise ValueError(f"unknown table {tname!r}")
+        table = tables[tname]
 
     # JOIN
     while p.peek() and p.peek().lower() in ("join", "inner", "left", "right", "outer"):

@@ -361,10 +361,29 @@ class Table:
     # -------------------------------------------------------- set/universe ops
 
     def concat(self, *others: "Table") -> "Table":
+        """Union of DISJOINT tables (reference semantics): the key sets
+        must be PROVABLY disjoint — difference results, or tables covered
+        by pw.universes.promise_are_pairwise_disjoint — otherwise this
+        raises at build time (overlapping keys would silently collapse).
+        Use concat_reindex for arbitrary tables."""
         tables = [self, *[_align_columns(self, o) for o in others]]
         schema = _common_schema(tables)
+        solver = univ.get_solver()
+        for i, a in enumerate(tables):
+            for b in tables[i + 1 :]:
+                if not solver.are_disjoint(a._universe, b._universe):
+                    raise ValueError(
+                        "concat: cannot prove the tables' key sets are "
+                        "disjoint; promise it with pw.universes."
+                        "promise_are_pairwise_disjoint(...) or use "
+                        "concat_reindex"
+                    )
         spec = OpSpec("concat", tables, reindex=False)
-        return Table(spec, schema, univ.Universe())
+        out = Table(spec, schema, univ.Universe())
+        solver.register_as_union(
+            out._universe, *[t._universe for t in tables]
+        )
+        return out
 
     def concat_reindex(self, *others: "Table") -> "Table":
         tables = [self, *[_align_columns(self, o) for o in others]]
@@ -392,13 +411,19 @@ class Table:
     def intersect(self, *tables: "Table") -> "Table":
         spec = OpSpec("setop", [self, *tables], mode="intersect")
         out_universe = univ.Universe()
-        univ.register_subset(out_universe, self._universe)
+        univ.get_solver().register_as_intersection(
+            out_universe, self._universe, *[t._universe for t in tables]
+        )
         return Table(spec, self._schema, out_universe)
 
     def difference(self, other: "Table") -> "Table":
         spec = OpSpec("setop", [self, other], mode="difference")
         out_universe = univ.Universe()
-        univ.register_subset(out_universe, self._universe)
+        # result ⊆ self and provably disjoint from `other` — a later
+        # concat with `other` is statically safe
+        univ.get_solver().register_as_difference(
+            out_universe, self._universe, other._universe
+        )
         return Table(spec, self._schema, out_universe)
 
     def restrict(self, other: "Table") -> "Table":

@@ -27,7 +27,8 @@ def pagerank(edges: Table, steps: int = 50, damping: float = 0.85) -> Table:
     degs = edges.groupby(edges.u).reduce(edges.u, degree=red.count())
     vertices_u = edges.groupby(edges.u).reduce(vid=edges.u)
     vertices_v = edges.groupby(edges.v).reduce(vid=edges.v)
-    vertices = vertices_u.concat(vertices_v).groupby(
+    # sources and targets overlap; reindex + groupby dedups to vertex set
+    vertices = vertices_u.concat_reindex(vertices_v).groupby(
         ex.this.vid
     ).reduce(vid=ex.this.vid)
 

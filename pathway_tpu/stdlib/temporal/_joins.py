@@ -16,6 +16,7 @@ import pathway_tpu.internals.reducers as red
 from pathway_tpu.internals import expression as ex
 from pathway_tpu.internals.common import apply_with_type, coalesce
 from pathway_tpu.internals.expression import wrap_arg
+from pathway_tpu.internals import universe as univ_mod
 from pathway_tpu.internals.table import JoinMode, Table
 
 
@@ -116,7 +117,11 @@ class IntervalJoinResult:
             pad = {}
             for name, e in out_kwargs.items():
                 pad[name] = _pad_expr(e, self._left, unmatched, right_side=self._right)
-            result = result.concat(unmatched.select(**pad))
+            padded = unmatched.select(**pad)
+            # join-output keys are (lkey, rkey) hashes; padded rows keep
+            # left keys — distinct key spaces by construction
+            univ_mod.promise_are_pairwise_disjoint(result, padded)
+            result = result.concat(padded)
         if self._mode in (JoinMode.RIGHT, JoinMode.OUTER):
             matched_rkeys = matched.groupby(matched._pw_rkey).reduce(
                 k=matched._pw_rkey
@@ -125,7 +130,9 @@ class IntervalJoinResult:
             pad = {}
             for name, e in out_kwargs.items():
                 pad[name] = _pad_expr(e, self._right, unmatched_r, right_side=self._left)
-            result = result.concat(unmatched_r.select(**pad))
+            padded_r = unmatched_r.select(**pad)
+            univ_mod.promise_are_pairwise_disjoint(result, padded_r)
+            result = result.concat(padded_r)
         return result
 
     def _make_select(

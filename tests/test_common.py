@@ -407,3 +407,131 @@ def test_telemetry_local_exporter(tmp_path, monkeypatch):
     ops = [r for r in records if r["kind"] == "operator"]
     assert any(r["rows_in"] > 0 for r in ops)
     assert all("latency_ms" in r for r in ops)
+
+
+def test_universe_solver_relations():
+    """Equality, transitive subsets, and provable disjointness
+    (universe_solver.py parity)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import universe as univ
+
+    t = T("v\n1\n2\n3\n4").with_id_from(pw.this.v)
+    evens = t.filter(t.v % 2 == 0)
+    odds = t.difference(evens)
+
+    solver = univ.get_solver()
+    # difference result is a subset of t and disjoint from evens
+    assert solver.is_subset(odds._universe, t._universe)
+    assert solver.are_disjoint(odds._universe, evens._universe)
+    # transitive subset: (odds ∩ x) ⊆ odds ⊆ t
+    smaller = odds.filter(pw.this.v > 1)
+    assert solver.is_subset(smaller._universe, t._universe)
+    # subsets of disjoint universes are disjoint
+    assert solver.are_disjoint(smaller._universe, evens._universe)
+
+    # concat of the disjoint split reassembles t
+    whole = odds.concat(evens)
+    cap = run_capture(whole)
+    assert sorted(r[0] for r in cap.state.rows.values()) == [1, 2, 3, 4]
+
+    # concat of same-universe tables is rejected statically
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="universe"):
+        t.concat(t.select(v=t.v * 10))
+
+    # explicit promise API
+    a = T("x\n1").with_id_from(pw.this.x)
+    b = T("x\n2").with_id_from(pw.this.x)
+    assert not solver.are_disjoint(a._universe, b._universe)
+    pw.universes.promise_are_pairwise_disjoint(a, b)
+    assert solver.are_disjoint(a._universe, b._universe)
+
+
+def test_sql_set_ops_ctes_subqueries():
+    """pw.sql: UNION (dedup), INTERSECT, EXCEPT, FROM subqueries, WITH
+    (reference sql.py documented subset; ORDER BY/LIMIT unsupported there
+    too)."""
+    import pathway_tpu as pw
+
+    a = T("v | g\n1 | x\n2 | x\n3 | y")
+    b = T("v | g\n2 | x\n3 | y\n9 | z")
+
+    def rows(t):
+        cap = run_capture(t)
+        return sorted(tuple(r) for r in cap.state.rows.values())
+
+    # UNION dedups, UNION ALL keeps duplicates
+    u = pw.sql("SELECT v FROM a UNION SELECT v FROM b", a=a, b=b)
+    assert rows(u) == [(1,), (2,), (3,), (9,)]
+    ua = pw.sql("SELECT v FROM a UNION ALL SELECT v FROM b", a=a, b=b)
+    assert rows(ua) == [(1,), (2,), (2,), (3,), (3,), (9,)]
+
+    # INTERSECT / EXCEPT by row content
+    i = pw.sql("SELECT v FROM a INTERSECT SELECT v FROM b", a=a, b=b)
+    assert rows(i) == [(2,), (3,)]
+    e = pw.sql("SELECT v FROM a EXCEPT SELECT v FROM b", a=a, b=b)
+    assert rows(e) == [(1,)]
+
+    # FROM subquery
+    s = pw.sql(
+        "SELECT g, sum(v) AS s FROM (SELECT * FROM a WHERE v > 1) t GROUP BY g",
+        a=a,
+    )
+    assert rows(s) == [("x", 2), ("y", 3)]
+
+    # WITH (CTE), referenced twice
+    w = pw.sql(
+        "WITH big AS (SELECT v FROM a WHERE v >= 2) "
+        "SELECT v FROM big UNION ALL SELECT v FROM big",
+        a=a,
+    )
+    assert rows(w) == [(2,), (2,), (3,), (3,)]
+
+
+def test_sql_set_op_associativity_and_anon_subquery():
+    """Chained set ops are left-associative with INTERSECT binding
+    tighter; an unaliased FROM-subquery must not swallow WHERE."""
+    import pathway_tpu as pw
+
+    a = T("v\n1\n2")
+    b = T("v\n2")
+    c = T("v\n2")
+    d = T("v\n5")
+
+    def rows(t):
+        return sorted(tuple(r) for r in run_capture(t).state.rows.values())
+
+    # (a EXCEPT b) EXCEPT c = {1}, not a EXCEPT (b EXCEPT c) = {1,2}
+    e = pw.sql(
+        "SELECT v FROM a EXCEPT SELECT v FROM b EXCEPT SELECT v FROM c",
+        a=a, b=b, c=c,
+    )
+    assert rows(e) == [(1,)]
+    # (a INTERSECT b) UNION d = {2,5}
+    u = pw.sql(
+        "SELECT v FROM a INTERSECT SELECT v FROM b UNION SELECT v FROM d",
+        a=a, b=b, d=d,
+    )
+    assert rows(u) == [(2,), (5,)]
+    # anonymous subquery followed by WHERE
+    w = pw.sql("SELECT v FROM (SELECT v FROM a) WHERE v > 1", a=a)
+    assert rows(w) == [(2,)]
+
+
+def test_universe_contradiction_and_equal_merge():
+    import pytest as _pytest
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals import universe as univ
+
+    solver = univ.get_solver()
+    a, b, c = univ.Universe(), univ.Universe(), univ.Universe()
+    solver.register_as_subset(a, b)
+    solver.register_as_equal(c, b)  # merge after the subset promise
+    assert solver.is_subset(a, b) and solver.is_subset(a, c)
+
+    x, y = univ.Universe(), univ.Universe()
+    solver.register_as_disjoint(x, y)
+    with _pytest.raises(ValueError, match="disjoint"):
+        solver.register_as_equal(x, y)
