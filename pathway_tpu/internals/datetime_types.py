@@ -199,7 +199,9 @@ class _DateTimeBase:
     def _fields(self) -> _dt.datetime:
         if self._utc:
             return _dt.datetime.fromtimestamp(self._ns / SEC, tz=_dt.timezone.utc)
-        return _dt.datetime.utcfromtimestamp(self._ns // SEC)
+        return _dt.datetime.fromtimestamp(
+            self._ns // SEC, tz=_dt.timezone.utc
+        ).replace(tzinfo=None)
 
     def nanosecond(self) -> int:
         return self._ns % US

@@ -192,6 +192,34 @@ class Session:
         self.cache[spec.id] = node
         return node
 
+    def _guarded_row_fn(
+        self, fns: list[Callable], trace: str | None
+    ) -> Callable:
+        """Per-column poison wrapper shared by every rowwise-style fn: a
+        failing expression yields ERROR in its column only (reference:
+        Value::Error semantics), logged with the user call site."""
+        graph = self.graph
+        suffix = f" (at {trace})" if trace else ""
+
+        def guard(f: Callable) -> Callable:
+            def g(key, rows):
+                try:
+                    return f(key, rows)
+                except Exception as e:  # noqa: BLE001
+                    graph.log_error(f"{type(e).__name__}: {e}{suffix}")
+                    from pathway_tpu.internals.errors import ERROR
+
+                    return ERROR
+
+            return g
+
+        gfns = [guard(f) for f in fns]
+
+        def fn(key: Key, *rows: tuple) -> tuple:
+            return tuple(f(key, rows) for f in gfns)
+
+        return fn
+
     def _compile_rowwise(
         self,
         main: Table,
@@ -223,31 +251,7 @@ class Session:
             }
         resolver = _SubstitutingResolver(tables, substitutions)
         fns = [compile_expression(e, resolver) for e in exprs.values()]
-        graph = self.graph
-
-        def guard(f):
-            # per-column poison: a failing expression yields ERROR in its
-            # column only (reference: Value::Error semantics); messages
-            # carry the user call site (trace.py parity)
-            suffix = f" (at {trace})" if trace else ""
-
-            def g(key, rows):
-                try:
-                    return f(key, rows)
-                except Exception as e:  # noqa: BLE001
-                    graph.log_error(f"{type(e).__name__}: {e}{suffix}")
-                    from pathway_tpu.internals.errors import ERROR
-
-                    return ERROR
-
-            return g
-
-        gfns = [guard(f) for f in fns]
-
-        def fn(key: Key, *rows: tuple) -> tuple:
-            return tuple(f(key, rows) for f in gfns)
-
-        return input_nodes, fn
+        return input_nodes, self._guarded_row_fn(fns, trace)
 
     def _build_async_node(self, main: Table, ae: ex.AsyncApplyExpression) -> eng.Node:
         resolver = Resolver([main])
@@ -627,10 +631,7 @@ class Session:
         }
         gres = GroupResolver(gb_exprs, reducer_slots, main)
         fns = [compile_expression(e, gres) for e in out_exprs.values()]
-
-        def fn(key: Key, *rows: tuple) -> tuple:
-            return tuple(f(key, rows) for f in fns)
-
+        fn = self._guarded_row_fn(fns, getattr(spec, "trace", None))
         return self._sharded(
             [gnode], lambda sg, ins: eng.RowwiseNode(sg, ins, fn), [_route_key]
         )
@@ -675,10 +676,7 @@ class Session:
         )
         jres = JoinResolver(left_t, right_t)
         fns = [compile_expression(e, jres) for e in out_exprs.values()]
-
-        def fn(key: Key, *rows: tuple) -> tuple:
-            return tuple(f(key, rows) for f in fns)
-
+        fn = self._guarded_row_fn(fns, getattr(spec, "trace", None))
         return self._sharded(
             [jnode], lambda sg, ins: eng.RowwiseNode(sg, ins, fn), [_route_key]
         )

@@ -1,0 +1,293 @@
+"""Breadth coverage the reference's tier-2 suite has: the type/coercion
+matrix (test_operators.py), error-path semantics (test_errors.py), and
+io streaming edge cases (test_io.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.errors import ERROR, ErrorValue
+from tests.utils import T, run_capture
+
+
+def _vals(table, col=0):
+    return sorted(
+        (r[col] for r in run_capture(table).state.rows.values()),
+        key=lambda v: (isinstance(v, ErrorValue), str(type(v)), str(v)),
+    )
+
+
+# ------------------------------------------------------------- type matrix
+
+
+def test_arithmetic_coercion_matrix():
+    t = T("i | f | b\n3 | 1.5 | True")
+    out = t.select(
+        ii=t.i + t.i,          # int + int -> int
+        if_=t.i + t.f,         # int + float -> float
+        fb=t.f * t.b,          # float * bool -> float
+        ib=t.i + t.b,          # int + bool -> int
+        div=t.i / 2,           # true division -> float
+        idiv=t.i // 2,         # floor division -> int
+        mod=t.i % 2,
+        pow_=t.i ** 2,
+    )
+    (row,) = run_capture(out).state.rows.values()
+    assert row == (6, 4.5, 1.5, 4, 1.5, 1, 1, 9)
+    assert isinstance(row[0], int) and isinstance(row[1], float)
+    assert isinstance(row[3], int) and isinstance(row[5], int)
+
+
+def test_comparison_and_boolean_ops():
+    t = T("a | b\n2 | 3")
+    out = t.select(
+        lt=t.a < t.b, le=t.a <= 2, eq=t.a == 2, ne=t.a != t.b,
+        conj=(t.a < t.b) & (t.b == 3),
+        disj=(t.a > t.b) | (t.b == 3),
+        neg=~(t.a > t.b),
+    )
+    (row,) = run_capture(out).state.rows.values()
+    assert row == (True, True, True, True, True, True, True)
+
+
+def test_cast_matrix_and_failures():
+    t = T("s | n\n12 | 7")
+    out = t.select(
+        s_to_i=pw.cast(int, t.s),
+        i_to_f=pw.cast(float, t.n),
+        i_to_s=pw.cast(str, t.n),
+        bad=pw.fill_error(pw.cast(int, pw.cast(str, "xyz")), -1),
+    )
+    (row,) = run_capture(out).state.rows.values()
+    assert row == (12, 7.0, "7", -1)
+
+
+def test_optional_none_semantics():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int, b=int | None), [(1, 5), (2, None)]
+    )
+    out = t.select(
+        both=pw.coalesce(t.b, 0) + t.a,
+        flag=t.b.is_none(),
+        flag2=t.b.is_not_none(),
+    )
+    rows = {tuple(r) for r in run_capture(out).state.rows.values()}
+    assert rows == {(6, False, True), (2, True, False)}
+
+
+def test_unwrap_and_require():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int, b=int | None), [(1, 5), (2, None)]
+    )
+    ok = t.filter(t.b.is_not_none()).select(v=pw.unwrap(pw.this.b))
+    assert _vals(ok) == [5]
+    # unwrap of None poisons the cell
+    bad = t.select(v=pw.fill_error(pw.unwrap(t.b), -1))
+    assert _vals(bad) == [-1, 5]
+    # require: None argument -> None result (reference require semantics)
+    req = t.select(v=pw.require(t.a + pw.unwrap(t.b, ERROR) if False else t.a, t.b))
+    rows = {tuple(r) for r in run_capture(req).state.rows.values()}
+    assert rows == {(1,), (None,)}
+
+
+def test_datetime_arithmetic_matrix():
+    from pathway_tpu.internals.datetime_types import DateTimeNaive, Duration
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(ts=DateTimeNaive, d=Duration),
+        [(DateTimeNaive("2024-01-02 03:04:05", fmt="%Y-%m-%d %H:%M:%S"),
+          Duration(hours=2))],
+    )
+    out = t.select(
+        plus=t.ts + t.d,
+        minus=t.ts - t.d,
+        delta=(t.ts + t.d) - t.ts,
+        hours=t.d.dt.hours(),
+        day=t.ts.dt.day(),
+    )
+    (row,) = run_capture(out).state.rows.values()
+    assert row[0].strftime("%H:%M") == "05:04"
+    assert row[1].strftime("%H:%M") == "01:04"
+    assert row[2] == Duration(hours=2)
+    assert row[3] == 2 and row[4] == 2
+
+
+# ------------------------------------------------------------- error paths
+
+
+def test_error_poisons_cell_not_row():
+    t = T("a | b\n6 | 2\n5 | 0")
+    out = t.select(ok=t.a, ratio=t.a // t.b)
+    rows = list(run_capture(out).state.rows.values())
+    assert sorted(r[0] for r in rows) == [5, 6]  # ok column intact
+    assert any(isinstance(r[1], ErrorValue) for r in rows)
+
+
+def test_error_propagates_through_expressions():
+    t = T("a | b\n5 | 0")
+    out = t.select(v=(t.a // t.b) + 100)  # ERROR + 100 -> ERROR
+    (row,) = run_capture(out).state.rows.values()
+    assert isinstance(row[0], ErrorValue)
+
+
+def test_remove_errors_and_fill_error():
+    t = T("a | b\n6 | 2\n5 | 0")
+    bad = t.select(ratio=t.a // t.b)
+    clean = bad.remove_errors()
+    assert _vals(clean) == [3]
+    filled = t.select(ratio=pw.fill_error(t.a // t.b, -1))
+    assert _vals(filled) == [-1, 3]
+
+
+def test_error_in_groupby_key_drops_row_logs():
+    t = T("a | b\n6 | 2\n5 | 0")
+    g = t.groupby(t.a // t.b).reduce(n=pw.reducers.count())
+    before = len(pw.global_error_log().entries)
+    cap = run_capture(g)
+    assert [r[0] for r in cap.state.rows.values()] == [1]
+    assert len(pw.global_error_log().entries) > before
+
+
+def test_terminate_on_error():
+    t = T("a | b\n5 | 0")
+    bad = t.select(v=t.a // t.b)
+    from pathway_tpu.internals.lowering import Session
+
+    s = Session()
+    s.graph.terminate_on_error = True
+    s.capture(bad)
+    with pytest.raises(RuntimeError, match="ZeroDivision"):
+        s.execute()
+
+
+def test_error_through_join_and_filter():
+    l = T("k | v\nx | 4\ny | 0")
+    r = T("k | w\nx | 1\ny | 2")
+    j = l.join(r, l.k == r.k).select(pw.left.k, q=100 // pw.left.v, w=pw.right.w)
+    rows = {(row[0], isinstance(row[1], ErrorValue), row[2])
+            for row in run_capture(j).state.rows.values()}
+    assert rows == {("x", False, 1), ("y", True, 2)}
+    # error condition in filter drops the row and logs
+    before = len(pw.global_error_log().entries)
+    f = l.filter(100 // l.v > 10)
+    assert _vals(f, col=1) == [4]
+    assert len(pw.global_error_log().entries) > before
+
+
+# ---------------------------------------------------------- io edge cases
+
+
+def test_csv_edge_cases(tmp_path):
+    p = tmp_path / "edge.csv"
+    p.write_text(
+        'name,val\n'
+        '"quoted, comma",1\n'
+        '"embedded ""quotes""",2\n'
+        '"multi\nline",3\n'
+        'plain,4\n'
+        ',5\n'  # empty first field
+    )
+
+    class S(pw.Schema):
+        name: str
+        val: int
+
+    t = pw.io.csv.read(str(p), schema=S, mode="static")
+    rows = {tuple(r) for r in run_capture(t).state.rows.values()}
+    assert rows == {
+        ("quoted, comma", 1),
+        ('embedded "quotes"', 2),
+        ("multi\nline", 3),
+        ("plain", 4),
+        ("", 5),
+    }
+
+
+def test_csv_empty_file_and_missing_columns(tmp_path):
+    empty = tmp_path / "empty.csv"
+    empty.write_text("")
+
+    class S(pw.Schema):
+        a: int
+        b: str
+
+    t = pw.io.csv.read(str(empty), schema=S, mode="static")
+    assert run_capture(t).state.rows == {}
+
+    # header present but a schema column missing -> None fills
+    partial = tmp_path / "partial.csv"
+    partial.write_text("a\n1\n2\n")
+    t2 = pw.io.csv.read(str(partial), schema=S, mode="static")
+    rows = {tuple(r) for r in run_capture(t2).state.rows.values()}
+    assert rows == {(1, None), (2, None)}
+
+
+def test_jsonlines_bad_lines_and_nested(tmp_path):
+    p = tmp_path / "data.jsonl"
+    p.write_text(
+        json.dumps({"a": 1, "meta": {"x": 1}}) + "\n"
+        + "\n"  # blank line skipped
+        + json.dumps({"a": 2, "meta": None}) + "\n"
+    )
+
+    class S(pw.Schema):
+        a: int
+        meta: pw.Json | None
+
+    t = pw.io.jsonlines.read(str(p), schema=S, mode="static")
+    cap = run_capture(t)
+    assert sorted(r[0] for r in cap.state.rows.values()) == [1, 2]
+
+
+def test_streaming_directory_picks_up_new_files(tmp_path):
+    d = tmp_path / "stream"
+    d.mkdir()
+    (d / "one.txt").write_text("alpha\n")
+
+    t = pw.io.plaintext.read(str(d), mode="streaming")
+    seen = []
+    done = {}
+
+    def on_change(key, row, time, is_addition):
+        seen.append(row["data"])
+
+    lt = t.live()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if {"alpha"} <= {r["data"] for r in lt.snapshot()}:
+            break
+        time.sleep(0.05)
+    (d / "two.txt").write_text("beta\n")
+    while time.monotonic() < deadline:
+        if {"alpha", "beta"} <= {r["data"] for r in lt.snapshot()}:
+            break
+        time.sleep(0.05)
+    lt.stop()
+    lt.wait(timeout=20)
+    assert {r["data"] for r in lt.snapshot()} == {"alpha", "beta"}
+
+
+def test_primary_key_upsert_semantics(tmp_path):
+    from pathway_tpu.io.python import ConnectorSubject
+
+    class Upserts(ConnectorSubject):
+        def run(self):
+            self.next(k="a", v=1)
+            self.next(k="a", v=2)  # same pk: replaces
+            self.next(k="b", v=9)
+
+    class S(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int
+
+    t = pw.io.python.read(Upserts(), schema=S)
+    lt = t.live()
+    lt.wait(timeout=30)
+    rows = {r["k"]: r["v"] for r in lt.snapshot()}
+    assert rows == {"a": 2, "b": 9}
