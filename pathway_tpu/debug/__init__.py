@@ -275,5 +275,38 @@ def compute_and_print_update_stream(
         print(" | ".join(cells))
 
 
-def diff_tables(t1: Table, t2: Table) -> None:
-    raise NotImplementedError
+def diff_tables(t1: Table, t2: Table) -> dict:
+    """Computes and prints the difference between two tables' final
+    states. Returns {"only_left": [...], "only_right": [...], "changed":
+    [(key, left_row, right_row), ...]} keyed on row ids; empty lists mean
+    the tables are identical."""
+    from pathway_tpu.engine.core import freeze_row
+    from pathway_tpu.internals.lowering import Session
+
+    session = Session()
+    cap1 = session.capture(t1)
+    cap2 = session.capture(t2)
+    session.execute()
+    rows1 = {k.value: r for k, r in cap1.state.rows.items()}
+    rows2 = {k.value: r for k, r in cap2.state.rows.items()}
+    only_left = [(k, rows1[k]) for k in rows1.keys() - rows2.keys()]
+    only_right = [(k, rows2[k]) for k in rows2.keys() - rows1.keys()]
+    changed = [
+        (k, rows1[k], rows2[k])
+        for k in rows1.keys() & rows2.keys()
+        if freeze_row(rows1[k]) != freeze_row(rows2[k])
+    ]
+    if not (only_left or only_right or changed):
+        print("tables are identical")
+    else:
+        for k, row in only_left:
+            print(f"- {k:032X} {row}")
+        for k, row in only_right:
+            print(f"+ {k:032X} {row}")
+        for k, l_row, r_row in changed:
+            print(f"~ {k:032X} {l_row} -> {r_row}")
+    return {
+        "only_left": only_left,
+        "only_right": only_right,
+        "changed": changed,
+    }

@@ -535,3 +535,17 @@ def test_universe_contradiction_and_equal_merge():
     solver.register_as_disjoint(x, y)
     with _pytest.raises(ValueError, match="disjoint"):
         solver.register_as_equal(x, y)
+
+
+def test_debug_diff_tables(capsys):
+    import pathway_tpu as pw
+
+    t1 = T("k | v\na | 1\nb | 2\nc | 3").with_id_from(pw.this.k)
+    t2 = T("k | v\na | 1\nb | 9\nd | 4").with_id_from(pw.this.k)
+    diff = pw.debug.diff_tables(t1, t2)
+    assert [r for (_k, r) in diff["only_left"]] == [("c", 3)]
+    assert [r for (_k, r) in diff["only_right"]] == [("d", 4)]
+    assert [(l, r) for (_k, l, r) in diff["changed"]] == [(("b", 2), ("b", 9))]
+    same = pw.debug.diff_tables(t1, t1.select(pw.this.k, pw.this.v))
+    assert not (same["only_left"] or same["only_right"] or same["changed"])
+    assert "identical" in capsys.readouterr().out

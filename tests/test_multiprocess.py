@@ -231,3 +231,70 @@ def test_iterate_under_two_processes(tmp_path):
         with open(out + f".{pid}") as f:
             all_rows.extend(json.load(f))
     assert sorted(all_rows) == [1, 1, 1], all_rows
+
+
+SLOW_SCRIPT = textwrap.dedent(
+    """
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    import pathway_tpu as pw
+    from pathway_tpu.io.python import ConnectorSubject
+
+    READY = sys.argv[1]
+    PID = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+
+    class Slow(ConnectorSubject):
+        def run(self):
+            for i in range(100000):
+                self.next(g=f"g{{i % 5}}", v=i)
+                if i == 5:
+                    open(READY + f".{{PID}}", "w").write("up")
+                time.sleep(0.05)
+
+    t = pw.io.python.read(Slow(), schema=pw.schema_from_types(g=str, v=int), name="slow")
+    agg = t.groupby(t.g).reduce(t.g, total=pw.reducers.sum(t.v))
+    pw.io.subscribe(agg, on_change=lambda key, row, time, is_addition: None)
+    pw.run()
+    """
+)
+
+
+def test_worker_failure_detected_not_hung(tmp_path):
+    """Killing one process mid-run must surface a clear peer-death error
+    on the survivor (failure detection), never an indefinite hang."""
+    ready = str(tmp_path / "ready")
+    base = _free_port_base(2)
+    procs = []
+    for pid in range(2):
+        env = {
+            **os.environ, "JAX_PLATFORMS": "cpu",
+            "PATHWAY_PROCESSES": "2", "PATHWAY_PROCESS_ID": str(pid),
+            "PATHWAY_FIRST_PORT": str(base),
+        }
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", SLOW_SCRIPT.format(repo=REPO), ready],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    import time as _time
+
+    # the single source lives on process 0; once it streams, lockstep
+    # control rounds prove BOTH meshes are up
+    deadline = _time.monotonic() + 60
+    while _time.monotonic() < deadline:
+        if os.path.exists(ready + ".0"):
+            break
+        _time.sleep(0.1)
+    else:
+        for p in procs:
+            p.kill()
+        raise AssertionError("workers did not come up")
+    _time.sleep(0.5)  # let a few more waves cross the mesh
+    procs[1].kill()
+    t0 = _time.monotonic()
+    _stdout, stderr = procs[0].communicate(timeout=120)
+    detect_s = _time.monotonic() - t0
+    procs[1].wait()
+    assert procs[0].returncode != 0
+    assert "died" in stderr or "peer" in stderr, stderr[-1500:]
+    # detection is prompt (socket EOF), not a timeout expiry
+    assert detect_s < 30, f"took {detect_s:.1f}s to notice the dead peer"
