@@ -56,6 +56,13 @@ class TransformerConfig:
     # `dataclasses.replace(cfg, fused_attention=False)`
     # (TransformerLM.shard does this for you).
     fused_attention: bool = True
+    # Sequence/context parallelism: name of the mesh axis the sequence is
+    # sharded over. When set, forward/encode must run INSIDE shard_map
+    # with [b, s_local, ...] blocks; attention runs as ring attention
+    # (ops/attention.py ring_attention — K/V blocks rotate over ICI with
+    # streaming-softmax accumulation), and positions/pooling account for
+    # the block offset. Long sequences scale with the ring size.
+    seq_axis: str | None = None
 
     @property
     def head_dim(self) -> int:
@@ -199,7 +206,19 @@ def _attention(
         "bsd,de->bse", x, block["qkv"].astype(cfg.dtype),
         preferred_element_type=jnp.float32,
     ).astype(cfg.dtype)
-    if not cfg.causal and cfg.fused_attention and _use_fused_attention():
+    if cfg.seq_axis is not None:
+        from pathway_tpu.ops.attention import ring_attention
+
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        ctx = ring_attention(
+            q.reshape(b, s, h, dh),
+            k.reshape(b, s, h, dh),
+            v.reshape(b, s, h, dh),
+            cfg.seq_axis,
+            causal=cfg.causal,
+            kv_mask=token_mask,
+        ).reshape(b, s, d)
+    elif not cfg.causal and cfg.fused_attention and _use_fused_attention():
         from pathway_tpu.ops.attention import fused_qkv_attention
 
         ctx = fused_qkv_attention(qkv, token_mask, h)
@@ -262,7 +281,24 @@ def forward(
     """Hidden states [b, s, d_model]."""
     b, s = token_ids.shape
     x = params["tok_embed"].astype(cfg.dtype)[token_ids]
-    x = x + params["pos_embed"].astype(cfg.dtype)[None, :s, :]
+    if cfg.seq_axis is not None:
+        # sequence-parallel block: positions offset by this device's block.
+        # The ring size is static, so over-length sequences fail at trace
+        # time (dynamic_slice would otherwise clamp and silently repeat
+        # the final positions).
+        n_blocks = jax.lax.psum(1, cfg.seq_axis)
+        if n_blocks * s > cfg.max_len:
+            raise ValueError(
+                f"sequence-parallel length {n_blocks}x{s} exceeds "
+                f"max_len={cfg.max_len}"
+            )
+        offset = jax.lax.axis_index(cfg.seq_axis) * s
+        pos = jax.lax.dynamic_slice_in_dim(
+            params["pos_embed"].astype(cfg.dtype), offset, s, axis=0
+        )
+        x = x + pos[None, :, :]
+    else:
+        x = x + params["pos_embed"].astype(cfg.dtype)[None, :s, :]
     mask = _build_mask(token_mask, cfg.causal)
     blk = functools.partial(_block_fwd, cfg=cfg, mask=mask, token_mask=token_mask)
     for block in params["blocks"]:
@@ -275,12 +311,22 @@ def encode(
 ) -> Array:
     """Pooled, L2-normalized embeddings [b, embed_dim] (f32)."""
     h = forward(params, token_ids, token_mask, cfg)
-    if cfg.pool == "mean":
-        # bf16 mask-and-sum (HBM-bound step); divide in f32 for accuracy
-        m16 = token_mask.astype(cfg.dtype)[:, :, None]
-        pooled = jnp.sum(h * m16, axis=1).astype(jnp.float32) / jnp.maximum(
-            jnp.sum(token_mask, axis=1)[:, None].astype(jnp.float32), 1.0
+    if cfg.seq_axis is not None and cfg.pool != "mean":
+        # 'cls'/'last' would need a block broadcast across the ring
+        raise NotImplementedError(
+            "sequence-parallel encode supports mean pooling"
         )
+    if cfg.pool == "mean":
+        # bf16 mask-and-sum (HBM-bound step); divide in f32 for accuracy.
+        # Under sequence parallelism the block-local partials combine over
+        # the ring before the divide.
+        m16 = token_mask.astype(cfg.dtype)[:, :, None]
+        part = jnp.sum(h * m16, axis=1).astype(jnp.float32)
+        cnt = jnp.sum(token_mask, axis=1)[:, None].astype(jnp.float32)
+        if cfg.seq_axis is not None:
+            part = jax.lax.psum(part, cfg.seq_axis)
+            cnt = jax.lax.psum(cnt, cfg.seq_axis)
+        pooled = part / jnp.maximum(cnt, 1.0)
     elif cfg.pool == "cls":
         pooled = h[:, 0, :].astype(jnp.float32)
     else:  # last valid token

@@ -132,3 +132,101 @@ def reference_attention(
         "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
     ).astype(qkv.dtype)
     return ctx.reshape(b, s, d)
+
+
+# --------------------------------------------------------- ring attention
+#
+# Long-context sequence/context parallelism: the sequence is sharded
+# across a mesh axis; K/V blocks rotate around the ring via ppermute
+# while each device accumulates its queries' attention with a streaming
+# (flash-style) softmax. Peak memory per device is O(s_local^2) scores
+# and one K/V block — sequences scale with the ring size. Communication
+# rides ICI (ppermute neighbors), overlapping with each step's matmuls
+# under XLA's latency-hiding scheduler.
+#
+# Reference parity: replaces the single-device torch SDPA ceiling of the
+# reference's local models with the standard ring-attention construction
+# (blockwise-parallel transformers over a device ring).
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    kv_mask: jax.Array | None = None,
+    scale: float | None = None,
+) -> jax.Array:
+    """Exact multi-head attention over a sequence sharded on `axis_name`.
+
+    Call INSIDE shard_map with q/k/v [b, s_local, h, dh] holding this
+    device's sequence block (global sequence = blocks in axis order).
+    `kv_mask` [b, s_local] marks valid key positions of the local block
+    (it rotates around the ring with K/V). Returns ctx [b, s_local, h, dh].
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_loc, h, dh = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(dh)
+    qf = q.astype(jnp.float32)
+    q_pos = my * s_loc + jnp.arange(s_loc)
+
+    def accumulate(o, m, l, kblk, vblk, mblk, i):
+        """Fold the currently-held K/V block into the streaming softmax."""
+        src = (my - i) % n  # block index currently held
+        scores = (
+            jnp.einsum("bqhd,bkhd->bhqk", qf, kblk.astype(jnp.float32)) * sc
+        )
+        valid = mblk[:, None, None, :].astype(bool)
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])[None, None, :, :]
+        scores = jnp.where(valid, scores, -jnp.inf)
+        blk_max = jnp.max(scores, axis=-1)  # [b,h,q]
+        new_m = jnp.maximum(m, blk_max)
+        # rows with no valid key anywhere so far keep m=-inf; exp(-inf-(-inf))
+        # would be NaN — pin those rows to 0 contribution
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        p = jnp.where(
+            jnp.isfinite(scores), jnp.exp(scores - safe_m[..., None]), 0.0
+        )
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vblk.astype(jnp.float32)
+        )
+        return o, new_m, l
+
+    ring = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        # rotate first, then accumulate: n-1 rotations total (the local
+        # block is folded before the scan; a final-step rotation would
+        # only be discarded)
+        o, m, l, kblk, vblk, mblk = carry
+        kblk = jax.lax.ppermute(kblk, axis_name, ring)
+        vblk = jax.lax.ppermute(vblk, axis_name, ring)
+        mblk = jax.lax.ppermute(mblk, axis_name, ring)
+        o, m, l = accumulate(o, m, l, kblk, vblk, mblk, i)
+        return (o, m, l, kblk, vblk, mblk), None
+
+    # build the initial carries FROM q so they inherit q's varying-axes
+    # set under shard_map (the scan carry types must match whatever axes
+    # the body's outputs vary over — ring axis AND any batch axes)
+    o0 = jnp.transpose(qf * 0.0, (0, 2, 1, 3))  # [b,h,s,dh] zeros
+    l0 = o0[..., 0]  # [b,h,s] zeros
+    m0 = l0 - jnp.inf  # [b,h,s] -inf
+    mask0 = (
+        kv_mask if kv_mask is not None else jnp.ones((b, s_loc), jnp.int32)
+    )
+    o0, m0, l0 = accumulate(o0, m0, l0, k, v, mask0, 0)  # local block
+    if n > 1:
+        (o, m, l, _k, _v, _m), _ = jax.lax.scan(
+            step, (o0, m0, l0, k, v, mask0), jnp.arange(1, n)
+        )
+    else:
+        o, l = o0, l0
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
