@@ -65,6 +65,7 @@ class ProcessMesh:
         self._cv = threading.Condition()
         self._data: dict[tuple[int, int, int], list] = {}  # (node, round, proc)
         self._ctl: dict[tuple[int, int], tuple[bool, bool, int]] = {}  # (round, proc)
+        self._nego: dict[tuple[str, int], Any] = {}  # (tag, proc) -> value
         self._dead: set[int] = set()
         self._closed = False
         self._listener = socket.socket()
@@ -138,6 +139,9 @@ class ProcessMesh:
                     if kind == "data":
                         node_id, rnd, entries = payload
                         self._data[(node_id, rnd, peer)] = entries
+                    elif kind == "nego":
+                        tag, value = payload
+                        self._nego[(tag, peer)] = value
                     else:  # ctl
                         rnd, has_data, done, t_hint = payload
                         self._ctl[(rnd, peer)] = (has_data, done, t_hint)
@@ -213,6 +217,25 @@ class ProcessMesh:
                 all_done = all_done and p_done
                 t_max = max(t_max, p_hint)
         return any_data, all_done, t_max
+
+    def allgather(self, tag: str, value: Any) -> dict[int, Any]:
+        """One-shot all-gather of a small value under a unique tag (e.g.
+        checkpoint-epoch negotiation at startup). Returns proc -> value
+        for every process including this one."""
+        for p in self.peers:
+            self._send(p, "nego", (tag, value))
+        out = {self.process_id: value}
+        with self._cv:
+            for p in self.peers:
+                while (tag, p) not in self._nego:
+                    if p in self._dead:
+                        raise ConnectionError(
+                            f"process {self.process_id}: peer {p} died "
+                            f"(negotiating {tag!r})"
+                        )
+                    self._cv.wait(60.0)
+                out[p] = self._nego.pop((tag, p))
+        return out
 
     def close(self) -> None:
         self._closed = True

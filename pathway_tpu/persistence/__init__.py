@@ -245,21 +245,55 @@ class MetadataStore:
         except (OSError, ValueError):
             return None
 
+    _UNSET = object()
+
     def commit(
-        self, epoch: int, offsets: dict[str, int], signature: str, finalized_time: int
+        self,
+        epoch: int,
+        offsets: dict[str, int],
+        signature: str,
+        finalized_time: int,
+        prev: "dict | None | object" = _UNSET,
     ) -> None:
-        _fsync_write(
-            self.path,
-            _json.dumps(
-                {
-                    "epoch": epoch,
-                    "offsets": offsets,
-                    "signature": signature,
-                    "finalized_time": finalized_time,
-                    "committed_at": _time.time(),
-                }
-            ).encode(),
-        )
+        record = {
+            "epoch": epoch,
+            "offsets": offsets,
+            "signature": signature,
+            "finalized_time": finalized_time,
+            "committed_at": _time.time(),
+        }
+        # keep the PREVIOUS epoch's record: multi-process recovery may
+        # need to roll back one epoch when peers crashed between each
+        # other's commits (coordinated-recovery min-epoch negotiation).
+        # Callers that already hold the previous record pass it in (one
+        # consistent snapshot, one read); prev=None means "no history"
+        # (rollback rewrite).
+        if prev is MetadataStore._UNSET:
+            prev = self.load()
+        if prev is not None:
+            record["history"] = [
+                {k: prev[k] for k in
+                 ("epoch", "offsets", "signature", "finalized_time")
+                 if k in prev}
+            ]
+        _fsync_write(self.path, _json.dumps(record).encode())
+
+    def clear(self) -> None:
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+    def record_for(self, epoch: int) -> dict | None:
+        meta = self.load()
+        if meta is None:
+            return None
+        if int(meta.get("epoch", -1)) == epoch:
+            return meta
+        for rec in meta.get("history", []):
+            if int(rec.get("epoch", -1)) == epoch:
+                return rec
+        return None
 
 
 class OperatorSnapshotStore:
@@ -282,7 +316,10 @@ class OperatorSnapshotStore:
         with open(p, "rb") as f:
             return pickle.load(f)  # noqa: S301
 
-    def compact(self, keep_epoch: int) -> None:
+    def compact(self, keep_epochs: "set[int] | int") -> None:
+        keep = (
+            {keep_epochs} if isinstance(keep_epochs, int) else set(keep_epochs)
+        )
         for fn in os.listdir(self.root):
             if not fn.endswith(".state"):
                 continue
@@ -290,7 +327,7 @@ class OperatorSnapshotStore:
                 epoch = int(fn.rsplit(".", 2)[-2])
             except (ValueError, IndexError):
                 continue
-            if epoch != keep_epoch:
+            if epoch not in keep:
                 try:
                     os.unlink(os.path.join(self.root, fn))
                 except OSError:
@@ -337,13 +374,56 @@ class CheckpointManager:
 
     # ------------------------------------------------------------ restore
 
-    def restore(self) -> dict[str, int]:
-        """Returns per-connector replay offsets ({} = cold start). Loads
-        operator snapshots when the pipeline signature matches."""
+    def latest_epoch(self) -> int:
         meta = self.metadata.load()
+        return int(meta["epoch"]) if meta is not None else 0
+
+    def restore(self, epoch: int | None = None) -> dict[str, int]:
+        """Returns per-connector replay offsets ({} = cold start). Loads
+        operator snapshots when the pipeline signature matches. `epoch`
+        selects a specific committed epoch (multi-process recovery rolls
+        back to the minimum epoch every process holds); default latest."""
+        if epoch == 0:
+            # agreed cold start (a peer has no checkpoint): ignore local
+            # snapshots; the full journal replays — only sound if its head
+            # survives. The stale metadata is wiped so the next commit
+            # starts a fresh epoch chain consistent with the peers.
+            meta0 = self.metadata.load()
+            names = list(meta0["offsets"]) if meta0 else []
+            for name in names:
+                if self.journal.head_offset(name) > 0:
+                    raise RuntimeError(
+                        f"cold recovery needs the full journal for "
+                        f"{name!r} but it was compacted; clear the "
+                        "persistence directories to restart"
+                    )
+            self.metadata.clear()
+            self.epoch = 0
+            return {name: 0 for name in names}
+        meta = (
+            self.metadata.load()
+            if epoch is None
+            else self.metadata.record_for(epoch)
+        )
         if meta is None:
+            if epoch:
+                raise RuntimeError(
+                    f"checkpoint epoch {epoch} is not available locally; "
+                    "clear the persistence directory to cold-start"
+                )
             return {}
         offsets: dict[str, int] = {k: int(v) for k, v in meta["offsets"].items()}
+        # the journal must still cover every offset this epoch needs —
+        # silently-skipped missing head segments would drop events
+        for name, off in offsets.items():
+            head = self.journal.head_offset(name)
+            if head > off:
+                raise RuntimeError(
+                    f"journal for {name!r} was compacted to offset {head}, "
+                    f"past epoch {meta.get('epoch')}'s offset {off}; cannot "
+                    "resume from this epoch. Clear the persistence "
+                    "directories to restart."
+                )
         if meta.get("signature") == self.signature and self.config.operator_snapshots:
             # Phase 1 — read + validate every snapshot before touching any
             # node: a corrupt/unreadable file falls back cleanly to journal
@@ -377,6 +457,18 @@ class CheckpointManager:
                 self.epoch = int(meta["epoch"])
                 self.restored = True
                 self._restored_offsets = offsets
+                if epoch is not None:
+                    # rollback: rewrite the on-disk record to the agreed
+                    # epoch NOW, else the next commit would chain its
+                    # history and journal-compaction floor off the stale
+                    # pre-crash record (unrecoverable on a second crash)
+                    self.metadata.commit(
+                        self.epoch,
+                        offsets,
+                        str(meta.get("signature")),
+                        int(meta.get("finalized_time", 0)),
+                        prev=None,
+                    )
                 return offsets
         # fall back to full journal replay — only sound if the head exists
         for name in offsets:
@@ -426,13 +518,22 @@ class CheckpointManager:
                 if st is not None:
                     self.ops.write(_persistent_id(node), epoch, st)
         # 3. metadata commit (the linearization point)
-        self.metadata.commit(epoch, offsets, self.signature, finalized_time)
+        prev_record = self.metadata.load()
+        self.metadata.commit(
+            epoch, offsets, self.signature, finalized_time, prev=prev_record
+        )
         self.epoch = epoch
-        # 4. compaction: journal head + old snapshot epochs are now dead
+        # 4. compaction — keep TWO epochs of snapshots and the journal
+        # back to the previous epoch's offsets, so multi-process recovery
+        # can roll back one epoch when peers crashed between commits
         if wrote_ops:
-            self.ops.compact(epoch)
+            self.ops.compact({epoch - 1, epoch})
+            prev_offsets = (
+                prev_record.get("offsets", {}) if prev_record else {}
+            )
             for name, committed in offsets.items():
-                self.journal.compact(name, committed)
+                safe = min(int(prev_offsets.get(name, committed)), committed)
+                self.journal.compact(name, safe)
                 # roll the segment so future compactions can free it
                 w = self._writers[name]
                 if w.count:
@@ -467,7 +568,15 @@ def attach_persistence(session: Any, config: Config) -> None:
             operator_snapshots=config.operator_snapshots,
         )
     manager = CheckpointManager(session, config)
-    replay_offsets = manager.restore()
+    if getattr(session, "mesh", None) is not None:
+        # coordinated recovery: a crash can land BETWEEN two processes'
+        # commits of the same epoch, so resume from the MINIMUM epoch all
+        # processes hold — each keeps two epochs for exactly this
+        epochs = session.mesh.allgather("ckpt-epoch", manager.latest_epoch())
+        agreed = min(epochs.values())
+        replay_offsets = manager.restore(epoch=agreed)
+    else:
+        replay_offsets = manager.restore()
 
     from pathway_tpu.engine.runtime import Connector
 

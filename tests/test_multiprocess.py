@@ -298,3 +298,99 @@ def test_worker_failure_detected_not_hung(tmp_path):
     assert "died" in stderr or "peer" in stderr, stderr[-1500:]
     # detection is prompt (socket EOF), not a timeout expiry
     assert detect_s < 30, f"took {detect_s:.1f}s to notice the dead peer"
+
+
+PERSIST_SCRIPT = textwrap.dedent(
+    """
+    import json, os, sys, time
+    sys.path.insert(0, {repo!r})
+    import pathway_tpu as pw
+    from pathway_tpu.io.python import ConnectorSubject
+
+    PDIR, OUT, READY = sys.argv[1], sys.argv[2], sys.argv[3]
+    PID = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+
+    class Nums(ConnectorSubject):
+        def run(self):
+            for i in range(200):
+                self.next(g=f"g{{i % 4}}", v=i)
+                if i == 5:
+                    open(READY + f".{{PID}}", "w").write("up")
+                time.sleep(0.01)
+
+    t = pw.io.python.read(Nums(), schema=pw.schema_from_types(g=str, v=int), name="nums")
+    agg = t.groupby(t.g).reduce(t.g, total=pw.reducers.sum(t.v), n=pw.reducers.count())
+    sink = open(OUT + f".{{PID}}", "a")
+    def on_change(key, row, time, is_addition):
+        sink.write(json.dumps({{**row, "add": is_addition}}) + "\\n"); sink.flush()
+    pw.io.subscribe(agg, on_change=on_change)
+    pw.run(persistence_config=pw.persistence.Config(
+        pw.persistence.Backend.filesystem(PDIR)))
+    """
+)
+
+
+def test_multiprocess_kill_both_and_resume_exact(tmp_path):
+    """Both cooperating processes die mid-run (possibly between each
+    other's checkpoint commits); restart negotiates the minimum common
+    epoch and resumes to EXACT global aggregates."""
+    import time as _time
+
+    pdir = str(tmp_path / "pstate")
+    out = str(tmp_path / "deliveries")
+    ready = str(tmp_path / "ready")
+    base = _free_port_base(2)
+
+    def launch():
+        procs = []
+        for pid in range(2):
+            env = {
+                **os.environ, "JAX_PLATFORMS": "cpu",
+                "PATHWAY_PROCESSES": "2", "PATHWAY_PROCESS_ID": str(pid),
+                "PATHWAY_FIRST_PORT": str(base),
+            }
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", PERSIST_SCRIPT.format(repo=REPO),
+                 pdir, out, ready],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            ))
+        return procs
+
+    # phase 1: run until waves flow, then SIGKILL both (at slightly
+    # different instants — the window between peers' checkpoint commits)
+    procs = launch()
+    deadline = _time.monotonic() + 60
+    while _time.monotonic() < deadline and not os.path.exists(ready + ".0"):
+        _time.sleep(0.1)
+    assert os.path.exists(ready + ".0"), "phase 1 did not come up"
+    _time.sleep(1.0)
+    procs[0].kill()
+    _time.sleep(0.05)
+    procs[1].kill()
+    for p in procs:
+        p.wait()
+
+    # phase 2: resume with the same dirs; must run to completion
+    os.unlink(ready + ".0")
+    procs = launch()
+    for p in procs:
+        _stdout, stderr = p.communicate(timeout=180)
+        assert p.returncode == 0, stderr[-3000:]
+
+    # reconstruct per-group finals from the accumulated delivery streams
+    state: dict = {}
+    for pid in range(2):
+        with open(out + f".{pid}") as f:
+            for line in f:
+                ev = json.loads(line)
+                if ev["add"]:
+                    state[ev["g"]] = (ev["total"], ev["n"])
+                elif state.get(ev["g"]) == (ev["total"], ev["n"]):
+                    del state[ev["g"]]
+    expected: dict = {}
+    for i in range(200):
+        g = f"g{i % 4}"
+        t0, n0 = expected.get(g, (0, 0))
+        expected[g] = (t0 + i, n0 + 1)
+    assert state == expected, (state, expected)

@@ -204,3 +204,48 @@ def test_signature_mismatch_refuses_compacted_resume(tmp_path):
 
     with pytest.raises(RuntimeError, match="compacted"):
         m.restore()
+
+
+def test_rollback_restore_rewrites_metadata(tmp_path):
+    """Rolling back one epoch (multi-process coordinated recovery) must
+    rewrite metadata.json so the NEXT commit chains its history and
+    journal-compaction floor off the agreed epoch — a second crash in the
+    same window must still find the rollback epoch (double-crash
+    regression from review)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.lowering import Session
+    from pathway_tpu.persistence import Backend, CheckpointManager, Config
+
+    def build():
+        t = pw.debug.table_from_markdown(
+            "k | v\na | 1\nb | 2"
+        ).with_id_from(pw.this.k)
+        return t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+
+    cfg = Config(Backend.filesystem(str(tmp_path)))
+    s1 = Session()
+    s1.capture(build())
+    s1.execute()
+    m1 = CheckpointManager(s1, cfg)
+    m1.checkpoint(finalized_time=10)  # epoch 1
+    m1.checkpoint(finalized_time=20)  # epoch 2 (history holds 1)
+    assert m1.latest_epoch() == 2
+
+    # simulate the peer-negotiated rollback to epoch 1 on a fresh process
+    s2 = Session()
+    s2.capture(build())
+    m2 = CheckpointManager(s2, cfg)
+    offsets = m2.restore(epoch=1)
+    assert m2.restored and m2.epoch == 1
+    # the on-disk record now reads epoch 1 — a second crash before any new
+    # checkpoint still negotiates and finds epoch 1
+    assert m2.latest_epoch() == 1
+    s3 = Session()
+    s3.capture(build())
+    m3 = CheckpointManager(s3, cfg)
+    m3.restore(epoch=1)
+    assert m3.restored and m3.epoch == 1
+    # and the next commit chains cleanly from the agreed epoch
+    m3.checkpoint(finalized_time=30)
+    assert m3.latest_epoch() == 2
+    assert m3.metadata.record_for(1) is not None
