@@ -29,9 +29,20 @@ Entry = tuple[Key, tuple, int]  # (key, row, diff)
 
 
 def freeze_value(v: Any) -> Any:
-    """Make a value usable as part of a dict key (multiset token)."""
+    """Make a value usable as part of a dict key (multiset token).
+
+    Fast path: anything already hashable IS its own frozen form (freezing
+    only rewrites unhashable values — ndarrays, dicts, lists — and tuples
+    containing them are themselves unhashable), so one hash() probe
+    replaces the recursive walk for the common all-scalar rows.
+    """
     if isinstance(v, np.ndarray):
         return ("\x00ndarray", str(v.dtype), v.shape, v.tobytes())
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        pass
     if isinstance(v, tuple):
         return tuple(freeze_value(x) for x in v)
     if isinstance(v, dict):
@@ -40,15 +51,15 @@ def freeze_value(v: Any) -> Any:
         return ("\x00json", Json.dumps(v))
     if isinstance(v, list):
         return tuple(freeze_value(x) for x in v)
-    try:
-        hash(v)
-        return v
-    except TypeError:
-        return ("\x00repr", repr(v))
+    return ("\x00repr", repr(v))
 
 
 def freeze_row(row: tuple) -> tuple:
-    return tuple(freeze_value(v) for v in row)
+    try:
+        hash(row)
+        return row
+    except TypeError:
+        return tuple(freeze_value(v) for v in row)
 
 
 def consolidate(entries: Iterable[Entry]) -> list[Entry]:

@@ -81,12 +81,30 @@ def _hash_bytes(data: bytes) -> int:
     return int.from_bytes(hashlib.blake2b(data, digest_size=16).digest(), "little")
 
 
+_np = None
+_pw_json = None
+_dt_types = None
+
+
+def _lazy_modules():
+    global _np, _pw_json, _dt_types
+    if _np is None:
+        import numpy
+
+        from pathway_tpu.internals import datetime_types, json
+
+        _np = numpy
+        _pw_json = json
+        _dt_types = datetime_types
+    return _np, _pw_json, _dt_types
+
+
 def _serialize_value(value: Any, out: list[bytes]) -> None:
     """Canonical serialization of a Value for hashing (type-tagged)."""
-    import numpy as np
-
-    from pathway_tpu.internals import json as pw_json
-    from pathway_tpu.internals.datetime_types import DateTimeNaive, DateTimeUtc, Duration
+    np, pw_json, dtt = _lazy_modules()
+    DateTimeNaive, DateTimeUtc, Duration = (
+        dtt.DateTimeNaive, dtt.DateTimeUtc, dtt.Duration
+    )
 
     if value is None:
         out.append(b"\x00")
@@ -122,7 +140,32 @@ def _serialize_value(value: Any, out: list[bytes]) -> None:
         out.append(b"\x0d" + repr(value).encode("utf-8", "replace"))
 
 
+def _fast_piece(v: Any) -> bytes | None:
+    """Byte-identical to _serialize_value for the hot scalar types (the
+    join/flatten/group output-key shapes); None falls back to the
+    generic serializer."""
+    t = type(v)
+    if t is Key:
+        return b"\x06" + v.value.to_bytes(16, "little")
+    if t is int:
+        return b"\x02" + struct.pack("<q", v)
+    if v is None:
+        return b"\x00"
+    if t is str:
+        b = v.encode("utf-8")
+        return b"\x04" + struct.pack("<q", len(b)) + b
+    return None
+
+
 def hash_values(*values: Any) -> int:
+    pieces: list[bytes] = []
+    for v in values:
+        p = _fast_piece(v)
+        if p is None:
+            break
+        pieces.append(p)
+    else:
+        return _hash_bytes(b"".join(pieces))
     out: list[bytes] = []
     for v in values:
         _serialize_value(v, out)

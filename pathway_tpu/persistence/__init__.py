@@ -316,10 +316,8 @@ class OperatorSnapshotStore:
         with open(p, "rb") as f:
             return pickle.load(f)  # noqa: S301
 
-    def compact(self, keep_epochs: "set[int] | int") -> None:
-        keep = (
-            {keep_epochs} if isinstance(keep_epochs, int) else set(keep_epochs)
-        )
+    def compact(self, keep_epochs: set[int]) -> None:
+        keep = set(keep_epochs)
         for fn in os.listdir(self.root):
             if not fn.endswith(".state"):
                 continue
@@ -480,6 +478,13 @@ class CheckpointManager:
                     "cannot resume. Clear the persistence directory or revert "
                     "the pipeline/worker configuration."
                 )
+        if epoch is not None:
+            # negotiated-epoch resume via full replay (snapshots disabled
+            # or unusable): restart the epoch chain at 0 so every peer's
+            # next commit agrees — leaving the stale record would desync
+            # chains on the next crash
+            self.metadata.clear()
+            self.epoch = 0
         return {name: 0 for name in offsets}
 
     # --------------------------------------------------------- journaling
@@ -532,7 +537,10 @@ class CheckpointManager:
                 prev_record.get("offsets", {}) if prev_record else {}
             )
             for name, committed in offsets.items():
-                safe = min(int(prev_offsets.get(name, committed)), committed)
+                # no previous record -> floor 0: the pre-existing journal
+                # may still serve an agreed-epoch-0 recovery (a genuine
+                # first run has nothing to compact anyway)
+                safe = min(int(prev_offsets.get(name, 0)), committed)
                 self.journal.compact(name, safe)
                 # roll the segment so future compactions can free it
                 w = self._writers[name]

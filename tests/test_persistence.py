@@ -249,3 +249,42 @@ def test_rollback_restore_rewrites_metadata(tmp_path):
     m3.checkpoint(finalized_time=30)
     assert m3.latest_epoch() == 2
     assert m3.metadata.record_for(1) is not None
+
+
+def test_cold_recovery_preserves_journal_across_first_checkpoint(tmp_path):
+    """After an agreed cold start, the resumed run's FIRST checkpoint must
+    not compact the pre-existing journal — a second between-commits crash
+    still negotiates epoch 0 and replays it (review regression)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.lowering import Session
+    from pathway_tpu.persistence import Backend, CheckpointManager, Config
+
+    def build():
+        t = pw.debug.table_from_markdown("k | v\na | 1").with_id_from(pw.this.k)
+        return t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+
+    cfg = Config(Backend.filesystem(str(tmp_path)))
+    s1 = Session()
+    s1.capture(build())
+    s1.execute()
+    m1 = CheckpointManager(s1, cfg)
+    m1.open_writer("src", 0)
+    for i in range(5):
+        m1.append("src", i, (i,), 1)
+    m1.checkpoint(finalized_time=10)  # epoch 1, journal [0..5)
+
+    # agreed cold start (a peer had nothing): metadata cleared, journal kept
+    s2 = Session()
+    s2.capture(build())
+    m2 = CheckpointManager(s2, cfg)
+    assert m2.restore(epoch=0) == {"src": 0}
+    assert m2.latest_epoch() == 0
+    # resumed run's first checkpoint: journal head must SURVIVE
+    m2.open_writer("src", m2.journal.total_events("src"))
+    m2.checkpoint(finalized_time=20)  # epoch 1 of the new chain
+    assert m2.journal.head_offset("src") == 0, "journal head compacted"
+    # a second cold negotiation still works
+    s3 = Session()
+    s3.capture(build())
+    m3 = CheckpointManager(s3, cfg)
+    assert m3.restore(epoch=0) == {"src": 0}
