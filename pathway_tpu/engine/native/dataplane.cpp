@@ -1,0 +1,1335 @@
+// Native data plane: token-resident rows for the dataflow hot path.
+//
+// Reference parity: the reference keeps every production row inside the
+// Rust engine as typed `Value`s flowing through differential arrangements
+// (/root/reference/src/engine/dataflow.rs:2270,2991,5506 and the vendored
+// differential-dataflow); Python only appears at UDF boundaries. This
+// library gives the Python engine the same property: rows are interned
+// ONCE at ingest into canonical serialized bytes (the exact byte format of
+// internals/keys._serialize_value, so 128-bit row keys computed here are
+// bit-identical to the Python ones), and from then on a batch is four flat
+// arrays (key_lo, key_hi, token, diff). Parsing, key hashing, group
+// projection, shard routing, row building and output formatting all run
+// here, one call per batch, with the GIL released (ctypes).
+//
+// Value piece format (must stay byte-identical to keys._serialize_value):
+//   0x00                        None
+//   0x01 u8                     bool
+//   0x02 i64-le                 int
+//   0x03 f64-le                 float
+//   0x04 i64-le len, utf8       str
+//   0x05 i64-le len, raw        bytes
+// A row is the concatenation of its column pieces. key_for_values(row) =
+// blake2b-128(row bytes), exactly like the Python side.
+//
+// Build: g++ -O3 -shared -fPIC (engine/native/dataplane.py drives it).
+
+#include <algorithm>
+#include <cerrno>
+#include <charconv>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ------------------------------------------------------------- blake2b-128
+// RFC 7693, sequential mode, no key. Digest size 16 bytes — must match
+// hashlib.blake2b(data, digest_size=16).
+
+constexpr uint64_t B2B_IV[8] = {
+    0x6A09E667F3BCC908ull, 0xBB67AE8584CAA73Bull, 0x3C6EF372FE94F82Bull,
+    0xA54FF53A5F1D36F1ull, 0x510E527FADE682D1ull, 0x9B05688C2B3E6C1Full,
+    0x1F83D9ABFB41BD6Bull, 0x5BE0CD19137E2179ull};
+
+constexpr uint8_t B2B_SIGMA[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+inline uint64_t rotr64(uint64_t x, int n) { return (x >> n) | (x << (64 - n)); }
+
+inline uint64_t load64(const uint8_t* p) {
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    return v;  // little-endian hosts only (x86/arm)
+}
+
+struct Blake2b {
+    uint64_t h[8];
+    uint8_t buf[128];
+    size_t buflen = 0;
+    uint64_t t = 0;  // total bytes compressed (fits u64 for our sizes)
+
+    explicit Blake2b(size_t digest_len) {
+        for (int i = 0; i < 8; ++i) h[i] = B2B_IV[i];
+        h[0] ^= 0x01010000ull ^ static_cast<uint64_t>(digest_len);
+    }
+
+    void compress(const uint8_t* block, bool last) {
+        uint64_t v[16], m[16];
+        for (int i = 0; i < 8; ++i) v[i] = h[i];
+        for (int i = 0; i < 8; ++i) v[i + 8] = B2B_IV[i];
+        v[12] ^= t;  // t_lo (t_hi stays 0 for < 2^64 bytes)
+        if (last) v[14] = ~v[14];
+        for (int i = 0; i < 16; ++i) m[i] = load64(block + 8 * i);
+        for (int r = 0; r < 12; ++r) {
+            const uint8_t* s = B2B_SIGMA[r];
+#define B2B_G(a, b, c, d, x, y)                                   \
+    v[a] = v[a] + v[b] + (x); v[d] = rotr64(v[d] ^ v[a], 32);     \
+    v[c] = v[c] + v[d];       v[b] = rotr64(v[b] ^ v[c], 24);     \
+    v[a] = v[a] + v[b] + (y); v[d] = rotr64(v[d] ^ v[a], 16);     \
+    v[c] = v[c] + v[d];       v[b] = rotr64(v[b] ^ v[c], 63);
+            B2B_G(0, 4, 8, 12, m[s[0]], m[s[1]])
+            B2B_G(1, 5, 9, 13, m[s[2]], m[s[3]])
+            B2B_G(2, 6, 10, 14, m[s[4]], m[s[5]])
+            B2B_G(3, 7, 11, 15, m[s[6]], m[s[7]])
+            B2B_G(0, 5, 10, 15, m[s[8]], m[s[9]])
+            B2B_G(1, 6, 11, 12, m[s[10]], m[s[11]])
+            B2B_G(2, 7, 8, 13, m[s[12]], m[s[13]])
+            B2B_G(3, 4, 9, 14, m[s[14]], m[s[15]])
+#undef B2B_G
+        }
+        for (int i = 0; i < 8; ++i) h[i] ^= v[i] ^ v[i + 8];
+    }
+
+    void update(const uint8_t* data, size_t len) {
+        while (len > 0) {
+            if (buflen == 128) {  // buffer full AND more coming -> compress
+                t += 128;
+                compress(buf, false);
+                buflen = 0;
+            }
+            size_t take = 128 - buflen;
+            if (take > len) take = len;
+            std::memcpy(buf + buflen, data, take);
+            buflen += take;
+            data += take;
+            len -= take;
+        }
+    }
+
+    // 128-bit digest as (lo, hi) halves of the little-endian digest bytes:
+    // Python does int.from_bytes(digest, "little"), so digest[0:8] is the
+    // LOW u64 and digest[8:16] the HIGH u64 of the 128-bit key.
+    void final128(uint64_t* lo, uint64_t* hi) {
+        t += buflen;
+        std::memset(buf + buflen, 0, 128 - buflen);
+        compress(buf, true);
+        *lo = h[0];
+        *hi = h[1];
+    }
+};
+
+inline void blake2b_128(const uint8_t* data, size_t len, uint64_t* lo,
+                        uint64_t* hi) {
+    Blake2b b(16);
+    b.update(data, len);
+    b.final128(lo, hi);
+}
+
+// ------------------------------------------------------------- intern table
+//
+// Canonical row/value bytes -> stable u64 token (dense, from 1; 0 invalid).
+// Arena-chunked storage keeps pointers stable for the table's lifetime.
+// One coarse mutex: callers batch thousands of rows per call, so the lock
+// is taken once per batch, not per row.
+
+struct InternTable {
+    std::mutex mu;
+    std::vector<char*> chunks;
+    size_t chunk_used = 0;
+    static constexpr size_t CHUNK = 1 << 22;  // 4 MiB
+    std::unordered_map<std::string_view, uint64_t> map;
+    std::vector<std::pair<const char*, int64_t>> items;  // token-1 -> (ptr,len)
+
+    InternTable() { items.reserve(1024); }
+
+    ~InternTable() {
+        for (char* c : chunks) std::free(c);
+    }
+
+    const char* arena_put(const char* data, size_t len) {
+        if (chunks.empty() || chunk_used + len > CHUNK) {
+            size_t sz = len > CHUNK ? len : CHUNK;
+            chunks.push_back(static_cast<char*>(std::malloc(sz)));
+            chunk_used = 0;
+        }
+        char* dst = chunks.back() + chunk_used;
+        std::memcpy(dst, data, len);
+        chunk_used += len;
+        return dst;
+    }
+
+    // caller must hold mu
+    uint64_t intern_locked(const char* data, int64_t len) {
+        auto it = map.find(std::string_view(data, static_cast<size_t>(len)));
+        if (it != map.end()) return it->second;
+        const char* stored = arena_put(data, static_cast<size_t>(len));
+        uint64_t id = items.size() + 1;
+        items.emplace_back(stored, len);
+        map.emplace(std::string_view(stored, static_cast<size_t>(len)), id);
+        return id;
+    }
+
+    bool get(uint64_t id, const char** ptr, int64_t* len) {
+        if (id == 0 || id > items.size()) return false;
+        *ptr = items[id - 1].first;
+        *len = items[id - 1].second;
+        return true;
+    }
+};
+
+// ----------------------------------------------------------- piece helpers
+
+constexpr uint8_t TAG_NONE = 0x00, TAG_BOOL = 0x01, TAG_INT = 0x02,
+                  TAG_FLOAT = 0x03, TAG_STR = 0x04, TAG_BYTES = 0x05;
+
+inline void put_i64(std::string& out, int64_t v) {
+    char b[8];
+    std::memcpy(b, &v, 8);
+    out.append(b, 8);
+}
+
+inline void put_f64(std::string& out, double v) {
+    char b[8];
+    std::memcpy(b, &v, 8);
+    out.append(b, 8);
+}
+
+inline void piece_none(std::string& out) { out.push_back(static_cast<char>(TAG_NONE)); }
+inline void piece_bool(std::string& out, bool v) {
+    out.push_back(static_cast<char>(TAG_BOOL));
+    out.push_back(v ? '\x01' : '\x00');
+}
+inline void piece_int(std::string& out, int64_t v) {
+    out.push_back(static_cast<char>(TAG_INT));
+    put_i64(out, v);
+}
+inline void piece_float(std::string& out, double v) {
+    out.push_back(static_cast<char>(TAG_FLOAT));
+    put_f64(out, v);
+}
+inline void piece_str(std::string& out, const char* s, int64_t len) {
+    out.push_back(static_cast<char>(TAG_STR));
+    put_i64(out, len);
+    out.append(s, static_cast<size_t>(len));
+}
+
+// Walk one piece starting at p (within [p, end)); returns pointer past it,
+// or nullptr on malformed/unsupported data.
+inline const char* skip_piece(const char* p, const char* end) {
+    if (p >= end) return nullptr;
+    uint8_t tag = static_cast<uint8_t>(*p++);
+    switch (tag) {
+        case TAG_NONE: return p;
+        case TAG_BOOL: return p + 1 <= end ? p + 1 : nullptr;
+        case TAG_INT:
+        case TAG_FLOAT: return p + 8 <= end ? p + 8 : nullptr;
+        case TAG_STR:
+        case TAG_BYTES: {
+            if (p + 8 > end) return nullptr;
+            int64_t len;
+            std::memcpy(&len, p, 8);
+            p += 8;
+            if (len < 0 || p + len > end) return nullptr;
+            return p + len;
+        }
+        default: return nullptr;  // tuples/ndarrays etc. never enter the plane
+    }
+}
+
+// Locate the [start, end) byte range of each requested column piece in a
+// row. col_idx may be in any order (and repeat). Returns false on
+// malformed rows or out-of-range columns.
+inline bool find_cols(const char* row, int64_t row_len, const int64_t* col_idx,
+                      int64_t n_cols, const char** starts, const char** ends) {
+    int64_t max_want = -1;
+    for (int64_t j = 0; j < n_cols; ++j)
+        if (col_idx[j] > max_want) max_want = col_idx[j];
+    // one walk records every piece boundary up to the furthest column
+    const char* bounds[2 * 64];  // start/end interleaved; 64 cols is plenty
+    std::vector<const char*> big;
+    const char** bp = bounds;
+    if (max_want >= 64) {
+        big.resize(static_cast<size_t>(2 * (max_want + 1)));
+        bp = big.data();
+    }
+    const char* p = row;
+    const char* end = row + row_len;
+    for (int64_t c = 0; c <= max_want; ++c) {
+        const char* nxt = skip_piece(p, end);
+        if (nxt == nullptr) return false;
+        bp[2 * c] = p;
+        bp[2 * c + 1] = nxt;
+        p = nxt;
+    }
+    for (int64_t j = 0; j < n_cols; ++j) {
+        if (col_idx[j] < 0) return false;
+        starts[j] = bp[2 * col_idx[j]];
+        ends[j] = bp[2 * col_idx[j] + 1];
+    }
+    return true;
+}
+
+// Canonicalize one piece for shard routing (matches workers._canon +
+// _serialize_value): bool -> int, integral float -> int (folds -0.0 too).
+inline void canon_piece(std::string& out, const char* p, const char* end) {
+    uint8_t tag = static_cast<uint8_t>(*p);
+    if (tag == TAG_BOOL) {
+        piece_int(out, p[1] ? 1 : 0);
+        return;
+    }
+    if (tag == TAG_FLOAT) {
+        double v;
+        std::memcpy(&v, p + 1, 8);
+        // float.is_integer() && int(v) fits i64 -> canonical int form
+        if (v == static_cast<int64_t>(v) && v >= -9.223372036854776e18 &&
+            v < 9.223372036854776e18) {
+            piece_int(out, static_cast<int64_t>(v));
+            return;
+        }
+    }
+    out.append(p, static_cast<size_t>(end - p));
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- table api
+
+void* dp_tab_new() { return new InternTable(); }
+void dp_tab_free(void* h) { delete static_cast<InternTable*>(h); }
+int64_t dp_tab_len(void* h) {
+    return static_cast<int64_t>(static_cast<InternTable*>(h)->items.size());
+}
+
+uint64_t dp_tab_intern(void* h, const char* data, int64_t len) {
+    auto* tab = static_cast<InternTable*>(h);
+    std::lock_guard<std::mutex> g(tab->mu);
+    return tab->intern_locked(data, len);
+}
+
+// Bytes of a token; returns length, or -1 if unknown. *ptr stays valid for
+// the table's lifetime.
+int64_t dp_tab_get(void* h, uint64_t id, const char** ptr) {
+    auto* tab = static_cast<InternTable*>(h);
+    const char* p;
+    int64_t len;
+    if (!tab->get(id, &p, &len)) return -1;
+    *ptr = p;
+    return len;
+}
+
+// blake2b-128 of raw bytes (the key/hash primitive, bit-identical to
+// hashlib.blake2b(digest_size=16) + int.from_bytes(..., "little")).
+void dp_hash128(const char* data, int64_t len, uint64_t* lo, uint64_t* hi) {
+    blake2b_128(reinterpret_cast<const uint8_t*>(data), static_cast<size_t>(len),
+                lo, hi);
+}
+
+// ------------------------------------------------------------- json parsing
+
+namespace {
+
+struct JsonCursor {
+    const char* p;
+    const char* end;
+
+    void ws() {
+        while (p < end && (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n'))
+            ++p;
+    }
+    bool eat(char c) {
+        ws();
+        if (p < end && *p == c) {
+            ++p;
+            return true;
+        }
+        return false;
+    }
+};
+
+// Parse a JSON string (cursor at opening quote) into UTF-8 `out`.
+bool json_string(JsonCursor& c, std::string& out) {
+    if (!c.eat('"')) return false;
+    while (c.p < c.end) {
+        char ch = *c.p++;
+        if (ch == '"') return true;
+        if (ch == '\\') {
+            if (c.p >= c.end) return false;
+            char e = *c.p++;
+            switch (e) {
+                case '"': out.push_back('"'); break;
+                case '\\': out.push_back('\\'); break;
+                case '/': out.push_back('/'); break;
+                case 'b': out.push_back('\b'); break;
+                case 'f': out.push_back('\f'); break;
+                case 'n': out.push_back('\n'); break;
+                case 'r': out.push_back('\r'); break;
+                case 't': out.push_back('\t'); break;
+                case 'u': {
+                    auto hex4 = [&](uint32_t* v) -> bool {
+                        if (c.p + 4 > c.end) return false;
+                        uint32_t x = 0;
+                        for (int i = 0; i < 4; ++i) {
+                            char h = c.p[i];
+                            x <<= 4;
+                            if (h >= '0' && h <= '9') x |= h - '0';
+                            else if (h >= 'a' && h <= 'f') x |= h - 'a' + 10;
+                            else if (h >= 'A' && h <= 'F') x |= h - 'A' + 10;
+                            else return false;
+                        }
+                        c.p += 4;
+                        *v = x;
+                        return true;
+                    };
+                    uint32_t cp;
+                    if (!hex4(&cp)) return false;
+                    if (cp >= 0xD800 && cp <= 0xDBFF) {  // surrogate pair
+                        if (c.p + 2 <= c.end && c.p[0] == '\\' && c.p[1] == 'u') {
+                            c.p += 2;
+                            uint32_t lo2;
+                            if (!hex4(&lo2) || lo2 < 0xDC00 || lo2 > 0xDFFF)
+                                return false;
+                            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo2 - 0xDC00);
+                        }  // lone surrogate: keep as-is (Python would too)
+                    }
+                    // utf-8 encode
+                    if (cp < 0x80) {
+                        out.push_back(static_cast<char>(cp));
+                    } else if (cp < 0x800) {
+                        out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+                        out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+                    } else if (cp < 0x10000) {
+                        if (cp >= 0xD800 && cp <= 0xDFFF) return false;  // lone
+                        out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+                        out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+                        out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+                    } else {
+                        out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+                        out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+                        out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+                        out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+                    }
+                    break;
+                }
+                default: return false;
+            }
+        } else {
+            out.push_back(ch);
+        }
+    }
+    return false;  // unterminated
+}
+
+// Skip any JSON value (for fields not in the schema). Returns false on
+// malformed input.
+bool json_skip(JsonCursor& c) {
+    c.ws();
+    if (c.p >= c.end) return false;
+    char ch = *c.p;
+    if (ch == '"') {
+        std::string sink;
+        return json_string(c, sink);
+    }
+    if (ch == '{' || ch == '[') {
+        char close = ch == '{' ? '}' : ']';
+        ++c.p;
+        c.ws();
+        if (c.p < c.end && *c.p == close) {
+            ++c.p;
+            return true;
+        }
+        while (true) {
+            if (ch == '{') {
+                std::string sink;
+                if (!json_string(c, sink)) return false;
+                if (!c.eat(':')) return false;
+            }
+            if (!json_skip(c)) return false;
+            c.ws();
+            if (c.p >= c.end) return false;
+            if (*c.p == ',') {
+                ++c.p;
+                c.ws();
+                continue;
+            }
+            if (*c.p == close) {
+                ++c.p;
+                return true;
+            }
+            return false;
+        }
+    }
+    // literal: true/false/null/number
+    if (c.end - c.p >= 4 && std::memcmp(c.p, "true", 4) == 0) { c.p += 4; return true; }
+    if (c.end - c.p >= 5 && std::memcmp(c.p, "false", 5) == 0) { c.p += 5; return true; }
+    if (c.end - c.p >= 4 && std::memcmp(c.p, "null", 4) == 0) { c.p += 4; return true; }
+    const char* start = c.p;
+    while (c.p < c.end && (std::strchr("+-0123456789.eE", *c.p) != nullptr)) ++c.p;
+    return c.p > start;
+}
+
+// Parse a scalar JSON value into a canonical piece. Containers / anomalies
+// return false (the caller falls back to Python for the whole line).
+bool json_value_piece(JsonCursor& c, std::string& piece) {
+    c.ws();
+    if (c.p >= c.end) return false;
+    char ch = *c.p;
+    if (ch == '"') {
+        std::string s;
+        if (!json_string(c, s)) return false;
+        piece_str(piece, s.data(), static_cast<int64_t>(s.size()));
+        return true;
+    }
+    if (ch == '{' || ch == '[') return false;  // Json dtype -> Python path
+    if (c.end - c.p >= 4 && std::memcmp(c.p, "true", 4) == 0) {
+        c.p += 4;
+        piece_bool(piece, true);
+        return true;
+    }
+    if (c.end - c.p >= 5 && std::memcmp(c.p, "false", 5) == 0) {
+        c.p += 5;
+        piece_bool(piece, false);
+        return true;
+    }
+    if (c.end - c.p >= 4 && std::memcmp(c.p, "null", 4) == 0) {
+        c.p += 4;
+        piece_none(piece);
+        return true;
+    }
+    // number — int unless '.', 'e', 'E' present (json.loads semantics)
+    const char* start = c.p;
+    bool is_float = false;
+    while (c.p < c.end && std::strchr("+-0123456789.eE", *c.p) != nullptr) {
+        if (*c.p == '.' || *c.p == 'e' || *c.p == 'E') is_float = true;
+        ++c.p;
+    }
+    if (c.p == start) return false;
+    std::string tok(start, static_cast<size_t>(c.p - start));
+    if (is_float) {
+        char* endp = nullptr;
+        double v = std::strtod(tok.c_str(), &endp);
+        if (endp != tok.c_str() + tok.size()) return false;
+        piece_float(piece, v);
+    } else {
+        errno = 0;
+        char* endp = nullptr;
+        long long v = std::strtoll(tok.c_str(), &endp, 10);
+        if (errno == ERANGE || endp != tok.c_str() + tok.size())
+            return false;  // bigint -> Python path
+        piece_int(piece, static_cast<int64_t>(v));
+    }
+    return true;
+}
+
+constexpr uint64_t SEQ_SALT_LO = 0xF39CC0605CEDC834ull;
+constexpr uint64_t SEQ_SALT_HI = 0x9E3779B97F4A7C15ull;
+
+// Row finalization shared by json/csv ingest: intern + key.
+inline void finish_row(InternTable* tab, const std::string& row_bytes,
+                       const std::string* pieces, const int64_t* pk_idx,
+                       int64_t n_pk, uint64_t seq_base, uint64_t seq_no,
+                       uint64_t* out_token, uint64_t* out_lo, uint64_t* out_hi) {
+    *out_token = tab->intern_locked(row_bytes.data(),
+                                    static_cast<int64_t>(row_bytes.size()));
+    if (n_pk > 0) {
+        std::string kb;
+        for (int64_t j = 0; j < n_pk; ++j) kb += pieces[pk_idx[j]];
+        blake2b_128(reinterpret_cast<const uint8_t*>(kb.data()), kb.size(),
+                    out_lo, out_hi);
+    } else {
+        // sequential_key: blake2b(pack("<QQ", base, n) + SALT_16LE)
+        uint8_t kb[32];
+        std::memcpy(kb, &seq_base, 8);
+        std::memcpy(kb + 8, &seq_no, 8);
+        std::memcpy(kb + 16, &SEQ_SALT_LO, 8);
+        std::memcpy(kb + 24, &SEQ_SALT_HI, 8);
+        blake2b_128(kb, 32, out_lo, out_hi);
+    }
+}
+
+}  // namespace
+
+// Parse a chunk of JSON-lines into interned rows.
+//
+// col_names/col_name_lens: schema column names (utf8), n_cols of them.
+// pk_idx/n_pk: primary-key column indices (empty -> sequential keys from
+// (seq_base, seq_start + line_no)).
+// Outputs per line i (cap = max lines): status[i] 0=ok 1=python-fallback
+// 2=blank (skip); line_start/line_end for fallback reparses; token/key
+// valid when status==0. Returns number of lines seen (<= cap assumed:
+// caller sizes cap by newline count + 1).
+int64_t dp_ingest_jsonl(void* h, const char* data, int64_t len, int64_t n_cols,
+                        const char** col_names, const int64_t* col_name_lens,
+                        const int64_t* pk_idx, int64_t n_pk, uint64_t seq_base,
+                        uint64_t seq_start, uint64_t* out_token,
+                        uint64_t* out_lo, uint64_t* out_hi, uint8_t* out_status,
+                        int64_t* line_start, int64_t* line_end, int64_t cap) {
+    auto* tab = static_cast<InternTable*>(h);
+    std::lock_guard<std::mutex> g(tab->mu);
+    std::vector<std::string> pieces(static_cast<size_t>(n_cols));
+    std::vector<uint8_t> have(static_cast<size_t>(n_cols));
+    std::string row_bytes, name;
+    int64_t n_lines = 0;
+    const char* p = data;
+    const char* end = data + len;
+    while (p < end && n_lines < cap) {
+        const char* ls = p;
+        const char* le = static_cast<const char*>(std::memchr(p, '\n', end - p));
+        const char* nxt = le == nullptr ? end : le + 1;
+        if (le == nullptr) le = end;
+        if (le > ls && le[-1] == '\r') --le;
+        int64_t i = n_lines++;
+        line_start[i] = ls - data;
+        line_end[i] = le - data;
+        p = nxt;
+        // blank line -> skip
+        const char* q = ls;
+        while (q < le && (*q == ' ' || *q == '\t')) ++q;
+        if (q == le) {
+            out_status[i] = 2;
+            continue;
+        }
+        JsonCursor c{ls, le};
+        std::fill(have.begin(), have.end(), 0);
+        for (auto& s : pieces) s.clear();
+        bool ok = c.eat('{');
+        if (ok) {
+            c.ws();
+            if (c.p < c.end && *c.p == '}') {
+                ++c.p;
+            } else {
+                while (ok) {
+                    name.clear();
+                    if (!json_string(c, name) || !c.eat(':')) {
+                        ok = false;
+                        break;
+                    }
+                    int64_t col = -1;
+                    for (int64_t j = 0; j < n_cols; ++j) {
+                        if (col_name_lens[j] ==
+                                static_cast<int64_t>(name.size()) &&
+                            std::memcmp(col_names[j], name.data(),
+                                        name.size()) == 0) {
+                            col = j;
+                            break;
+                        }
+                    }
+                    if (col >= 0) {
+                        pieces[col].clear();
+                        if (!json_value_piece(c, pieces[col])) {
+                            ok = false;
+                            break;
+                        }
+                        have[col] = 1;
+                    } else if (!json_skip(c)) {
+                        ok = false;
+                        break;
+                    }
+                    c.ws();
+                    if (c.p < c.end && *c.p == ',') {
+                        ++c.p;
+                        continue;
+                    }
+                    if (c.p < c.end && *c.p == '}') {
+                        ++c.p;
+                        break;
+                    }
+                    ok = false;
+                }
+            }
+        }
+        if (ok) {
+            c.ws();
+            if (c.p != c.end) ok = false;  // trailing junk
+        }
+        if (!ok) {
+            out_status[i] = 1;
+            continue;
+        }
+        row_bytes.clear();
+        for (int64_t j = 0; j < n_cols; ++j) {
+            if (!have[j]) piece_none(pieces[j]);  // missing -> None
+            row_bytes += pieces[j];
+        }
+        finish_row(tab, row_bytes, pieces.data(), pk_idx, n_pk, seq_base,
+                   seq_start + static_cast<uint64_t>(i), &out_token[i],
+                   &out_lo[i], &out_hi[i]);
+        out_status[i] = 0;
+    }
+    return n_lines;
+}
+
+// -------------------------------------------------------------- csv ingest
+
+// Parse CSV records (no header; caller maps schema col -> field index via
+// field_idx, -1 = missing). dtypes per schema col: 2=int 3=float 1=bool
+// 4=str (json/any -> caller must not use native). opt[j]=1 allows None for
+// empty fields. Quoting is RFC-4180. Same outputs as dp_ingest_jsonl.
+int64_t dp_ingest_csv(void* h, const char* data, int64_t len, char delim,
+                      int64_t n_cols, const int64_t* field_idx,
+                      const uint8_t* dtypes, const uint8_t* opt,
+                      const int64_t* pk_idx, int64_t n_pk, uint64_t seq_base,
+                      uint64_t seq_start, uint64_t* out_token, uint64_t* out_lo,
+                      uint64_t* out_hi, uint8_t* out_status,
+                      int64_t* line_start, int64_t* line_end, int64_t cap) {
+    auto* tab = static_cast<InternTable*>(h);
+    std::lock_guard<std::mutex> g(tab->mu);
+    std::vector<std::string> fields;
+    std::vector<std::string> pieces(static_cast<size_t>(n_cols));
+    std::string row_bytes;
+    int64_t n_rec = 0;
+    const char* p = data;
+    const char* end = data + len;
+    while (p < end && n_rec < cap) {
+        // find record end (newline outside quotes)
+        const char* rs = p;
+        bool in_q = false;
+        const char* re = p;
+        while (re < end) {
+            char ch = *re;
+            if (ch == '"') {
+                if (in_q && re + 1 < end && re[1] == '"') ++re;
+                else in_q = !in_q;
+            } else if (ch == '\n' && !in_q) {
+                break;
+            }
+            ++re;
+        }
+        const char* nxt = re < end ? re + 1 : end;
+        if (re > rs && re[-1] == '\r') --re;
+        int64_t i = n_rec++;
+        line_start[i] = rs - data;
+        line_end[i] = re - data;
+        p = nxt;
+        if (re == rs) {
+            out_status[i] = 2;  // blank
+            continue;
+        }
+        // split fields
+        fields.clear();
+        const char* f = rs;
+        while (true) {
+            std::string val;
+            if (f < re && *f == '"') {
+                ++f;
+                while (f < re) {
+                    if (*f == '"') {
+                        if (f + 1 < re && f[1] == '"') {
+                            val.push_back('"');
+                            f += 2;
+                        } else {
+                            ++f;
+                            break;
+                        }
+                    } else {
+                        val.push_back(*f++);
+                    }
+                }
+                // junk after closing quote concatenates (csv-module style)
+                while (f < re && *f != delim) val.push_back(*f++);
+            } else {
+                while (f < re && *f != delim) val.push_back(*f++);
+            }
+            fields.push_back(std::move(val));
+            if (f >= re) break;
+            ++f;  // skip delim
+            if (f == re) {
+                fields.emplace_back();
+                break;
+            }
+        }
+        bool ok = true;
+        for (int64_t j = 0; j < n_cols && ok; ++j) {
+            pieces[j].clear();
+            int64_t fi = field_idx[j];
+            if (fi < 0 || fi >= static_cast<int64_t>(fields.size())) {
+                piece_none(pieces[j]);
+                continue;
+            }
+            const std::string& v = fields[static_cast<size_t>(fi)];
+            uint8_t dt = dtypes[j];
+            if (v.empty() && opt[j]) {
+                piece_none(pieces[j]);
+                continue;
+            }
+            switch (dt) {
+                case 2: {  // int(value): sign + digits, tolerate spaces
+                    size_t a = 0, b = v.size();
+                    while (a < b && v[a] == ' ') ++a;
+                    while (b > a && v[b - 1] == ' ') --b;
+                    size_t d = a;
+                    if (d < b && (v[d] == '+' || v[d] == '-')) ++d;
+                    bool digits = d < b;
+                    for (size_t k = d; k < b; ++k)
+                        if (v[k] < '0' || v[k] > '9') { digits = false; break; }
+                    if (!digits) {
+                        // Python _coerce falls back to the raw string (or
+                        // None when Optional)
+                        if (opt[j]) piece_none(pieces[j]);
+                        else piece_str(pieces[j], v.data(),
+                                       static_cast<int64_t>(v.size()));
+                        break;
+                    }
+                    errno = 0;
+                    char* endp = nullptr;
+                    std::string tok = v.substr(a, b - a);
+                    long long x = std::strtoll(tok.c_str(), &endp, 10);
+                    if (errno == ERANGE || endp != tok.c_str() + tok.size()) {
+                        ok = false;  // bigint etc -> Python line
+                        break;
+                    }
+                    piece_int(pieces[j], x);
+                    break;
+                }
+                case 3: {  // float(value)
+                    if (v.find('_') != std::string::npos) { ok = false; break; }
+                    char* endp = nullptr;
+                    std::string tok = v;
+                    // trim spaces (Python float() allows them)
+                    size_t a = tok.find_first_not_of(" \t");
+                    size_t b = tok.find_last_not_of(" \t");
+                    if (a == std::string::npos) {
+                        if (opt[j]) { piece_none(pieces[j]); break; }
+                        piece_str(pieces[j], v.data(),
+                                  static_cast<int64_t>(v.size()));
+                        break;
+                    }
+                    tok = tok.substr(a, b - a + 1);
+                    double x = std::strtod(tok.c_str(), &endp);
+                    if (endp != tok.c_str() + tok.size()) {
+                        if (opt[j]) piece_none(pieces[j]);
+                        else piece_str(pieces[j], v.data(),
+                                       static_cast<int64_t>(v.size()));
+                        break;
+                    }
+                    piece_float(pieces[j], x);
+                    break;
+                }
+                case 1: {  // bool: strip().lower() in (true,1,yes,on)
+                    std::string s;
+                    for (char ch : v)
+                        if (ch != ' ' && ch != '\t')
+                            s.push_back(static_cast<char>(
+                                ch >= 'A' && ch <= 'Z' ? ch + 32 : ch));
+                    bool tv = s == "true" || s == "1" || s == "yes" || s == "on";
+                    piece_bool(pieces[j], tv);
+                    break;
+                }
+                default:  // str
+                    piece_str(pieces[j], v.data(), static_cast<int64_t>(v.size()));
+            }
+        }
+        if (!ok) {
+            out_status[i] = 1;
+            continue;
+        }
+        row_bytes.clear();
+        for (int64_t j = 0; j < n_cols; ++j) row_bytes += pieces[j];
+        finish_row(tab, row_bytes, pieces.data(), pk_idx, n_pk, seq_base,
+                   seq_start + static_cast<uint64_t>(i), &out_token[i],
+                   &out_lo[i], &out_hi[i]);
+        out_status[i] = 0;
+    }
+    return n_rec;
+}
+
+// ------------------------------------------------------------ decode / agg
+
+// Decode numeric columns into the zs_agg value layout: per (col j, row i)
+// tags[j*n+i]: 0 = int64 (vals_i), 1 = double (vals_f), 2 = other
+// (None / str / malformed -> the aggregation error bucket). Bools decode
+// as ints (Python arithmetic semantics). Returns 0, or -1-row_index of the
+// first malformed row.
+int64_t dp_decode_num_cols(void* h, int64_t n, const uint64_t* tokens,
+                           const int64_t* col_idx, int64_t n_cols,
+                           int64_t* vals_i, double* vals_f, uint8_t* tags) {
+    auto* tab = static_cast<InternTable*>(h);
+    std::vector<const char*> starts(static_cast<size_t>(n_cols));
+    std::vector<const char*> ends(static_cast<size_t>(n_cols));
+    for (int64_t i = 0; i < n; ++i) {
+        const char* row;
+        int64_t rlen;
+        if (!tab->get(tokens[i], &row, &rlen) ||
+            !find_cols(row, rlen, col_idx, n_cols, starts.data(), ends.data()))
+            return -1 - i;
+        for (int64_t j = 0; j < n_cols; ++j) {
+            const char* p = starts[j];
+            uint8_t tag = static_cast<uint8_t>(*p);
+            int64_t o = j * n + i;
+            if (tag == TAG_INT) {
+                std::memcpy(&vals_i[o], p + 1, 8);
+                tags[o] = 0;
+            } else if (tag == TAG_FLOAT) {
+                std::memcpy(&vals_f[o], p + 1, 8);
+                tags[o] = 1;
+            } else if (tag == TAG_BOOL) {
+                vals_i[o] = p[1] ? 1 : 0;
+                tags[o] = 0;
+            } else {
+                tags[o] = 2;
+            }
+        }
+    }
+    return 0;
+}
+
+// Decode string columns: offsets into a caller buffer. For col j, row i:
+// kind[j*n+i] = 0 str (buf[off..off+len)), 1 None, 2 non-string.
+// Returns bytes used, or -needed when cap is too small.
+int64_t dp_decode_str_cols(void* h, int64_t n, const uint64_t* tokens,
+                           const int64_t* col_idx, int64_t n_cols, char* buf,
+                           int64_t cap, int64_t* off, int64_t* slen,
+                           uint8_t* kind) {
+    auto* tab = static_cast<InternTable*>(h);
+    std::vector<const char*> starts(static_cast<size_t>(n_cols));
+    std::vector<const char*> ends(static_cast<size_t>(n_cols));
+    int64_t used = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        const char* row;
+        int64_t rlen;
+        if (!tab->get(tokens[i], &row, &rlen) ||
+            !find_cols(row, rlen, col_idx, n_cols, starts.data(), ends.data()))
+            return INT64_MIN;  // malformed: caller falls back wholesale
+        for (int64_t j = 0; j < n_cols; ++j) {
+            const char* p = starts[j];
+            uint8_t tag = static_cast<uint8_t>(*p);
+            int64_t o = j * n + i;
+            if (tag == TAG_STR) {
+                int64_t L;
+                std::memcpy(&L, p + 1, 8);
+                if (used + L <= cap) {
+                    std::memcpy(buf + used, p + 9, static_cast<size_t>(L));
+                    off[o] = used;
+                    slen[o] = L;
+                    kind[o] = 0;
+                }
+                used += L;
+            } else if (tag == TAG_NONE) {
+                kind[o] = 1;
+                off[o] = slen[o] = 0;
+            } else {
+                kind[o] = 2;
+                off[o] = slen[o] = 0;
+            }
+        }
+    }
+    return used <= cap ? used : -used;
+}
+
+// --------------------------------------------------- group project + route
+
+// For each row: project columns col_idx -> group bytes; gtoken = intern of
+// the group bytes (group identity — matches Python freeze_value(tuple)
+// because column dtypes are stable within a native pipeline); shard =
+// blake2b(canonical tuple serialization)[0:8] % n_shards when n_shards>0
+// (must stay byte-identical to workers._shard_of). Returns 0 or -1-i on
+// malformed row i.
+int64_t dp_project_group(void* h, int64_t n, const uint64_t* tokens,
+                         const int64_t* col_idx, int64_t n_cols,
+                         int64_t n_shards, uint64_t* out_gtoken,
+                         int64_t* out_shard) {
+    auto* tab = static_cast<InternTable*>(h);
+    std::lock_guard<std::mutex> g(tab->mu);
+    std::vector<const char*> starts(static_cast<size_t>(n_cols));
+    std::vector<const char*> ends(static_cast<size_t>(n_cols));
+    std::string gbytes, canon;
+    // per-gtoken shard memo (groups repeat heavily within a batch)
+    std::unordered_map<uint64_t, int64_t> shard_memo;
+    for (int64_t i = 0; i < n; ++i) {
+        const char* row;
+        int64_t rlen;
+        if (!tab->get(tokens[i], &row, &rlen) ||
+            !find_cols(row, rlen, col_idx, n_cols, starts.data(), ends.data()))
+            return -1 - i;
+        gbytes.clear();
+        for (int64_t j = 0; j < n_cols; ++j)
+            gbytes.append(starts[j], static_cast<size_t>(ends[j] - starts[j]));
+        uint64_t gt = tab->intern_locked(gbytes.data(),
+                                         static_cast<int64_t>(gbytes.size()));
+        out_gtoken[i] = gt;
+        if (n_shards > 0) {
+            auto it = shard_memo.find(gt);
+            if (it != shard_memo.end()) {
+                out_shard[i] = it->second;
+            } else {
+                // serialize the canonicalized VALUE TUPLE: \x07 + len + pieces
+                canon.clear();
+                canon.push_back('\x07');
+                put_i64(canon, n_cols);
+                for (int64_t j = 0; j < n_cols; ++j)
+                    canon_piece(canon, starts[j], ends[j]);
+                uint64_t lo, hi;
+                blake2b_128(reinterpret_cast<const uint8_t*>(canon.data()),
+                            canon.size(), &lo, &hi);
+                int64_t s = static_cast<int64_t>(lo % static_cast<uint64_t>(n_shards));
+                shard_memo.emplace(gt, s);
+                out_shard[i] = s;
+            }
+        }
+    }
+    return 0;
+}
+
+// Shard by record key: key128 % n (identical to Python `key.value % n`).
+void dp_route_key(int64_t n, const uint64_t* key_lo, const uint64_t* key_hi,
+                  int64_t n_shards, int64_t* out_shard) {
+    uint64_t m = static_cast<uint64_t>(n_shards);
+    // 2^64 mod m without 128-bit literals: (2^64 - 1) % m + 1 (mod m)
+    uint64_t r64 = (UINT64_MAX % m + 1) % m;
+    for (int64_t i = 0; i < n; ++i) {
+        out_shard[i] = static_cast<int64_t>(
+            ((key_hi[i] % m) * r64 + key_lo[i] % m) % m);
+    }
+}
+
+// ---------------------------------------------------------------- build rows
+
+// Assemble new rows (select/map output). Output column j comes from:
+//   src_kind[j] == 0 -> passthrough of input column src_col[j]
+//   src_kind[j] == 1 -> computed from value slot s = src_col[j]:
+//                       vtag[s*n+i] 0=int(vals_i) 1=float(vals_f)
+//                       2=None 3=bool(vals_i) 255=python-fallback row
+// status[i]: 0 ok, 1 fallback (any col with vtag 255 or malformed input).
+// Returns 0, or -1 on bad args.
+int64_t dp_build_rows(void* h, int64_t n, const uint64_t* in_tokens,
+                      int64_t n_out, const int64_t* src_kind,
+                      const int64_t* src_col, const int64_t* vals_i,
+                      const double* vals_f, const uint8_t* vtag,
+                      uint64_t* out_token, uint8_t* out_status) {
+    auto* tab = static_cast<InternTable*>(h);
+    std::lock_guard<std::mutex> g(tab->mu);
+    // passthrough columns, ascending for find_cols
+    std::vector<int64_t> pass_cols;
+    for (int64_t j = 0; j < n_out; ++j)
+        if (src_kind[j] == 0) pass_cols.push_back(src_col[j]);
+    std::vector<int64_t> sorted_cols(pass_cols);
+    std::sort(sorted_cols.begin(), sorted_cols.end());
+    sorted_cols.erase(std::unique(sorted_cols.begin(), sorted_cols.end()),
+                      sorted_cols.end());
+    std::unordered_map<int64_t, int64_t> col_slot;
+    for (size_t k = 0; k < sorted_cols.size(); ++k)
+        col_slot[sorted_cols[k]] = static_cast<int64_t>(k);
+    std::vector<const char*> starts(sorted_cols.size());
+    std::vector<const char*> ends(sorted_cols.size());
+    std::string row_bytes;
+    for (int64_t i = 0; i < n; ++i) {
+        bool ok = true;
+        if (!sorted_cols.empty()) {
+            const char* row;
+            int64_t rlen;
+            if (!tab->get(in_tokens[i], &row, &rlen) ||
+                !find_cols(row, rlen, sorted_cols.data(),
+                           static_cast<int64_t>(sorted_cols.size()),
+                           starts.data(), ends.data()))
+                ok = false;
+        }
+        row_bytes.clear();
+        for (int64_t j = 0; j < n_out && ok; ++j) {
+            if (src_kind[j] == 0) {
+                int64_t slot = col_slot[src_col[j]];
+                row_bytes.append(starts[static_cast<size_t>(slot)],
+                                 static_cast<size_t>(
+                                     ends[static_cast<size_t>(slot)] -
+                                     starts[static_cast<size_t>(slot)]));
+            } else {
+                int64_t o = src_col[j] * n + i;
+                switch (vtag[o]) {
+                    case 0: piece_int(row_bytes, vals_i[o]); break;
+                    case 1: piece_float(row_bytes, vals_f[o]); break;
+                    case 2: piece_none(row_bytes); break;
+                    case 3: piece_bool(row_bytes, vals_i[o] != 0); break;
+                    default: ok = false;
+                }
+            }
+        }
+        if (!ok) {
+            out_status[i] = 1;
+            out_token[i] = 0;
+            continue;
+        }
+        out_token[i] = tab->intern_locked(
+            row_bytes.data(), static_cast<int64_t>(row_bytes.size()));
+        out_status[i] = 0;
+    }
+    return 0;
+}
+
+// ---------------------------------------------------------------- formatting
+
+namespace {
+
+// Python-repr-compatible float formatting: shortest round-trip via
+// to_chars, then ".0" appended for integral values (repr(5.0) == "5.0").
+inline void format_double(std::string& out, double v) {
+    char buf[40];
+    auto r = std::to_chars(buf, buf + sizeof(buf), v);
+    bool plain = true;
+    for (char* q = buf; q < r.ptr; ++q)
+        if (*q == '.' || *q == 'e' || *q == 'n' || *q == 'i') {
+            plain = false;  // has '.', exponent, nan or inf
+            break;
+        }
+    out.append(buf, r.ptr);
+    if (plain) out.append(".0");
+}
+
+// csv.writer QUOTE_MINIMAL: quote when the field contains the delimiter,
+// the quote char, \r or \n.
+inline void csv_field(std::string& out, const char* s, int64_t len,
+                      char delim) {
+    bool need = false;
+    for (int64_t k = 0; k < len; ++k) {
+        char c = s[k];
+        if (c == delim || c == '"' || c == '\r' || c == '\n') {
+            need = true;
+            break;
+        }
+    }
+    if (!need) {
+        out.append(s, static_cast<size_t>(len));
+        return;
+    }
+    out.push_back('"');
+    for (int64_t k = 0; k < len; ++k) {
+        if (s[k] == '"') out.push_back('"');
+        out.push_back(s[k]);
+    }
+    out.push_back('"');
+}
+
+}  // namespace
+
+// Format rows as CSV lines `col,...,time,diff\r\n` (the engine csv writer's
+// shape, matching Python csv.writer QUOTE_MINIMAL + str() value forms).
+// Rows with unsupported tags (bytes etc.) are skipped and their indices
+// written to fallback_idx (caller formats those via Python). Output is
+// appended into `out` up to cap; returns bytes written, or -needed if cap
+// too small (caller retries with a bigger buffer; the fallback list is
+// only valid on success). n_fallback is in/out.
+int64_t dp_format_csv(void* h, int64_t n, const uint64_t* tokens,
+                      const int64_t* diffs, int64_t time, char delim,
+                      char* out, int64_t cap, int64_t* fallback_idx,
+                      int64_t* n_fallback) {
+    auto* tab = static_cast<InternTable*>(h);
+    std::string line;
+    int64_t used = 0;
+    int64_t nfb = 0;
+    char numbuf[32];
+    for (int64_t i = 0; i < n; ++i) {
+        const char* row;
+        int64_t rlen;
+        if (!tab->get(tokens[i], &row, &rlen)) {
+            fallback_idx[nfb++] = i;
+            continue;
+        }
+        line.clear();
+        const char* p = row;
+        const char* end = row + rlen;
+        bool ok = true;
+        bool first = true;
+        while (p < end) {
+            if (!first) line.push_back(delim);
+            first = false;
+            uint8_t tag = static_cast<uint8_t>(*p);
+            const char* nx = skip_piece(p, end);
+            if (nx == nullptr) {
+                ok = false;
+                break;
+            }
+            switch (tag) {
+                case TAG_NONE: break;  // empty field
+                case TAG_BOOL: line.append(p[1] ? "True" : "False"); break;
+                case TAG_INT: {
+                    int64_t v;
+                    std::memcpy(&v, p + 1, 8);
+                    auto r = std::to_chars(numbuf, numbuf + sizeof(numbuf), v);
+                    line.append(numbuf, r.ptr);
+                    break;
+                }
+                case TAG_FLOAT: {
+                    double v;
+                    std::memcpy(&v, p + 1, 8);
+                    std::string fv;
+                    format_double(fv, v);
+                    csv_field(line, fv.data(), static_cast<int64_t>(fv.size()),
+                              delim);
+                    break;
+                }
+                case TAG_STR: {
+                    int64_t L;
+                    std::memcpy(&L, p + 1, 8);
+                    csv_field(line, p + 9, L, delim);
+                    break;
+                }
+                default: ok = false;  // bytes -> Python str(b'..') form
+            }
+            if (!ok) break;
+            p = nx;
+        }
+        if (!ok) {
+            fallback_idx[nfb++] = i;
+            continue;
+        }
+        line.push_back(delim);
+        auto r = std::to_chars(numbuf, numbuf + sizeof(numbuf), time);
+        line.append(numbuf, r.ptr);
+        line.push_back(delim);
+        r = std::to_chars(numbuf, numbuf + sizeof(numbuf), diffs[i]);
+        line.append(numbuf, r.ptr);
+        line.append("\r\n");
+        if (used + static_cast<int64_t>(line.size()) <= cap)
+            std::memcpy(out + used, line.data(), line.size());
+        used += static_cast<int64_t>(line.size());
+    }
+    *n_fallback = nfb;
+    return used <= cap ? used : -used;
+}
+
+// ------------------------------------------------------------- consolidation
+
+// Fast ingest-shape check: 1 when all diffs are +1 and keys are pairwise
+// distinct (the batch is already consolidated), else 0.
+int64_t dp_distinct_check(int64_t n, const uint64_t* key_lo,
+                          const uint64_t* key_hi, const int64_t* diff) {
+    struct K {
+        uint64_t lo, hi;
+        bool operator==(const K& o) const { return lo == o.lo && hi == o.hi; }
+    };
+    struct KH {
+        size_t operator()(const K& k) const {
+            uint64_t x = k.lo ^ (k.hi * 0x9E3779B97F4A7C15ull);
+            x ^= x >> 33;
+            x *= 0xFF51AFD7ED558CCDull;
+            x ^= x >> 33;
+            return static_cast<size_t>(x);
+        }
+    };
+    std::unordered_map<K, char, KH> seen;
+    seen.reserve(static_cast<size_t>(n));
+    for (int64_t i = 0; i < n; ++i) {
+        if (diff[i] != 1) return 0;
+        if (!seen.emplace(K{key_lo[i], key_hi[i]}, 1).second) return 0;
+    }
+    return 1;
+}
+
+// Order-stable consolidation on (key, token): sums diffs, keeps first-
+// appearance order, drops zeros. In-place; returns the new length.
+int64_t dp_consolidate(int64_t n, uint64_t* key_lo, uint64_t* key_hi,
+                       uint64_t* token, int64_t* diff) {
+    struct K {
+        uint64_t lo, hi, tok;
+        bool operator==(const K& o) const {
+            return lo == o.lo && hi == o.hi && tok == o.tok;
+        }
+    };
+    struct KH {
+        size_t operator()(const K& k) const {
+            uint64_t x = k.lo ^ (k.hi * 0x9E3779B97F4A7C15ull) ^
+                         (k.tok * 0xBF58476D1CE4E5B9ull);
+            x ^= x >> 33;
+            x *= 0xFF51AFD7ED558CCDull;
+            x ^= x >> 33;
+            return static_cast<size_t>(x);
+        }
+    };
+    std::unordered_map<K, int64_t, KH> slot;  // -> first index in output
+    slot.reserve(static_cast<size_t>(n));
+    int64_t m = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        K k{key_lo[i], key_hi[i], token[i]};
+        auto it = slot.find(k);
+        if (it == slot.end()) {
+            key_lo[m] = key_lo[i];
+            key_hi[m] = key_hi[i];
+            token[m] = token[i];
+            diff[m] = diff[i];
+            slot.emplace(k, m);
+            ++m;
+        } else {
+            diff[it->second] += diff[i];
+        }
+    }
+    // drop zeros, preserving order (stable compaction; slots shift left)
+    int64_t w = 0;
+    for (int64_t i = 0; i < m; ++i) {
+        if (diff[i] == 0) continue;
+        if (w != i) {
+            key_lo[w] = key_lo[i];
+            key_hi[w] = key_hi[i];
+            token[w] = token[i];
+            diff[w] = diff[i];
+        }
+        ++w;
+    }
+    return w;
+}
+
+// ------------------------------------------------------------ wire transport
+
+// Export the unique row bytes of a token array for cross-process shipping:
+// writes, per unique token (in first-appearance order), its byte length to
+// ulen, and the bytes to blob; remaps tokens[] in place to LOCAL dense ids
+// 0..n_unique-1 (indices into the export list). Returns n_unique, or
+// -needed when blob cap is too small.
+int64_t dp_export_tokens(void* h, int64_t n, uint64_t* tokens, char* blob,
+                         int64_t blob_cap, int64_t* ulen, int64_t ulen_cap) {
+    auto* tab = static_cast<InternTable*>(h);
+    std::unordered_map<uint64_t, int64_t> local;
+    local.reserve(static_cast<size_t>(n));
+    int64_t used = 0;
+    int64_t n_u = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        auto it = local.find(tokens[i]);
+        if (it == local.end()) {
+            const char* p;
+            int64_t len;
+            if (!tab->get(tokens[i], &p, &len)) return INT64_MIN;
+            if (used + len <= blob_cap) std::memcpy(blob + used, p, len);
+            used += len;
+            if (n_u < ulen_cap) ulen[n_u] = len;
+            it = local.emplace(tokens[i], n_u++).first;
+        }
+        tokens[i] = static_cast<uint64_t>(it->second);
+    }
+    return (used <= blob_cap && n_u <= ulen_cap) ? n_u : -used;
+}
+
+// Import: intern each blob row (offsets implied by ulen), then map local
+// ids in tokens[] back to this process's intern ids.
+int64_t dp_import_tokens(void* h, int64_t n, uint64_t* tokens,
+                         const char* blob, const int64_t* ulen, int64_t n_u) {
+    auto* tab = static_cast<InternTable*>(h);
+    std::lock_guard<std::mutex> g(tab->mu);
+    std::vector<uint64_t> ids(static_cast<size_t>(n_u));
+    int64_t off = 0;
+    for (int64_t u = 0; u < n_u; ++u) {
+        ids[static_cast<size_t>(u)] = tab->intern_locked(blob + off, ulen[u]);
+        off += ulen[u];
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        if (tokens[i] >= static_cast<uint64_t>(n_u)) return -1;
+        tokens[i] = ids[static_cast<size_t>(tokens[i])];
+    }
+    return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,626 @@
+"""ctypes loader + Python surface for the native data plane (dataplane.cpp).
+
+The data plane keeps production rows token-resident: a `NativeBatch` is
+four flat numpy arrays (key_lo, key_hi, token, diff) plus an `InternTable`
+holding each distinct row's canonical bytes (the exact byte format of
+`internals.keys._serialize_value`, so keys hashed here are bit-identical
+to Python's). Engine nodes that understand batches never touch Python
+objects per row; `materialize()` decodes rows only at true Python
+boundaries (UDFs, captures, subscribers).
+
+Reference parity: differential-dataflow's typed-record hot path
+(/root/reference/src/engine/dataflow.rs:2270,5506) vs Python-object
+interpretation — this module is the boundary that keeps rows native.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import struct
+import subprocess
+import threading
+from pathlib import Path
+from typing import Any, Iterable
+
+import numpy as np
+
+from pathway_tpu.engine.native import _cpu_tag
+from pathway_tpu.internals.keys import Key
+
+_HERE = Path(__file__).resolve().parent
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+u64p = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
+i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+c_u64_p = ctypes.POINTER(ctypes.c_uint64)
+
+
+def _build() -> Path | None:
+    src = _HERE / "dataplane.cpp"
+    tag = hashlib.sha256(src.read_bytes()).hexdigest()[:16] + "-" + _cpu_tag()
+    out = _HERE / f"libdataplane-{tag}.so"
+    if out.exists():
+        return out
+    for stale in _HERE.glob("libdataplane-*.so"):
+        try:
+            stale.unlink()
+        except OSError:
+            pass
+    cmd = [
+        "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+        str(src), "-o", str(out),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            cmd.remove("-march=native")
+            subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            return None
+    return out
+
+
+def _load() -> ctypes.CDLL | None:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("PATHWAY_TPU_NATIVE", "1") == "0":
+            return None
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            return None
+        c = ctypes
+        lib.dp_tab_new.restype = c.c_void_p
+        lib.dp_tab_free.argtypes = [c.c_void_p]
+        lib.dp_tab_len.restype = c.c_int64
+        lib.dp_tab_len.argtypes = [c.c_void_p]
+        lib.dp_tab_intern.restype = c.c_uint64
+        lib.dp_tab_intern.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+        lib.dp_tab_get.restype = c.c_int64
+        lib.dp_tab_get.argtypes = [c.c_void_p, c.c_uint64, c.POINTER(c.c_char_p)]
+        lib.dp_hash128.argtypes = [c.c_char_p, c.c_int64, c_u64_p, c_u64_p]
+        lib.dp_ingest_jsonl.restype = c.c_int64
+        lib.dp_ingest_jsonl.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_int64, c.c_int64,
+            c.POINTER(c.c_char_p), i64p, i64p, c.c_int64,
+            c.c_uint64, c.c_uint64, u64p, u64p, u64p, u8p, i64p, i64p,
+            c.c_int64,
+        ]
+        lib.dp_ingest_csv.restype = c.c_int64
+        lib.dp_ingest_csv.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_int64, c.c_char, c.c_int64,
+            i64p, u8p, u8p, i64p, c.c_int64, c.c_uint64, c.c_uint64,
+            u64p, u64p, u64p, u8p, i64p, i64p, c.c_int64,
+        ]
+        lib.dp_decode_num_cols.restype = c.c_int64
+        lib.dp_decode_num_cols.argtypes = [
+            c.c_void_p, c.c_int64, u64p, i64p, c.c_int64, i64p, f64p, u8p,
+        ]
+        lib.dp_decode_str_cols.restype = c.c_int64
+        lib.dp_decode_str_cols.argtypes = [
+            c.c_void_p, c.c_int64, u64p, i64p, c.c_int64,
+            c.c_char_p, c.c_int64, i64p, i64p, u8p,
+        ]
+        lib.dp_project_group.restype = c.c_int64
+        lib.dp_project_group.argtypes = [
+            c.c_void_p, c.c_int64, u64p, i64p, c.c_int64, c.c_int64, u64p, i64p,
+        ]
+        lib.dp_route_key.argtypes = [c.c_int64, u64p, u64p, c.c_int64, i64p]
+        lib.dp_build_rows.restype = c.c_int64
+        lib.dp_build_rows.argtypes = [
+            c.c_void_p, c.c_int64, u64p, c.c_int64, i64p, i64p,
+            i64p, f64p, u8p, u64p, u8p,
+        ]
+        lib.dp_format_csv.restype = c.c_int64
+        lib.dp_format_csv.argtypes = [
+            c.c_void_p, c.c_int64, u64p, i64p, c.c_int64, c.c_char,
+            c.c_char_p, c.c_int64, i64p, i64p,
+        ]
+        lib.dp_distinct_check.restype = c.c_int64
+        lib.dp_distinct_check.argtypes = [c.c_int64, u64p, u64p, i64p]
+        lib.dp_consolidate.restype = c.c_int64
+        lib.dp_consolidate.argtypes = [c.c_int64, u64p, u64p, u64p, i64p]
+        lib.dp_export_tokens.restype = c.c_int64
+        lib.dp_export_tokens.argtypes = [
+            c.c_void_p, c.c_int64, u64p, c.c_char_p, c.c_int64, i64p, c.c_int64,
+        ]
+        lib.dp_import_tokens.restype = c.c_int64
+        lib.dp_import_tokens.argtypes = [
+            c.c_void_p, c.c_int64, u64p, c.c_char_p, i64p, c.c_int64,
+        ]
+        _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# -------------------------------------------------------- row (de)serialize
+
+_TAG_NONE, _TAG_BOOL, _TAG_INT, _TAG_FLOAT, _TAG_STR, _TAG_BYTES = range(6)
+
+
+def decode_row(b: bytes) -> tuple:
+    """Canonical bytes -> Python row tuple (scalar tags only)."""
+    out: list[Any] = []
+    pos = 0
+    n = len(b)
+    while pos < n:
+        tag = b[pos]
+        pos += 1
+        if tag == _TAG_NONE:
+            out.append(None)
+        elif tag == _TAG_BOOL:
+            out.append(b[pos] == 1)
+            pos += 1
+        elif tag == _TAG_INT:
+            out.append(struct.unpack_from("<q", b, pos)[0])
+            pos += 8
+        elif tag == _TAG_FLOAT:
+            out.append(struct.unpack_from("<d", b, pos)[0])
+            pos += 8
+        elif tag == _TAG_STR:
+            ln = struct.unpack_from("<q", b, pos)[0]
+            pos += 8
+            out.append(b[pos : pos + ln].decode("utf-8"))
+            pos += ln
+        elif tag == _TAG_BYTES:
+            ln = struct.unpack_from("<q", b, pos)[0]
+            pos += 8
+            out.append(b[pos : pos + ln])
+            pos += ln
+        else:
+            raise ValueError(f"non-scalar tag {tag} in native row")
+    return tuple(out)
+
+
+def encode_scalar(v: Any) -> bytes | None:
+    """One value -> canonical piece; None return = not plane-representable.
+    (Must stay byte-identical to keys._serialize_value for these types.)"""
+    t = type(v)
+    if v is None:
+        return b"\x00"
+    if t is bool or isinstance(v, np.bool_):
+        return b"\x01\x01" if v else b"\x01\x00"
+    if t is int or isinstance(v, np.integer):
+        try:
+            return b"\x02" + struct.pack("<q", int(v))
+        except (struct.error, OverflowError):
+            return None
+    if t is float or isinstance(v, np.floating):
+        return b"\x03" + struct.pack("<d", float(v))
+    if t is str:
+        eb = v.encode("utf-8")
+        return b"\x04" + struct.pack("<q", len(eb)) + eb
+    if t is bytes:
+        return b"\x05" + struct.pack("<q", len(v)) + v
+    return None
+
+
+def encode_row(row: tuple) -> bytes | None:
+    """Row tuple -> canonical bytes; None when any value is non-scalar."""
+    pieces = []
+    for v in row:
+        p = encode_scalar(v)
+        if p is None:
+            return None
+        pieces.append(p)
+    return b"".join(pieces)
+
+
+class InternTable:
+    """Process-side handle on a C++ intern table + a token->row cache."""
+
+    def __init__(self) -> None:
+        lib = _load()
+        assert lib is not None
+        self._lib = lib
+        self._h = lib.dp_tab_new()
+        self._row_cache: dict[int, tuple] = {}
+
+    def __del__(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.dp_tab_free(self._h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return self._lib.dp_tab_len(self._h)
+
+    def intern(self, data: bytes) -> int:
+        return self._lib.dp_tab_intern(self._h, data, len(data))
+
+    def intern_row(self, row: tuple) -> int | None:
+        b = encode_row(row)
+        if b is None:
+            return None
+        tok = self.intern(b)
+        self._row_cache.setdefault(tok, row)
+        return tok
+
+    def get_bytes(self, token: int) -> bytes:
+        ptr = ctypes.c_char_p()
+        n = self._lib.dp_tab_get(self._h, token, ctypes.byref(ptr))
+        if n < 0:
+            raise KeyError(f"unknown intern token {token}")
+        return ctypes.string_at(ptr, n)
+
+    def row(self, token: int) -> tuple:
+        r = self._row_cache.get(token)
+        if r is None:
+            r = decode_row(self.get_bytes(token))
+            self._row_cache[token] = r
+        return r
+
+
+_DEFAULT_TAB: InternTable | None = None
+_DEFAULT_TAB_LOCK = threading.Lock()
+
+
+def default_table() -> InternTable:
+    """The process-wide intern table (all engine sessions share it; tokens
+    are comparable across nodes and worker threads)."""
+    global _DEFAULT_TAB
+    with _DEFAULT_TAB_LOCK:
+        if _DEFAULT_TAB is None:
+            _DEFAULT_TAB = InternTable()
+    return _DEFAULT_TAB
+
+
+class NativeBatch:
+    """A token-resident z-set batch: (key, token, diff) flat arrays."""
+
+    __slots__ = ("tab", "key_lo", "key_hi", "token", "diff")
+
+    def __init__(
+        self,
+        tab: InternTable,
+        key_lo: np.ndarray,
+        key_hi: np.ndarray,
+        token: np.ndarray,
+        diff: np.ndarray,
+    ):
+        self.tab = tab
+        self.key_lo = key_lo
+        self.key_hi = key_hi
+        self.token = token
+        self.diff = diff
+
+    def __len__(self) -> int:
+        return len(self.token)
+
+    def materialize(self) -> list[tuple]:
+        """Decode to [(Key, row, diff)] — the Python-object boundary."""
+        tab = self.tab
+        lo = self.key_lo
+        hi = self.key_hi
+        tok = self.token
+        diff = self.diff
+        return [
+            (
+                Key((int(hi[i]) << 64) | int(lo[i])),
+                tab.row(int(tok[i])),
+                int(diff[i]),
+            )
+            for i in range(len(tok))
+        ]
+
+    def select(self, idx: np.ndarray) -> "NativeBatch":
+        """Row subset/permutation by integer or boolean index array."""
+        return NativeBatch(
+            self.tab,
+            np.ascontiguousarray(self.key_lo[idx]),
+            np.ascontiguousarray(self.key_hi[idx]),
+            np.ascontiguousarray(self.token[idx]),
+            np.ascontiguousarray(self.diff[idx]),
+        )
+
+    def with_diff(self, diff: np.ndarray) -> "NativeBatch":
+        return NativeBatch(self.tab, self.key_lo, self.key_hi, self.token, diff)
+
+    def keys_array(self) -> np.ndarray:
+        """128-bit keys as object array of Key (rarely needed)."""
+        return np.array(
+            [Key((int(h) << 64) | int(lo)) for h, lo in zip(self.key_hi, self.key_lo)],
+            dtype=object,
+        )
+
+    @staticmethod
+    def concat(batches: "list[NativeBatch]") -> "NativeBatch":
+        assert batches
+        tab = batches[0].tab
+        return NativeBatch(
+            tab,
+            np.concatenate([b.key_lo for b in batches]),
+            np.concatenate([b.key_hi for b in batches]),
+            np.concatenate([b.token for b in batches]),
+            np.concatenate([b.diff for b in batches]),
+        )
+
+    def is_distinct_insert(self) -> bool:
+        """True when all diffs are +1 with pairwise-distinct keys (already
+        consolidated — the shape every fresh ingest produces)."""
+        lib = _load()
+        return bool(
+            lib.dp_distinct_check(len(self), self.key_lo, self.key_hi, self.diff)
+        )
+
+    def consolidate(self) -> "NativeBatch":
+        lib = _load()
+        lo = self.key_lo.copy()
+        hi = self.key_hi.copy()
+        tok = self.token.copy()
+        diff = self.diff.copy()
+        m = lib.dp_consolidate(len(tok), lo, hi, tok, diff)
+        return NativeBatch(self.tab, lo[:m], hi[:m], tok[:m], diff[:m])
+
+    # ------------------------------------------------------------- wire form
+
+    def to_wire(self) -> tuple:
+        """Compact picklable form for cross-process exchange: tokens are
+        rewritten to dense local ids + a unique-row blob."""
+        lib = _load()
+        tok = self.token.copy()
+        n = len(tok)
+        blob_cap = 1 << 16
+        ulen = np.empty(max(n, 1), np.int64)
+        while True:
+            blob = ctypes.create_string_buffer(blob_cap)
+            n_u = lib.dp_export_tokens(
+                self.tab._h, n, tok, blob, blob_cap, ulen, len(ulen)
+            )
+            if n_u >= 0:
+                break
+            blob_cap = max(-n_u, blob_cap * 2)
+        used = int(ulen[:n_u].sum()) if n_u else 0
+        return (
+            self.key_lo.tobytes(),
+            self.key_hi.tobytes(),
+            tok.tobytes(),
+            self.diff.tobytes(),
+            blob.raw[:used],
+            ulen[:n_u].tobytes(),
+        )
+
+    @staticmethod
+    def from_wire(w: tuple, tab: InternTable | None = None) -> "NativeBatch":
+        lib = _load()
+        tab = tab or default_table()
+        lo = np.frombuffer(w[0], np.uint64).copy()
+        hi = np.frombuffer(w[1], np.uint64).copy()
+        tok = np.frombuffer(w[2], np.uint64).copy()
+        diff = np.frombuffer(w[3], np.int64).copy()
+        ulen = np.frombuffer(w[5], np.int64).copy()
+        rc = lib.dp_import_tokens(tab._h, len(tok), tok, w[4], ulen, len(ulen))
+        if rc != 0:
+            raise ValueError("corrupt native wire batch")
+        return NativeBatch(tab, lo, hi, tok, diff)
+
+
+# ------------------------------------------------------------------ ingest
+
+
+def ingest_jsonl(
+    tab: InternTable,
+    data: bytes,
+    col_names: list[str],
+    pk_idx: list[int],
+    seq_base: int,
+    seq_start: int,
+):
+    """Parse a jsonlines chunk. Returns (batch_arrays, statuses,
+    line_offsets): tokens/keys are valid where status==0; status==1 lines
+    need the Python fallback parser; 2 = blank."""
+    lib = _load()
+    n_cols = len(col_names)
+    name_bufs = [n.encode("utf-8") for n in col_names]
+    name_arr = (ctypes.c_char_p * n_cols)(*name_bufs)
+    name_lens = np.array([len(b) for b in name_bufs], np.int64)
+    cap = data.count(b"\n") + 2
+    out_tok = np.empty(cap, np.uint64)
+    out_lo = np.empty(cap, np.uint64)
+    out_hi = np.empty(cap, np.uint64)
+    status = np.empty(cap, np.uint8)
+    ls = np.empty(cap, np.int64)
+    le = np.empty(cap, np.int64)
+    pk = np.asarray(pk_idx or [0], np.int64)
+    n = lib.dp_ingest_jsonl(
+        tab._h, data, len(data), n_cols,
+        ctypes.cast(name_arr, ctypes.POINTER(ctypes.c_char_p)), name_lens,
+        pk, len(pk_idx), seq_base, seq_start,
+        out_tok, out_lo, out_hi, status, ls, le, cap,
+    )
+    return (
+        (out_lo[:n], out_hi[:n], out_tok[:n]),
+        status[:n],
+        (ls[:n], le[:n]),
+    )
+
+
+def ingest_csv(
+    tab: InternTable,
+    data: bytes,
+    field_idx: list[int],
+    dtypes: list[int],
+    optional: list[bool],
+    pk_idx: list[int],
+    seq_base: int,
+    seq_start: int,
+    delim: bytes = b",",
+):
+    """Parse CSV records (header already consumed by the caller)."""
+    lib = _load()
+    n_cols = len(field_idx)
+    cap = data.count(b"\n") + 2
+    out_tok = np.empty(cap, np.uint64)
+    out_lo = np.empty(cap, np.uint64)
+    out_hi = np.empty(cap, np.uint64)
+    status = np.empty(cap, np.uint8)
+    ls = np.empty(cap, np.int64)
+    le = np.empty(cap, np.int64)
+    pk = np.asarray(pk_idx or [0], np.int64)
+    n = lib.dp_ingest_csv(
+        tab._h, data, len(data), delim, n_cols,
+        np.asarray(field_idx, np.int64),
+        np.asarray(dtypes, np.uint8),
+        np.asarray([1 if o else 0 for o in optional], np.uint8),
+        pk, len(pk_idx), seq_base, seq_start,
+        out_tok, out_lo, out_hi, status, ls, le, cap,
+    )
+    return (
+        (out_lo[:n], out_hi[:n], out_tok[:n]),
+        status[:n],
+        (ls[:n], le[:n]),
+    )
+
+
+# ------------------------------------------------------------ node helpers
+
+
+def decode_num_cols(tab: InternTable, tokens: np.ndarray, col_idx: list[int]):
+    """-> (vals_i, vals_f, tags) each [n_cols, n]; tags match the zs_agg
+    layout (0=int, 1=float, 2=error-bucket). None on malformed rows."""
+    lib = _load()
+    n = len(tokens)
+    k = len(col_idx)
+    vi = np.zeros(k * n, np.int64)
+    vf = np.zeros(k * n, np.float64)
+    tg = np.zeros(k * n, np.uint8)
+    rc = lib.dp_decode_num_cols(
+        tab._h, n, np.ascontiguousarray(tokens),
+        np.asarray(col_idx, np.int64), k, vi, vf, tg,
+    )
+    if rc != 0:
+        return None
+    return vi.reshape(k, n), vf.reshape(k, n), tg.reshape(k, n)
+
+
+def decode_str_cols(tab: InternTable, tokens: np.ndarray, col_idx: list[int]):
+    """-> list of per-column lists of str|None, or None on malformed rows /
+    non-string values (kind==2)."""
+    lib = _load()
+    n = len(tokens)
+    k = len(col_idx)
+    cap = max(64 * n, 4096)
+    off = np.zeros(k * n, np.int64)
+    slen = np.zeros(k * n, np.int64)
+    kind = np.zeros(k * n, np.uint8)
+    ci = np.asarray(col_idx, np.int64)
+    toks = np.ascontiguousarray(tokens)
+    while True:
+        buf = ctypes.create_string_buffer(cap)
+        used = lib.dp_decode_str_cols(tab._h, n, toks, ci, k, buf, cap, off, slen, kind)
+        if used == -(2**63):
+            return None
+        if used >= 0:
+            break
+        cap = -used
+    raw = buf.raw
+    cols: list[list] = []
+    for j in range(k):
+        col: list = []
+        for i in range(n):
+            o = j * n + i
+            if kind[o] == 0:
+                col.append(raw[off[o] : off[o] + slen[o]].decode("utf-8"))
+            elif kind[o] == 1:
+                col.append(None)
+            else:
+                return None
+        cols.append(col)
+    return cols
+
+
+def project_group(
+    tab: InternTable, tokens: np.ndarray, col_idx: list[int], n_shards: int = 0
+):
+    """-> (gtokens, shards|None); None result on malformed rows."""
+    lib = _load()
+    n = len(tokens)
+    gt = np.empty(n, np.uint64)
+    sh = np.empty(n, np.int64)
+    rc = lib.dp_project_group(
+        tab._h, n, np.ascontiguousarray(tokens),
+        np.asarray(col_idx, np.int64), len(col_idx), n_shards, gt, sh,
+    )
+    if rc != 0:
+        return None
+    return gt, (sh if n_shards > 0 else None)
+
+
+def route_key(key_lo: np.ndarray, key_hi: np.ndarray, n_shards: int) -> np.ndarray:
+    lib = _load()
+    n = len(key_lo)
+    out = np.empty(n, np.int64)
+    lib.dp_route_key(
+        n, np.ascontiguousarray(key_lo), np.ascontiguousarray(key_hi), n_shards, out
+    )
+    return out
+
+
+def build_rows(
+    tab: InternTable,
+    in_tokens: np.ndarray,
+    specs: list,
+    vals_i: np.ndarray,
+    vals_f: np.ndarray,
+    vtag: np.ndarray,
+):
+    """specs: per output column, ('col', src_idx) or ('val', slot). The
+    val arrays are [n_slots, n] row-major (slot = second spec element).
+    Returns (tokens, status)."""
+    lib = _load()
+    n = len(in_tokens)
+    n_out = len(specs)
+    src_kind = np.array([0 if s[0] == "col" else 1 for s in specs], np.int64)
+    src_col = np.array([s[1] for s in specs], np.int64)
+    out_tok = np.empty(n, np.uint64)
+    status = np.empty(n, np.uint8)
+    rc = lib.dp_build_rows(
+        tab._h, n, np.ascontiguousarray(in_tokens), n_out, src_kind, src_col,
+        np.ascontiguousarray(vals_i.reshape(-1)),
+        np.ascontiguousarray(vals_f.reshape(-1)),
+        np.ascontiguousarray(vtag.reshape(-1)),
+        out_tok, status,
+    )
+    assert rc == 0
+    return out_tok, status
+
+
+def format_csv(
+    tab: InternTable,
+    tokens: np.ndarray,
+    diffs: np.ndarray,
+    time: int,
+    delim: bytes = b",",
+):
+    """-> (csv_bytes, fallback_row_indices)."""
+    lib = _load()
+    n = len(tokens)
+    fb = np.empty(max(n, 1), np.int64)
+    nfb = np.zeros(1, np.int64)
+    cap = max(64 * n, 4096)
+    toks = np.ascontiguousarray(tokens)
+    dfs = np.ascontiguousarray(diffs)
+    while True:
+        out = ctypes.create_string_buffer(cap)
+        used = lib.dp_format_csv(tab._h, n, toks, dfs, time, delim, out, cap, fb, nfb)
+        if used >= 0:
+            break
+        cap = -used + 1024
+    return out.raw[:used], fb[: int(nfb[0])]

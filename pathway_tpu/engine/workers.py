@@ -93,12 +93,25 @@ def _shard_of(token: Any, n: int) -> int:
     """Process-stable shard assignment. Python's hash() is salted per
     process (PYTHONHASHSEED), which would route a group to a different
     worker after restart — operator snapshots store per-shard state, so
-    routing must be a pure function of the token's content."""
+    routing must be a pure function of the token's content.
+
+    Non-int tokens hash via blake2b of the token's canonical value
+    serialization — the same bytes the native data plane computes in C++
+    (dataplane.cpp dp_project_group), so a batch routed natively and a
+    row routed here always land on the same shard."""
     if isinstance(token, bool):
         return int(token) % n
     if isinstance(token, int):
         return token % n
-    digest = hashlib.md5(repr(_canon(token)).encode()).digest()
+    from pathway_tpu.internals.keys import _serialize_value
+
+    out: list[bytes] = []
+    try:
+        _serialize_value(_canon(token), out)
+        payload = b"".join(out)
+    except Exception:  # noqa: BLE001 — exotic token: stable repr fallback
+        payload = repr(_canon(token)).encode()
+    digest = hashlib.blake2b(payload, digest_size=16).digest()
     return int.from_bytes(digest[:8], "little") % n
 
 

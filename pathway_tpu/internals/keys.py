@@ -182,6 +182,24 @@ def key_for_value(value: Any) -> Key:
 
 
 _seq_counter = itertools.count()
+_seq_lock = None  # lazy: threading import kept out of the hot import path
+
+
+def reserve_sequential(n: int) -> int:
+    """Reserve n consecutive sequence numbers; returns the first. The
+    native ingest path computes the same blake2b(pack(base, i) + salt)
+    keys in C++ from this range, so native and Python rows share one
+    non-colliding sequence."""
+    global _seq_lock
+    if _seq_lock is None:
+        import threading
+
+        _seq_lock = threading.Lock()
+    with _seq_lock:
+        start = next(_seq_counter)
+        for _ in range(n - 1):
+            next(_seq_counter)
+    return start
 
 
 def sequential_key(base: int = 0) -> Key:

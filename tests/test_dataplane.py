@@ -1,0 +1,326 @@
+"""Native data-plane unit tests: every C++ primitive is checked against
+its Python ground truth (keys._serialize_value / hashlib.blake2b /
+json.loads / csv.writer), because the plane's whole contract is
+bit-identity with the Python path."""
+
+from __future__ import annotations
+
+import csv as _csv
+import hashlib
+import io
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine.native import dataplane as dp
+from pathway_tpu.internals import keys
+
+pytestmark = pytest.mark.skipif(not dp.available(), reason="no native toolchain")
+
+
+def _py_key(*values):
+    return keys.key_for_values(*values)
+
+
+def _py_row_bytes(row):
+    out = []
+    for v in row:
+        keys._serialize_value(v, out)
+    return b"".join(out)
+
+
+# ------------------------------------------------------------------ hashing
+
+
+def test_hash128_matches_hashlib():
+    import ctypes
+
+    lib = dp._load()
+    for data in [b"", b"a", b"abc" * 100, bytes(range(256)) * 7, b"x" * 128]:
+        lo = ctypes.c_uint64()
+        hi = ctypes.c_uint64()
+        lib.dp_hash128(data, len(data), ctypes.byref(lo), ctypes.byref(hi))
+        want = int.from_bytes(
+            hashlib.blake2b(data, digest_size=16).digest(), "little"
+        )
+        assert (hi.value << 64) | lo.value == want
+
+
+def test_encode_row_matches_serialize_value():
+    rows = [
+        (None,),
+        (True, False),
+        (1, -5, 2**62),
+        (1.5, -0.0, float("inf")),
+        ("hello", "żółć", ""),
+        (b"bytes", b""),
+        ("mixed", 1, 2.5, None, True, b"z"),
+    ]
+    for row in rows:
+        assert dp.encode_row(row) == _py_row_bytes(row), row
+        assert dp.decode_row(dp.encode_row(row)) == row
+
+
+def test_intern_roundtrip():
+    tab = dp.InternTable()
+    t1 = tab.intern_row(("a", 1))
+    t2 = tab.intern_row(("a", 1))
+    t3 = tab.intern_row(("a", 2))
+    assert t1 == t2 != t3
+    assert tab.row(t1) == ("a", 1)
+    assert tab.row(t3) == ("a", 2)
+    assert len(tab) == 2
+
+
+# ------------------------------------------------------------------- ingest
+
+
+def test_ingest_jsonl_matches_python():
+    tab = dp.InternTable()
+    lines = [
+        {"word": "hello"},
+        {"word": "żółć", "extra": [1, 2, {"x": 3}]},
+        {"word": "with \"quotes\" and \\u00e9: é", "n": 5},
+        {"word": None},
+        {"n": 7},  # missing word -> None
+        {"word": "tab\there", "f": 1.25, "b": True},
+    ]
+    data = "\n".join(json.dumps(ln) for ln in lines).encode() + b"\n"
+    (lo, hi, tok), status, _ = dp.ingest_jsonl(
+        tab, data, ["word", "n", "f", "b"], [], 0, 1000
+    )
+    assert list(status) == [0] * len(lines)
+    for i, ln in enumerate(lines):
+        rec = json.loads(json.dumps(ln))
+        want_row = tuple(rec.get(c) for c in ["word", "n", "f", "b"])
+        assert tab.row(int(tok[i])) == want_row, (i, want_row)
+        want_key = keys.Key(
+            keys._hash_bytes(
+                struct.pack("<QQ", 0, 1000 + i)
+                + keys._SALT_SEQ.to_bytes(16, "little")
+            )
+        )
+        assert keys.Key.from_hi_lo(int(hi[i]), int(lo[i])) == want_key
+
+
+def test_ingest_jsonl_fallback_lines():
+    tab = dp.InternTable()
+    data = b'{"word": "ok"}\n{"word": [1,2]}\nnot json\n{"word": 99999999999999999999999}\n\n{"word": "fine"}\n'
+    (_, _, tok), status, (ls, le) = dp.ingest_jsonl(tab, data, ["word"], [], 0, 0)
+    assert list(status) == [0, 1, 1, 1, 2, 0]
+    assert tab.row(int(tok[0])) == ("ok",)
+    assert tab.row(int(tok[5])) == ("fine",)
+    # fallback line offsets recover the raw line
+    assert data[ls[1]:le[1]] == b'{"word": [1,2]}'
+
+
+def test_ingest_jsonl_pk_keys():
+    tab = dp.InternTable()
+    data = b'{"k": "a", "v": 1}\n{"k": "b", "v": 2}\n'
+    (lo, hi, tok), status, _ = dp.ingest_jsonl(tab, data, ["k", "v"], [0], 0, 0)
+    assert list(status) == [0, 0]
+    assert keys.Key.from_hi_lo(int(hi[0]), int(lo[0])) == _py_key("a")
+    assert keys.Key.from_hi_lo(int(hi[1]), int(lo[1])) == _py_key("b")
+
+
+def test_ingest_csv_matches_coerce():
+    tab = dp.InternTable()
+    # dtype tags: 2=int 3=float 1=bool 4=str
+    data = b'5,1.5,true,plain\n-7, 2.25 ,0,"quo,ted"\n99,bad,YES,"with ""q"""\n'
+    (lo, hi, tok), status, _ = dp.ingest_csv(
+        tab, data, [0, 1, 2, 3], [2, 3, 1, 4], [False] * 4, [], 0, 0
+    )
+    assert list(status) == [0, 0, 0]
+    assert tab.row(int(tok[0])) == (5, 1.5, True, "plain")
+    assert tab.row(int(tok[1])) == (-7, 2.25, False, "quo,ted")
+    # float("bad") fails -> _coerce falls back to the raw string
+    assert tab.row(int(tok[2])) == (99, "bad", True, 'with "q"')
+
+
+def test_ingest_csv_optional_empty():
+    tab = dp.InternTable()
+    data = b",5\nx,\n"
+    (_, _, tok), status, _ = dp.ingest_csv(
+        tab, data, [0, 1], [4, 2], [True, True], [], 0, 0
+    )
+    assert list(status) == [0, 0]
+    assert tab.row(int(tok[0])) == (None, 5)
+    assert tab.row(int(tok[1])) == ("x", None)
+
+
+# ----------------------------------------------------------- decode/project
+
+
+def _mk_batch(tab, rows, start_key=0):
+    toks = np.array([tab.intern_row(r) for r in rows], np.uint64)
+    lo = np.arange(start_key, start_key + len(rows), dtype=np.uint64)
+    hi = np.zeros(len(rows), np.uint64)
+    diff = np.ones(len(rows), np.int64)
+    return dp.NativeBatch(tab, lo, hi, toks, diff)
+
+
+def test_decode_num_cols():
+    tab = dp.InternTable()
+    rows = [("a", 1, 2.5, True), ("b", -3, 0.0, False), ("c", None, 7.0, None)]
+    b = _mk_batch(tab, rows)
+    vi, vf, tg = dp.decode_num_cols(tab, b.token, [1, 2, 3])
+    assert list(tg[0]) == [0, 0, 2]  # int col: None -> error bucket
+    assert list(vi[0][:2]) == [1, -3]
+    assert list(tg[1]) == [1, 1, 1]
+    assert list(vf[1]) == [2.5, 0.0, 7.0]
+    assert list(tg[2][:2]) == [0, 0] and list(vi[2][:2]) == [1, 0]  # bools
+
+
+def test_decode_str_cols():
+    tab = dp.InternTable()
+    rows = [("łąka", 1), (None, 2), ("x", 3)]
+    b = _mk_batch(tab, rows)
+    cols = dp.decode_str_cols(tab, b.token, [0])
+    assert cols == [["łąka", None, "x"]]
+    assert dp.decode_str_cols(tab, b.token, [1]) is None  # ints: not strings
+
+
+def test_project_group_identity_and_route():
+    from pathway_tpu.engine.workers import _shard_of
+
+    tab = dp.InternTable()
+    rows = [("a", 1), ("b", 2), ("a", 9), ("c", 1.0)]
+    b = _mk_batch(tab, rows)
+    res = dp.project_group(tab, b.token, [0], n_shards=4)
+    assert res is not None
+    gt, sh = res
+    assert gt[0] == gt[2] and gt[0] != gt[1]
+    # group bytes decode back to the group values tuple
+    assert tab.row(int(gt[0])) == ("a",)
+    # shard matches the Python _shard_of on the frozen gvals tuple
+    for i, r in enumerate(rows):
+        assert sh[i] == _shard_of((r[0],), 4), (i, r)
+
+
+def test_project_group_numeric_canon_routing():
+    """1 vs 1.0 group keys route to the same shard (Python dict equality
+    folds them into one group; routing must agree)."""
+    from pathway_tpu.engine.workers import _shard_of
+
+    tab = dp.InternTable()
+    rows = [(1, "x"), (1.0, "y"), (True, "z"), (7.5, "w")]
+    b = _mk_batch(tab, rows)
+    gt, sh = dp.project_group(tab, b.token, [0], n_shards=8)
+    assert sh[0] == sh[1] == sh[2] == _shard_of((1,), 8)
+    assert sh[3] == _shard_of((7.5,), 8)
+
+
+def test_route_key_matches_python():
+    tab = dp.InternTable()
+    rows = [("r%d" % i,) for i in range(50)]
+    b = _mk_batch(tab, rows)
+    ks = [keys.key_for_values(*r) for r in rows]
+    b = dp.NativeBatch(
+        tab,
+        np.array([k.value & ((1 << 64) - 1) for k in ks], np.uint64),
+        np.array([k.value >> 64 for k in ks], np.uint64),
+        b.token,
+        b.diff,
+    )
+    for n in (1, 2, 3, 4, 7, 16):
+        got = dp.route_key(b.key_lo, b.key_hi, n)
+        for i, k in enumerate(ks):
+            assert got[i] == k.value % n
+
+
+# ------------------------------------------------------------- build/format
+
+
+def test_build_rows_passthrough_and_values():
+    tab = dp.InternTable()
+    rows = [("a", 1.0, 2.0), ("b", 3.0, 4.0)]
+    b = _mk_batch(tab, rows)
+    n = len(rows)
+    vi = np.zeros((1, n), np.int64)
+    vf = np.array([[2.0, 12.0]], np.float64)
+    vt = np.array([[1, 1]], np.uint8)
+    toks, status = dp.build_rows(
+        tab, b.token, [("col", 0), ("col", 2), ("val", 0)], vi, vf, vt
+    )
+    assert list(status) == [0, 0]
+    assert tab.row(int(toks[0])) == ("a", 2.0, 2.0)
+    assert tab.row(int(toks[1])) == ("b", 4.0, 12.0)
+
+
+def test_format_csv_matches_csv_module():
+    tab = dp.InternTable()
+    rows = [
+        ("plain", 5, 1.5, True, None),
+        ('with"quote', -2, 2.0, False, None),
+        ("comma,here", 0, 1e16, True, None),
+        ("new\nline", 1, 0.1, False, None),
+    ]
+    b = _mk_batch(tab, rows)
+    got, fb = dp.format_csv(tab, b.token, b.diff, 42)
+    assert len(fb) == 0
+    sio = io.StringIO()
+    w = _csv.writer(sio)
+    for r in rows:
+        w.writerow(list(r) + [42, 1])
+    assert got.decode() == sio.getvalue()
+
+
+def test_format_csv_fallback_rows():
+    tab = dp.InternTable()
+    rows = [("ok", 1), (b"bytes-val", 2)]
+    b = _mk_batch(tab, rows)
+    got, fb = dp.format_csv(tab, b.token, b.diff, 2)
+    assert list(fb) == [1]
+    assert got.decode().startswith("ok,1,2,1")
+
+
+# ------------------------------------------------------- batch ops & wire
+
+
+def test_distinct_and_consolidate():
+    tab = dp.InternTable()
+    rows = [("a",), ("b",), ("a",)]
+    toks = np.array([tab.intern_row(r) for r in rows], np.uint64)
+    lo = np.array([1, 2, 1], np.uint64)
+    hi = np.zeros(3, np.uint64)
+    b = dp.NativeBatch(tab, lo, hi, toks, np.ones(3, np.int64))
+    assert not b.is_distinct_insert()
+    c = b.consolidate()
+    assert len(c) == 2
+    assert list(c.diff) == [2, 1] or list(c.diff) == [1, 2]
+    # stable first-appearance order: ('a', key 1) first
+    assert c.tab.row(int(c.token[0])) == ("a",)
+
+    b2 = dp.NativeBatch(
+        tab, np.array([5, 6], np.uint64), hi[:2], toks[:2], np.ones(2, np.int64)
+    )
+    assert b2.is_distinct_insert()
+    # diff != 1 -> not the ingest shape
+    b3 = b2.with_diff(np.array([1, -1], np.int64))
+    assert not b3.is_distinct_insert()
+
+
+def test_materialize():
+    tab = dp.InternTable()
+    rows = [("a", 1), ("b", None)]
+    b = _mk_batch(tab, rows, start_key=7)
+    ents = b.materialize()
+    assert [r for _k, r, _d in ents] == rows
+    assert ents[0][0] == keys.Key(7)
+    assert all(d == 1 for _k, _r, d in ents)
+
+
+def test_wire_roundtrip_across_tables():
+    tab_a = dp.InternTable()
+    rows = [("x", 1.5), ("y", None), ("x", 1.5)]
+    b = _mk_batch(tab_a, rows)
+    wire = b.to_wire()
+    import pickle
+
+    wire = pickle.loads(pickle.dumps(wire))
+    tab_b = dp.InternTable()
+    rb = dp.NativeBatch.from_wire(wire, tab_b)
+    assert [r for _k, r, _d in rb.materialize()] == rows
+    assert list(rb.key_lo) == list(b.key_lo)
