@@ -20,9 +20,39 @@ from typing import Any, Callable, Iterable, Sequence
 import numpy as np
 
 from pathway_tpu.internals.errors import ERROR, ErrorValue, global_error_log
-from pathway_tpu.internals.keys import Key, hash_values, key_for_values
+from pathway_tpu.internals.keys import (
+    Key,
+    _hash_bytes as _hash_bytes_128,
+    hash_values,
+    key_for_values,
+)
 
 Entry = tuple[Key, tuple, int]  # (key, row, diff)
+
+
+def _native_batch_type():
+    """The token-resident batch type, or None when the plane is off.
+    Imported lazily: core must load when no compiler is available."""
+    try:
+        from pathway_tpu.engine.native import dataplane
+
+        if dataplane.available():
+            return dataplane.NativeBatch
+    except Exception:  # noqa: BLE001
+        pass
+    return None
+
+
+NativeBatch: Any = None  # resolved on first use via _nb_type()
+_NB_RESOLVED = False
+
+
+def _nb_type():
+    global NativeBatch, _NB_RESOLVED
+    if not _NB_RESOLVED:
+        NativeBatch = _native_batch_type()
+        _NB_RESOLVED = True
+    return NativeBatch
 
 
 # ------------------------------------------------------------------ hashing
@@ -199,6 +229,9 @@ class Node:
         self.inputs = list(inputs)
         self.downstream: list[tuple[Node, int]] = []
         self.buffers: list[list[Entry]] = [[] for _ in inputs]
+        # per-input count of buffered NativeBatch segments: inputs without
+        # segments keep the zero-copy take_input fast path
+        self._nseg: list[int] = [0] * len(self.inputs)
         self.node_id = graph.register(self)
         for i, inp in enumerate(self.inputs):
             inp.downstream.append((self, i))
@@ -216,11 +249,18 @@ class Node:
             message = f"{message} (at {self.trace})"
         self.graph.log_error(message)
 
-    def accept(self, input_idx: int, entries: list[Entry]) -> None:
-        self.buffers[input_idx].extend(entries)
+    def accept(self, input_idx: int, entries) -> None:
+        """entries: list[Entry], or a token-resident NativeBatch segment
+        (appended whole; materialized lazily at take_input unless the node
+        consumes segments natively via take_segments)."""
+        if type(entries) is list:
+            self.buffers[input_idx].extend(entries)
+        else:
+            self.buffers[input_idx].append(entries)
+            self._nseg[input_idx] += 1
 
-    def emit(self, time: int, entries: list[Entry]) -> None:
-        if not entries:
+    def emit(self, time: int, entries) -> None:
+        if entries is None or len(entries) == 0:
             return
         self.rows_out += len(entries)
         for node, idx in self.downstream:
@@ -229,8 +269,34 @@ class Node:
     def take_input(self, idx: int = 0) -> list[Entry]:
         entries = self.buffers[idx]
         self.buffers[idx] = []
+        if self._nseg[idx]:
+            self._nseg[idx] = 0
+            flat: list[Entry] = []
+            for seg in entries:
+                if type(seg) is tuple:
+                    flat.append(seg)
+                else:
+                    flat.extend(seg.materialize())
+            entries = flat
         self.rows_in += len(entries)
         return entries
+
+    def take_segments(self, idx: int = 0) -> tuple[list, list[Entry]]:
+        """Segment-aware drain for native-capable nodes: returns
+        (native_batches, python_entries) in arrival order within each
+        kind. rows_in accounting included."""
+        buf = self.buffers[idx]
+        self.buffers[idx] = []
+        self._nseg[idx] = 0
+        batches: list = []
+        entries: list[Entry] = []
+        for seg in buf:
+            if type(seg) is tuple:
+                entries.append(seg)
+            else:
+                batches.append(seg)
+        self.rows_in += len(entries) + sum(len(b) for b in batches)
+        return batches, entries
 
     def finish_time(self, time: int) -> None:
         raise NotImplementedError
@@ -302,19 +368,44 @@ class Graph:
 
 
 class InputNode(Node):
-    """Entry point: the runtime / connector sessions push batches here."""
+    """Entry point: the runtime / connector sessions push batches here.
+    Accepts plain entry lists and token-resident NativeBatch segments
+    (mixed freely; native waves stay native end to end)."""
 
     def __init__(self, graph: Graph):
         super().__init__(graph, ())
-        self.pending: list[Entry] = []
+        self.pending: list = []  # Entry tuples and/or NativeBatch segments
 
-    def push(self, entries: list[Entry]) -> None:
-        self.pending.extend(entries)
+    def push(self, entries) -> None:
+        if type(entries) is list:
+            self.pending.extend(entries)
+        else:
+            self.pending.append(entries)
 
     def finish_time(self, time: int) -> None:
-        if self.pending:
-            out, self.pending = self.pending, []
-            self.emit(time, consolidate(out))
+        if not self.pending:
+            return
+        out, self.pending = self.pending, []
+        nb_t = _nb_type()
+        if nb_t is not None and any(type(s) is nb_t for s in out):
+            batches = [s for s in out if type(s) is nb_t]
+            entries = [s for s in out if type(s) is not nb_t]
+            if entries:
+                # mixed wave (native ingest + per-row fallbacks): the
+                # distinct-insert guarantee can span both parts, so take
+                # the safe object-plane consolidation
+                flat: list[Entry] = []
+                for b in batches:
+                    flat.extend(b.materialize())
+                flat.extend(entries)
+                self.emit(time, consolidate(flat))
+                return
+            nb = batches[0] if len(batches) == 1 else nb_t.concat(batches)
+            if not nb.is_distinct_insert():
+                nb = nb.consolidate()
+            self.emit(time, nb)
+            return
+        self.emit(time, consolidate(out))
 
 
 class StatelessNode(Node):
@@ -794,6 +885,7 @@ class GroupByNode(Node):
         arg_fns: list[Callable],
         set_id: bool = False,
         native_ok: bool = True,
+        native_plan: dict | None = None,
     ):
         super().__init__(graph, [inp])
         self.gk_fn = gk_fn
@@ -807,7 +899,16 @@ class GroupByNode(Node):
         # (lowering decides; ndarray sums etc. need the generic reducers).
         # Reference: semigroup reducer dispatch, src/engine/reduce.rs:40
         # + dataflow.rs:2715.
+        #
+        # `native_plan` (lowering-provided) additionally enables the
+        # token-resident batch path: {"gb_cols": [col indices]} plus
+        # "arg_plans": per reducer None (count) | ("col", idx) |
+        # ("numpy", NumpyPlan). With a plan, group tokens are intern ids
+        # of the projected group bytes (dataplane), shared between whole-
+        # batch C processing and the per-row fallback, so mixed waves
+        # aggregate into one state.
         self._native = None
+        self._plan = None
         if native_ok and all(
             type(r).__name__ in ("CountReducer", "SumReducer", "AvgReducer")
             for r in reducers
@@ -820,6 +921,18 @@ class GroupByNode(Node):
                 )
                 self._gid_by_token: dict[Any, int] = {}
                 self._ginfo: list[tuple[Key, tuple]] = []
+                if native_plan is not None and _nb_type() is not None:
+                    self._plan = native_plan
+                    from pathway_tpu.engine.native import dataplane as _dp
+
+                    self._dp = _dp
+                    self._tab = _dp.default_table()
+                    # gtoken -> (Key, gvals); tokens are intern ids, or
+                    # synthetic ids >= 2^63 for non-encodable group values
+                    # (ERROR poison etc., assigned by the per-row path)
+                    self._ginfo_map: dict[int, tuple[Key, tuple]] = {}
+                    self._syn_by_token: dict[Any, int] = {}
+                    self._syn_next = 1 << 63
         if self._native is None:
             self.state = MultisetState()  # gkey -> {token: ((gvals,args),cnt)}
             self.gkeys: dict[Any, tuple[Key, tuple]] = {}  # fzn gval->(Key,gvals)
@@ -832,6 +945,23 @@ class GroupByNode(Node):
         return f"GroupByNode/[{reds}]/native={int(self._native is not None)}"
 
     def persist_state(self) -> dict:
+        if self._native is not None and self._plan is not None:
+            # intern tokens are run-local: snapshot each group's canonical
+            # BYTES (re-interned on restore) or its raw gvals for
+            # synthetic (non-encodable) groups
+            agg = self._native.export_state()
+            slots = []
+            for g in agg["g"]:
+                g = int(g)
+                if g >= 1 << 63:
+                    slots.append(("v", self._ginfo_map[g][1]))
+                else:
+                    slots.append(("b", self._tab.get_bytes(g)))
+            return {
+                "native_plan": agg,
+                "slots": slots,
+                "emitted": self.emitted,
+            }
         if self._native is not None:
             return {
                 "native": self._native.export_state(),
@@ -847,14 +977,44 @@ class GroupByNode(Node):
         }
 
     def restore_state(self, st: dict) -> None:
-        if ("native" in st) != (self._native is not None):
+        mode = (
+            "plan" if self._native is not None and self._plan is not None
+            else "native" if self._native is not None
+            else "python"
+        )
+        st_mode = (
+            "plan" if "native_plan" in st
+            else "native" if "native" in st
+            else "python"
+        )
+        if mode != st_mode:
             # PATHWAY_TPU_NATIVE toggled between runs; the aggregate
             # representations are not interchangeable
             raise RuntimeError(
                 "groupby snapshot was taken with a different native-kernel "
                 "setting; cannot restore operator state"
             )
-        if self._native is not None:
+        if mode == "plan":
+            agg = st["native_plan"]
+            new_g = []
+            for kind, payload in st["slots"]:
+                if kind == "b":
+                    tok = self._tab.intern(payload)
+                    gvals = self._dp.decode_row(payload)
+                    gkey = Key(_hash_bytes_128(payload))
+                else:
+                    tok = self._syn_next
+                    self._syn_next += 1
+                    gvals = payload
+                    self._syn_by_token[freeze_value(gvals)] = tok
+                    gkey = key_for_values(*gvals)
+                self._ginfo_map[tok] = (gkey, gvals)
+                new_g.append(tok)
+            agg = dict(agg)
+            agg["g"] = np.asarray(new_g, np.uint64)
+            self._native.import_state(agg)
+            self.emitted = st["emitted"]
+        elif mode == "native":
             self._native.import_state(st["native"])
             self._gid_by_token = st["gid_by_token"]
             self._ginfo = st["ginfo"]
@@ -865,6 +1025,31 @@ class GroupByNode(Node):
             self.stateful_state = st["stateful_state"]
             self.emitted = st["emitted"]
 
+    def _group_token(self, gvals: tuple) -> int:
+        """Plan mode: the group's intern id (canonical bytes) or a
+        synthetic >= 2^63 id for non-encodable group values."""
+        tok = self._tab.intern_row(gvals)
+        if tok is None:
+            ftok = freeze_value(gvals)
+            tok = self._syn_by_token.get(ftok)
+            if tok is None:
+                tok = self._syn_next
+                self._syn_next += 1
+                self._syn_by_token[ftok] = tok
+                self._ginfo_map[tok] = (key_for_values(*gvals), gvals)
+            return tok
+        if tok not in self._ginfo_map:
+            self._ginfo_map[tok] = (key_for_values(*gvals), gvals)
+        return tok
+
+    def _group_info(self, gt: int) -> tuple[Key, tuple]:
+        info = self._ginfo_map.get(gt)
+        if info is None:  # batch-path group seen first natively
+            gbytes = self._tab.get_bytes(gt)
+            info = (Key(_hash_bytes_128(gbytes)), self._dp.decode_row(gbytes))
+            self._ginfo_map[gt] = info
+        return info
+
     def _finish_native(self, time: int, entries: list[Entry]) -> None:
         n = len(entries)
         n_red = len(self.reducers)
@@ -874,18 +1059,22 @@ class GroupByNode(Node):
         vals_f = np.zeros((n_red, n), np.float64)
         tags = np.zeros((n_red, n), np.uint8)
         keep = 0
+        plan_mode = self._plan is not None
         for key, row, diff in entries:
             try:
                 gvals = self.gk_fn(key, row)
             except Exception as e:  # noqa: BLE001
                 self.log_error(f"groupby key: {type(e).__name__}: {e}")
                 continue
-            ftok = freeze_value(gvals)
-            gid = self._gid_by_token.get(ftok)
-            if gid is None:
-                gid = len(self._ginfo)
-                self._gid_by_token[ftok] = gid
-                self._ginfo.append((key_for_values(*gvals), gvals))
+            if plan_mode:
+                gid = self._group_token(gvals)
+            else:
+                ftok = freeze_value(gvals)
+                gid = self._gid_by_token.get(ftok)
+                if gid is None:
+                    gid = len(self._ginfo)
+                    self._gid_by_token[ftok] = gid
+                    self._ginfo.append((key_for_values(*gvals), gvals))
             gtok[keep] = gid
             diffs[keep] = diff
             for ri, red in enumerate(self.reducers):
@@ -915,9 +1104,16 @@ class GroupByNode(Node):
             gtok[:keep], vals_i[:, :keep], vals_f[:, :keep],
             tags[:, :keep], diffs[:keep],
         )
+        self._emit_agg(time, g_ids, totals, isum, fsum, cnts, flags)
+
+    def _emit_agg(self, time, g_ids, totals, isum, fsum, cnts, flags) -> None:
+        plan_mode = self._plan is not None
         out: list[Entry] = []
         for j in range(len(g_ids)):
-            gkey, gvals = self._ginfo[int(g_ids[j])]
+            if plan_mode:
+                gkey, gvals = self._group_info(int(g_ids[j]))
+            else:
+                gkey, gvals = self._ginfo[int(g_ids[j])]
             if totals[j] == 0:
                 new = None
             else:
@@ -943,7 +1139,59 @@ class GroupByNode(Node):
             delta_emit(self.emitted, out, gkey, new)
         self.emit(time, out)
 
+    def _finish_native_batch(self, time: int, batch) -> bool:
+        """Token-resident wave: group projection, arg decode and the
+        semigroup aggregation all run in C/numpy; Python appears only for
+        the affected groups' output rows. Returns False when the batch
+        can't be handled (caller materializes)."""
+        plan = self._plan
+        res = self._dp.project_group(self._tab, batch.token, plan["gb_cols"])
+        if res is None:
+            return False
+        gtok = res[0]
+        n = len(batch)
+        n_red = len(self.reducers)
+        # decode every distinct arg column once
+        col_plans = [p for p in plan["arg_plans"] if p is not None]
+        need_cols = sorted(
+            {p[1] for p in col_plans if p[0] == "col"}
+            | {c for p in col_plans if p[0] == "numpy" for c in p[1].needed_cols}
+        )
+        decoded = {}
+        if need_cols:
+            dec = self._dp.decode_num_cols(self._tab, batch.token, need_cols)
+            if dec is None:
+                return False
+            vi_c, vf_c, tg_c = dec
+            decoded = {c: (vi_c[j], vf_c[j], tg_c[j]) for j, c in enumerate(need_cols)}
+        vals_i = np.zeros((n_red, n), np.int64)
+        vals_f = np.zeros((n_red, n), np.float64)
+        tags = np.zeros((n_red, n), np.uint8)
+        for ri, p in enumerate(plan["arg_plans"]):
+            if p is None:
+                continue  # count
+            if p[0] == "col":
+                vi, vf, tg = decoded[p[1]]
+            else:  # ("numpy", NumpyPlan)
+                vi, vf, tg = p[1].eval(decoded, n)
+            vals_i[ri] = vi
+            vals_f[ri] = vf
+            tags[ri] = tg
+        g_ids, totals, isum, fsum, cnts, flags = self._native.update(
+            gtok, vals_i, vals_f, tags, np.ascontiguousarray(batch.diff)
+        )
+        self._emit_agg(time, g_ids, totals, isum, fsum, cnts, flags)
+        return True
+
     def finish_time(self, time: int) -> None:
+        if self._native is not None and self._plan is not None:
+            batches, entries = self.take_segments()
+            for b in batches:
+                if not self._finish_native_batch(time, b):
+                    entries = b.materialize() + entries
+            if entries:
+                self._finish_native(time, entries)
+            return
         entries = self.take_input()
         if not entries:
             return

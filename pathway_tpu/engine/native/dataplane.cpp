@@ -31,6 +31,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <mutex>
+#include <shared_mutex>
 #include <string>
 #include <string_view>
 #include <unordered_map>
@@ -150,7 +151,7 @@ inline void blake2b_128(const uint8_t* data, size_t len, uint64_t* lo,
 // is taken once per batch, not per row.
 
 struct InternTable {
-    std::mutex mu;
+    std::shared_mutex mu;
     std::vector<char*> chunks;
     size_t chunk_used = 0;
     static constexpr size_t CHUNK = 1 << 22;  // 4 MiB
@@ -316,12 +317,14 @@ extern "C" {
 void* dp_tab_new() { return new InternTable(); }
 void dp_tab_free(void* h) { delete static_cast<InternTable*>(h); }
 int64_t dp_tab_len(void* h) {
-    return static_cast<int64_t>(static_cast<InternTable*>(h)->items.size());
+    auto* tab = static_cast<InternTable*>(h);
+    std::shared_lock<std::shared_mutex> g(tab->mu);
+    return static_cast<int64_t>(tab->items.size());
 }
 
 uint64_t dp_tab_intern(void* h, const char* data, int64_t len) {
     auto* tab = static_cast<InternTable*>(h);
-    std::lock_guard<std::mutex> g(tab->mu);
+    std::unique_lock<std::shared_mutex> g(tab->mu);
     return tab->intern_locked(data, len);
 }
 
@@ -329,6 +332,7 @@ uint64_t dp_tab_intern(void* h, const char* data, int64_t len) {
 // the table's lifetime.
 int64_t dp_tab_get(void* h, uint64_t id, const char** ptr) {
     auto* tab = static_cast<InternTable*>(h);
+    std::shared_lock<std::shared_mutex> g(tab->mu);
     const char* p;
     int64_t len;
     if (!tab->get(id, &p, &len)) return -1;
@@ -488,7 +492,15 @@ bool json_skip(JsonCursor& c) {
 
 // Parse a scalar JSON value into a canonical piece. Containers / anomalies
 // return false (the caller falls back to Python for the whole line).
-bool json_value_piece(JsonCursor& c, std::string& piece) {
+//
+// `declared` is the schema column's dtype tag (0 = any, TAG_INT/TAG_FLOAT
+// for numeric columns): numeric literals coerce LOSSLESSLY to the declared
+// type (1.0 in an int column -> int 1; 3 in a float column -> 3.0), so a
+// column's token identity never splits on literal spelling — the Python
+// parser applies the identical rule (io/fs.py _json_coerce). Lossy cases
+// (1.5 in an int column, ints beyond 2^53 in a float column) stay
+// literal-faithful / fall back.
+bool json_value_piece(JsonCursor& c, std::string& piece, uint8_t declared) {
     c.ws();
     if (c.p >= c.end) return false;
     char ch = *c.p;
@@ -527,14 +539,25 @@ bool json_value_piece(JsonCursor& c, std::string& piece) {
         char* endp = nullptr;
         double v = std::strtod(tok.c_str(), &endp);
         if (endp != tok.c_str() + tok.size()) return false;
-        piece_float(piece, v);
+        if (declared == TAG_INT && v == static_cast<int64_t>(v) &&
+            v >= -9.007199254740992e15 && v <= 9.007199254740992e15) {
+            piece_int(piece, static_cast<int64_t>(v));
+        } else {
+            piece_float(piece, v);
+        }
     } else {
         errno = 0;
         char* endp = nullptr;
         long long v = std::strtoll(tok.c_str(), &endp, 10);
         if (errno == ERANGE || endp != tok.c_str() + tok.size())
             return false;  // bigint -> Python path
-        piece_int(piece, static_cast<int64_t>(v));
+        if (declared == TAG_FLOAT) {
+            if (v > 9007199254740992ll || v < -9007199254740992ll)
+                return false;  // not losslessly representable -> Python
+            piece_float(piece, static_cast<double>(v));
+        } else {
+            piece_int(piece, static_cast<int64_t>(v));
+        }
     }
     return true;
 }
@@ -542,13 +565,35 @@ bool json_value_piece(JsonCursor& c, std::string& piece) {
 constexpr uint64_t SEQ_SALT_LO = 0xF39CC0605CEDC834ull;
 constexpr uint64_t SEQ_SALT_HI = 0x9E3779B97F4A7C15ull;
 
-// Row finalization shared by json/csv ingest: intern + key.
-inline void finish_row(InternTable* tab, const std::string& row_bytes,
-                       const std::string* pieces, const int64_t* pk_idx,
-                       int64_t n_pk, uint64_t seq_base, uint64_t seq_no,
-                       uint64_t* out_token, uint64_t* out_lo, uint64_t* out_hi) {
-    *out_token = tab->intern_locked(row_bytes.data(),
-                                    static_cast<int64_t>(row_bytes.size()));
+// Pending rows of one ingest call: parsed row bytes are accumulated
+// lock-free; the intern table's mutex is taken ONCE at the end for the
+// whole batch (concurrent chunk parses then overlap almost fully — only
+// the hash-map inserts serialize).
+struct PendingRows {
+    std::string blob;
+    std::vector<std::pair<int64_t, int64_t>> spans;  // (offset, len)
+    std::vector<int64_t> row_idx;                    // output slot
+
+    void add(const std::string& row_bytes, int64_t i) {
+        spans.emplace_back(static_cast<int64_t>(blob.size()),
+                           static_cast<int64_t>(row_bytes.size()));
+        blob += row_bytes;
+        row_idx.push_back(i);
+    }
+
+    void intern_all(InternTable* tab, uint64_t* out_token) {
+        std::unique_lock<std::shared_mutex> g(tab->mu);
+        for (size_t k = 0; k < spans.size(); ++k) {
+            out_token[row_idx[k]] = tab->intern_locked(
+                blob.data() + spans[k].first, spans[k].second);
+        }
+    }
+};
+
+// Key computation shared by json/csv ingest (no lock needed).
+inline void row_key(const std::string* pieces, const int64_t* pk_idx,
+                    int64_t n_pk, uint64_t seq_base, uint64_t seq_no,
+                    uint64_t* out_lo, uint64_t* out_hi) {
     if (n_pk > 0) {
         std::string kb;
         for (int64_t j = 0; j < n_pk; ++j) kb += pieces[pk_idx[j]];
@@ -578,12 +623,13 @@ inline void finish_row(InternTable* tab, const std::string& row_bytes,
 // caller sizes cap by newline count + 1).
 int64_t dp_ingest_jsonl(void* h, const char* data, int64_t len, int64_t n_cols,
                         const char** col_names, const int64_t* col_name_lens,
-                        const int64_t* pk_idx, int64_t n_pk, uint64_t seq_base,
-                        uint64_t seq_start, uint64_t* out_token,
-                        uint64_t* out_lo, uint64_t* out_hi, uint8_t* out_status,
+                        const uint8_t* col_tags, const int64_t* pk_idx,
+                        int64_t n_pk, uint64_t seq_base, uint64_t seq_start,
+                        uint64_t* out_token, uint64_t* out_lo,
+                        uint64_t* out_hi, uint8_t* out_status,
                         int64_t* line_start, int64_t* line_end, int64_t cap) {
     auto* tab = static_cast<InternTable*>(h);
-    std::lock_guard<std::mutex> g(tab->mu);
+    PendingRows pend;
     std::vector<std::string> pieces(static_cast<size_t>(n_cols));
     std::vector<uint8_t> have(static_cast<size_t>(n_cols));
     std::string row_bytes, name;
@@ -634,7 +680,7 @@ int64_t dp_ingest_jsonl(void* h, const char* data, int64_t len, int64_t n_cols,
                     }
                     if (col >= 0) {
                         pieces[col].clear();
-                        if (!json_value_piece(c, pieces[col])) {
+                        if (!json_value_piece(c, pieces[col], col_tags[col])) {
                             ok = false;
                             break;
                         }
@@ -669,11 +715,12 @@ int64_t dp_ingest_jsonl(void* h, const char* data, int64_t len, int64_t n_cols,
             if (!have[j]) piece_none(pieces[j]);  // missing -> None
             row_bytes += pieces[j];
         }
-        finish_row(tab, row_bytes, pieces.data(), pk_idx, n_pk, seq_base,
-                   seq_start + static_cast<uint64_t>(i), &out_token[i],
-                   &out_lo[i], &out_hi[i]);
+        pend.add(row_bytes, i);
+        row_key(pieces.data(), pk_idx, n_pk, seq_base,
+                seq_start + static_cast<uint64_t>(i), &out_lo[i], &out_hi[i]);
         out_status[i] = 0;
     }
+    pend.intern_all(tab, out_token);
     return n_lines;
 }
 
@@ -691,7 +738,7 @@ int64_t dp_ingest_csv(void* h, const char* data, int64_t len, char delim,
                       uint64_t* out_hi, uint8_t* out_status,
                       int64_t* line_start, int64_t* line_end, int64_t cap) {
     auto* tab = static_cast<InternTable*>(h);
-    std::lock_guard<std::mutex> g(tab->mu);
+    PendingRows pend;
     std::vector<std::string> fields;
     std::vector<std::string> pieces(static_cast<size_t>(n_cols));
     std::string row_bytes;
@@ -843,11 +890,12 @@ int64_t dp_ingest_csv(void* h, const char* data, int64_t len, char delim,
         }
         row_bytes.clear();
         for (int64_t j = 0; j < n_cols; ++j) row_bytes += pieces[j];
-        finish_row(tab, row_bytes, pieces.data(), pk_idx, n_pk, seq_base,
-                   seq_start + static_cast<uint64_t>(i), &out_token[i],
-                   &out_lo[i], &out_hi[i]);
+        pend.add(row_bytes, i);
+        row_key(pieces.data(), pk_idx, n_pk, seq_base,
+                seq_start + static_cast<uint64_t>(i), &out_lo[i], &out_hi[i]);
         out_status[i] = 0;
     }
+    pend.intern_all(tab, out_token);
     return n_rec;
 }
 
@@ -862,6 +910,7 @@ int64_t dp_decode_num_cols(void* h, int64_t n, const uint64_t* tokens,
                            const int64_t* col_idx, int64_t n_cols,
                            int64_t* vals_i, double* vals_f, uint8_t* tags) {
     auto* tab = static_cast<InternTable*>(h);
+    std::shared_lock<std::shared_mutex> rg(tab->mu);
     std::vector<const char*> starts(static_cast<size_t>(n_cols));
     std::vector<const char*> ends(static_cast<size_t>(n_cols));
     for (int64_t i = 0; i < n; ++i) {
@@ -899,6 +948,7 @@ int64_t dp_decode_str_cols(void* h, int64_t n, const uint64_t* tokens,
                            int64_t cap, int64_t* off, int64_t* slen,
                            uint8_t* kind) {
     auto* tab = static_cast<InternTable*>(h);
+    std::shared_lock<std::shared_mutex> rg(tab->mu);
     std::vector<const char*> starts(static_cast<size_t>(n_cols));
     std::vector<const char*> ends(static_cast<size_t>(n_cols));
     int64_t used = 0;
@@ -947,12 +997,18 @@ int64_t dp_project_group(void* h, int64_t n, const uint64_t* tokens,
                          int64_t n_shards, uint64_t* out_gtoken,
                          int64_t* out_shard) {
     auto* tab = static_cast<InternTable*>(h);
-    std::lock_guard<std::mutex> g(tab->mu);
     std::vector<const char*> starts(static_cast<size_t>(n_cols));
     std::vector<const char*> ends(static_cast<size_t>(n_cols));
-    std::string gbytes, canon;
-    // per-gtoken shard memo (groups repeat heavily within a batch)
-    std::unordered_map<uint64_t, int64_t> shard_memo;
+    // dedupe group bytes within the batch LOCK-FREE (distinct groups are
+    // typically a small fraction of rows); intern only the distinct set
+    // under one short lock at the end.
+    std::string blob, gbytes, canon;
+    std::unordered_map<std::string_view, int64_t> local;  // gbytes -> gid
+    std::vector<std::pair<int64_t, int64_t>> spans;       // gid -> span
+    std::vector<int64_t> shard_of_gid;
+    std::vector<int64_t> gid_of_row(static_cast<size_t>(n));
+    blob.reserve(1024);
+    std::shared_lock<std::shared_mutex> rg(tab->mu);
     for (int64_t i = 0; i < n; ++i) {
         const char* row;
         int64_t rlen;
@@ -962,15 +1018,32 @@ int64_t dp_project_group(void* h, int64_t n, const uint64_t* tokens,
         gbytes.clear();
         for (int64_t j = 0; j < n_cols; ++j)
             gbytes.append(starts[j], static_cast<size_t>(ends[j] - starts[j]));
-        uint64_t gt = tab->intern_locked(gbytes.data(),
-                                         static_cast<int64_t>(gbytes.size()));
-        out_gtoken[i] = gt;
-        if (n_shards > 0) {
-            auto it = shard_memo.find(gt);
-            if (it != shard_memo.end()) {
-                out_shard[i] = it->second;
-            } else {
-                // serialize the canonicalized VALUE TUPLE: \x07 + len + pieces
+        auto it = local.find(std::string_view(gbytes));
+        int64_t gid;
+        if (it != local.end()) {
+            gid = it->second;
+        } else {
+            gid = static_cast<int64_t>(spans.size());
+            // append to blob; string_view keys must point into the blob,
+            // which may reallocate — rebuild the map when it does
+            const char* before = blob.data();
+            int64_t off = static_cast<int64_t>(blob.size());
+            blob += gbytes;
+            spans.emplace_back(off, static_cast<int64_t>(gbytes.size()));
+            if (blob.data() != before) {
+                local.clear();
+                for (int64_t g2 = 0; g2 < gid; ++g2)
+                    local.emplace(
+                        std::string_view(blob.data() + spans[g2].first,
+                                         static_cast<size_t>(spans[g2].second)),
+                        g2);
+            }
+            local.emplace(
+                std::string_view(blob.data() + spans.back().first,
+                                 static_cast<size_t>(spans.back().second)),
+                gid);
+            if (n_shards > 0) {
+                // serialize the canonicalized VALUE TUPLE: \x07+len+pieces
                 canon.clear();
                 canon.push_back('\x07');
                 put_i64(canon, n_cols);
@@ -979,11 +1052,24 @@ int64_t dp_project_group(void* h, int64_t n, const uint64_t* tokens,
                 uint64_t lo, hi;
                 blake2b_128(reinterpret_cast<const uint8_t*>(canon.data()),
                             canon.size(), &lo, &hi);
-                int64_t s = static_cast<int64_t>(lo % static_cast<uint64_t>(n_shards));
-                shard_memo.emplace(gt, s);
-                out_shard[i] = s;
+                shard_of_gid.push_back(static_cast<int64_t>(
+                    lo % static_cast<uint64_t>(n_shards)));
             }
         }
+        gid_of_row[static_cast<size_t>(i)] = gid;
+    }
+    rg.unlock();
+    std::vector<uint64_t> gtok(spans.size());
+    {
+        std::unique_lock<std::shared_mutex> g(tab->mu);
+        for (size_t k = 0; k < spans.size(); ++k)
+            gtok[k] = tab->intern_locked(blob.data() + spans[k].first,
+                                         spans[k].second);
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t gid = gid_of_row[static_cast<size_t>(i)];
+        out_gtoken[i] = gtok[static_cast<size_t>(gid)];
+        if (n_shards > 0) out_shard[i] = shard_of_gid[static_cast<size_t>(gid)];
     }
     return 0;
 }
@@ -1015,7 +1101,6 @@ int64_t dp_build_rows(void* h, int64_t n, const uint64_t* in_tokens,
                       const double* vals_f, const uint8_t* vtag,
                       uint64_t* out_token, uint8_t* out_status) {
     auto* tab = static_cast<InternTable*>(h);
-    std::lock_guard<std::mutex> g(tab->mu);
     // passthrough columns, ascending for find_cols
     std::vector<int64_t> pass_cols;
     for (int64_t j = 0; j < n_out; ++j)
@@ -1030,6 +1115,8 @@ int64_t dp_build_rows(void* h, int64_t n, const uint64_t* in_tokens,
     std::vector<const char*> starts(sorted_cols.size());
     std::vector<const char*> ends(sorted_cols.size());
     std::string row_bytes;
+    PendingRows pend;
+    std::shared_lock<std::shared_mutex> rg(tab->mu);
     for (int64_t i = 0; i < n; ++i) {
         bool ok = true;
         if (!sorted_cols.empty()) {
@@ -1065,10 +1152,11 @@ int64_t dp_build_rows(void* h, int64_t n, const uint64_t* in_tokens,
             out_token[i] = 0;
             continue;
         }
-        out_token[i] = tab->intern_locked(
-            row_bytes.data(), static_cast<int64_t>(row_bytes.size()));
+        pend.add(row_bytes, i);
         out_status[i] = 0;
     }
+    rg.unlock();
+    pend.intern_all(tab, out_token);
     return 0;
 }
 
@@ -1129,6 +1217,7 @@ int64_t dp_format_csv(void* h, int64_t n, const uint64_t* tokens,
                       char* out, int64_t cap, int64_t* fallback_idx,
                       int64_t* n_fallback) {
     auto* tab = static_cast<InternTable*>(h);
+    std::shared_lock<std::shared_mutex> rg(tab->mu);
     std::string line;
     int64_t used = 0;
     int64_t nfb = 0;
@@ -1293,6 +1382,7 @@ int64_t dp_consolidate(int64_t n, uint64_t* key_lo, uint64_t* key_hi,
 int64_t dp_export_tokens(void* h, int64_t n, uint64_t* tokens, char* blob,
                          int64_t blob_cap, int64_t* ulen, int64_t ulen_cap) {
     auto* tab = static_cast<InternTable*>(h);
+    std::shared_lock<std::shared_mutex> rg(tab->mu);
     std::unordered_map<uint64_t, int64_t> local;
     local.reserve(static_cast<size_t>(n));
     int64_t used = 0;
@@ -1318,7 +1408,7 @@ int64_t dp_export_tokens(void* h, int64_t n, uint64_t* tokens, char* blob,
 int64_t dp_import_tokens(void* h, int64_t n, uint64_t* tokens,
                          const char* blob, const int64_t* ulen, int64_t n_u) {
     auto* tab = static_cast<InternTable*>(h);
-    std::lock_guard<std::mutex> g(tab->mu);
+    std::unique_lock<std::shared_mutex> g(tab->mu);
     std::vector<uint64_t> ids(static_cast<size_t>(n_u));
     int64_t off = 0;
     for (int64_t u = 0; u < n_u; ++u) {

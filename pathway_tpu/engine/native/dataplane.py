@@ -97,7 +97,7 @@ def _load() -> ctypes.CDLL | None:
         lib.dp_ingest_jsonl.restype = c.c_int64
         lib.dp_ingest_jsonl.argtypes = [
             c.c_void_p, c.c_char_p, c.c_int64, c.c_int64,
-            c.POINTER(c.c_char_p), i64p, i64p, c.c_int64,
+            c.POINTER(c.c_char_p), i64p, u8p, i64p, c.c_int64,
             c.c_uint64, c.c_uint64, u64p, u64p, u64p, u8p, i64p, i64p,
             c.c_int64,
         ]
@@ -422,15 +422,18 @@ def ingest_jsonl(
     pk_idx: list[int],
     seq_base: int,
     seq_start: int,
+    col_tags: list[int] | None = None,
 ):
     """Parse a jsonlines chunk. Returns (batch_arrays, statuses,
     line_offsets): tokens/keys are valid where status==0; status==1 lines
-    need the Python fallback parser; 2 = blank."""
+    need the Python fallback parser; 2 = blank. col_tags: declared dtype
+    tag per column (2=int 3=float, 0=any) for lossless literal coercion."""
     lib = _load()
     n_cols = len(col_names)
     name_bufs = [n.encode("utf-8") for n in col_names]
     name_arr = (ctypes.c_char_p * n_cols)(*name_bufs)
     name_lens = np.array([len(b) for b in name_bufs], np.int64)
+    tags = np.asarray(col_tags if col_tags is not None else [0] * n_cols, np.uint8)
     cap = data.count(b"\n") + 2
     out_tok = np.empty(cap, np.uint64)
     out_lo = np.empty(cap, np.uint64)
@@ -442,7 +445,7 @@ def ingest_jsonl(
     n = lib.dp_ingest_jsonl(
         tab._h, data, len(data), n_cols,
         ctypes.cast(name_arr, ctypes.POINTER(ctypes.c_char_p)), name_lens,
-        pk, len(pk_idx), seq_base, seq_start,
+        tags, pk, len(pk_idx), seq_base, seq_start,
         out_tok, out_lo, out_hi, status, ls, le, cap,
     )
     return (

@@ -53,6 +53,13 @@ class InputSession:
                 self._current[key] = row
             self._staged.append((key, row, 1))
 
+    def insert_batch(self, nbatch) -> None:
+        """Stage a token-resident NativeBatch segment whole (plain insert
+        sessions only — upsert bookkeeping is inherently per-row)."""
+        assert not self.upsert_mode
+        with self._lock:
+            self._staged.append(nbatch)
+
     def remove(self, key: Key, row: tuple | None = None) -> None:
         with self._lock:
             if self.upsert_mode:
@@ -217,7 +224,9 @@ class Runtime:
                 t_hint = statics[0][0]
                 while statics and statics[0][0] == t_hint:
                     _t, node, entries = statics.pop(0)
-                    node.push(list(entries))
+                    node.push(
+                        list(entries) if type(entries) is list else entries
+                    )
                     has_data = True
             for c in self.connectors:
                 entries = c.poll()
@@ -417,7 +426,7 @@ class IterateNode(Node):
         released = False
         while self._pending_statics and self._pending_statics[0][0] <= time:
             _t, node, entries = self._pending_statics.pop(0)
-            node.push(list(entries))
+            node.push(list(entries) if type(entries) is list else entries)
             released = True
         return released
 
@@ -503,7 +512,7 @@ class IterateNode(Node):
         released = False
         while self._pending_statics:
             _t, node, entries = self._pending_statics.pop(0)
-            node.push(list(entries))
+            node.push(list(entries) if type(entries) is list else entries)
             released = True
         self.inner_t += 2
         for node in self.sub_graph.nodes:

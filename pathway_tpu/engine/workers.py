@@ -29,7 +29,7 @@ import hashlib
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Iterable, Sequence
 
 from pathway_tpu.engine.core import Entry, Graph, InputNode, Node
 
@@ -61,18 +61,23 @@ def _pool() -> ThreadPoolExecutor:
 
 
 class _Collector:
-    """Duck-typed downstream sink capturing one replica's emits."""
+    """Duck-typed downstream sink capturing one replica's emits (entry
+    lists or NativeBatch segments, kept as segments)."""
 
-    __slots__ = ("entries",)
+    __slots__ = ("segments",)
 
     def __init__(self) -> None:
-        self.entries: list[Entry] = []
+        self.segments: list = []
 
-    def accept(self, input_idx: int, entries: list[Entry]) -> None:
-        self.entries.extend(entries)
+    def accept(self, input_idx: int, entries) -> None:
+        if type(entries) is list:
+            if entries:
+                self.segments.append(entries)
+        else:
+            self.segments.append(entries)
 
-    def take(self) -> list[Entry]:
-        out, self.entries = self.entries, []
+    def take(self) -> list:
+        out, self.segments = self.segments, []
         return out
 
 
@@ -131,10 +136,16 @@ class ShardedNode(Node):
         factory: Callable[[Graph, list[Node]], Node],
         route_fns: Sequence[RouteFn],
         n_shards: int,
+        native_routes: Sequence[Any] | None = None,
     ):
         super().__init__(graph, inputs)
         assert len(route_fns) == len(inputs)
         self.route_fns = list(route_fns)
+        # per input: None, ('key',) — record-key routing — or
+        # ('group', [col_idx...]) — group-key routing; lets NativeBatch
+        # segments split across replicas without materializing (the C
+        # routing is byte-identical to _shard_of, see dataplane.cpp)
+        self.native_routes = list(native_routes or [None] * len(inputs))
         self.n_shards = n_shards
         self.replicas: list[Node] = []
         self.collectors: list[_Collector] = []
@@ -174,26 +185,65 @@ class ShardedNode(Node):
                 touched.append(s)
         return touched
 
+    def _exchange_native(self, input_idx: int, batch: Any) -> list[int]:
+        """Split a NativeBatch across replicas without materializing.
+        Falls back to the object plane when this input has no native
+        route plan or the C routing rejects the batch."""
+        plan = self.native_routes[input_idx]
+        if plan is not None:
+            import numpy as np
+
+            from pathway_tpu.engine.native import dataplane as dp
+
+            if plan[0] == "key":
+                shards = dp.route_key(batch.key_lo, batch.key_hi, self.n_shards)
+            else:  # ("group", [col_idx...])
+                res = dp.project_group(
+                    batch.tab, batch.token, plan[1], n_shards=self.n_shards
+                )
+                shards = None if res is None else res[1]
+            if shards is not None:
+                touched = []
+                for s in np.unique(shards):
+                    sub = batch.select(shards == s)
+                    self.replicas[int(s)].accept(input_idx, sub)
+                    touched.append(int(s))
+                return touched
+        return self._exchange(input_idx, batch.materialize())
+
     def finish_time(self, time: int) -> None:
         active: set[int] = set()
         for i in range(len(self.inputs)):
-            batch = self.take_input(i)
-            if batch:
-                active.update(self._exchange(i, batch))
+            batches, entries = self.take_segments(i)
+            for b in batches:
+                active.update(self._exchange_native(i, b))
+            if entries:
+                active.update(self._exchange(i, entries))
+        if not active:
+            return
+        ordered = sorted(active)
+        if len(ordered) == 1:
+            self.replicas[ordered[0]].finish_time(time)
+        else:
+            futures = [
+                _pool().submit(self.replicas[s].finish_time, time)
+                for s in ordered
+            ]
+            for f in futures:
+                f.result()  # wave barrier; re-raises replica errors
+        self._emit_collected(time, ordered)
+
+    def _emit_collected(self, time: int, shards: Iterable[int]) -> None:
         out: list[Entry] = []
-        if active:
-            ordered = sorted(active)
-            if len(ordered) == 1:
-                self.replicas[ordered[0]].finish_time(time)
-            else:
-                futures = [
-                    _pool().submit(self.replicas[s].finish_time, time)
-                    for s in ordered
-                ]
-                for f in futures:
-                    f.result()  # wave barrier; re-raises replica errors
-            for s in ordered:
-                out.extend(self.collectors[s].take())
+        for s in shards:
+            for seg in self.collectors[s].take():
+                if type(seg) is list:
+                    out.extend(seg)
+                else:
+                    if out:
+                        self.emit(time, out)
+                        out = []
+                    self.emit(time, seg)
         if out:
             self.emit(time, out)
 
@@ -202,12 +252,9 @@ class ShardedNode(Node):
         # emitting here still reaches downstream buffers before they close.
         # (No sharded node type currently implements on_end; this keeps the
         # wrapper correct for any future one.)
-        out: list[Entry] = []
         for s in range(self.n_shards):
             self.replicas[s].on_end(time)
-            out.extend(self.collectors[s].take())
-        if out:
-            self.emit(time, out)
+        self._emit_collected(time, range(self.n_shards))
 
     # ----------------------------------------------- operator snapshots
 

@@ -205,7 +205,13 @@ def reserve_sequential(n: int) -> int:
 def sequential_key(base: int = 0) -> Key:
     """Auto-generated key for rows without a primary key: hash of a sequence
     number (keeps keys uniformly spread over the shard space)."""
-    n = next(_seq_counter)
+    return sequential_key_at(next(_seq_counter), base)
+
+
+def sequential_key_at(n: int, base: int = 0) -> Key:
+    """The key for an explicit sequence number (from reserve_sequential) —
+    the formula the native ingest computes in C++ (dataplane.cpp
+    finish_row)."""
     return Key(_hash_bytes(struct.pack("<QQ", base, n) + _SALT_SEQ.to_bytes(16, "little")))
 
 

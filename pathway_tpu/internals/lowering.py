@@ -161,6 +161,7 @@ class Session:
         inputs: list[eng.Node],
         factory: Callable[[eng.Graph, list[eng.Node]], eng.Node],
         route_fns: list[Callable],
+        native_routes: list | None = None,
     ) -> eng.Node:
         """Build a stateful node, sharded across the session's workers.
 
@@ -170,11 +171,22 @@ class Session:
         inter-process exchange on the same shard keys, so a key's state
         lives on exactly one process (and one thread shard within it).
         Single-worker sessions build the node directly on the main graph.
+
+        `native_routes` lets token-resident batches split across shards in
+        C (engine/workers.py ShardedNode._exchange_native); inputs routed
+        by the record key get the ('key',) plan automatically.
         """
+        if native_routes is None:
+            native_routes = [
+                ("key",) if fn is _route_key else None for fn in route_fns
+            ]
         inputs = self._process_exchange(list(inputs), route_fns)
         if self.n_workers <= 1:
             return factory(self.graph, list(inputs))
-        return ShardedNode(self.graph, inputs, factory, route_fns, self.n_workers)
+        return ShardedNode(
+            self.graph, inputs, factory, route_fns, self.n_workers,
+            native_routes=native_routes,
+        )
 
     # ---------------------------------------------------------------- build
 
@@ -286,6 +298,20 @@ class Session:
                 # each key would arrive N times at its owner
                 return node
             rows = spec.params["rows"]
+            by_time: dict[int, list] = {}
+            for t, key, row, diff in rows:
+                by_time.setdefault(t, []).append((key, row, diff))
+            for t, entries in by_time.items():
+                self.static_batches.append((t, node, entries))
+            return node
+
+        if kind == "static_native":
+            node = eng.InputNode(g)
+            if self.mesh is not None and self.mesh.process_id != 0:
+                return node  # process 0 owns static rows (see "static")
+            for b in spec.params.get("batches", []):
+                self.static_batches.append((0, node, b))
+            rows = spec.params.get("rows", [])
             by_time: dict[int, list] = {}
             for t, key, row, diff in rows:
                 by_time.setdefault(t, []).append((key, row, diff))
@@ -617,13 +643,62 @@ class Session:
             getattr(re_._reducer, "n_args", 1) == 0 or _scalar_numeric(re_)
             for re_ in reducer_exprs
         )
+        # Token-resident batch plan: applies when the group key is a plain
+        # projection of stably-typed scalar columns and every reducer arg
+        # is a column or a numpy-compilable numeric expression. Gated off
+        # FLOAT/ANY group columns: token identity is byte-based, and a
+        # float column may carry int-valued rows (literal-faithful JSON)
+        # that Python dict equality would fold into one group.
+        native_plan = None
+        if native_ok:
+            names = main._column_names()
+            gb_cols: list[int] | None = []
+            for e in gb_exprs:
+                if (
+                    isinstance(e, ex.ColumnReference)
+                    and not isinstance(e, ex.IdReference)
+                    and e.name in names
+                    and main._dtype_of(e.name) in (dt.INT, dt.STR, dt.BOOL)
+                ):
+                    gb_cols.append(names.index(e.name))
+                else:
+                    gb_cols = None
+                    break
+            arg_plans: list | None = []
+            if gb_cols is not None:
+                from pathway_tpu.internals.expression_numpy import compile_numpy
+
+                for re_ in reducer_exprs:
+                    if getattr(re_._reducer, "n_args", 1) == 0:
+                        arg_plans.append(None)
+                        continue
+                    a = re_._args[0]
+                    if (
+                        isinstance(a, ex.ColumnReference)
+                        and not isinstance(a, ex.IdReference)
+                        and a.name in names
+                    ):
+                        arg_plans.append(("col", names.index(a.name)))
+                        continue
+                    plan = compile_numpy(a, names)
+                    if plan is None:
+                        arg_plans = None
+                        break
+                    arg_plans.append(("numpy", plan))
+            if gb_cols is not None and arg_plans is not None:
+                native_plan = {"gb_cols": gb_cols, "arg_plans": arg_plans}
+        plan_for_node = native_plan
         gnode = self._sharded(
             [self.node_of(main)],
             lambda sg, ins: eng.GroupByNode(
-                sg, ins[0], gk_fn, reducers, arg_fns, native_ok=native_ok
+                sg, ins[0], gk_fn, reducers, arg_fns, native_ok=native_ok,
+                native_plan=plan_for_node,
             ),
             # exchange on the group key: every group's rows meet in one worker
             [lambda key, row: eng.freeze_value(gk_fn(key, row))],
+            native_routes=[
+                ("group", native_plan["gb_cols"]) if native_plan else None
+            ],
         )
         # post-processing rowwise over (gvals..., rvals...)
         reducer_slots = {

@@ -22,9 +22,263 @@ from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals import universe as univ
 from pathway_tpu.internals.datasink import CallbackDataSink
 from pathway_tpu.internals.json import Json
-from pathway_tpu.internals.keys import key_for_values, sequential_key
+from pathway_tpu.internals.keys import (
+    Key,
+    key_for_values,
+    reserve_sequential,
+    sequential_key,
+    sequential_key_at,
+)
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import OpSpec, Table
+
+
+# -------------------------------------------------- native (token) ingest
+#
+# When the schema is representable in the native data plane, files parse in
+# C++ (engine/native/dataplane.cpp): rows intern to tokens, keys hash in
+# C, and the engine receives NativeBatch segments instead of per-row Python
+# tuples. Lines the C parser rejects (nested JSON values, bigints, …) fall
+# back to the Python parser row by row — both kinds share one key sequence.
+# Reference: the Rust reader + parser chain, src/connectors/scanner/
+# filesystem.rs + data_format.rs, which likewise never surfaces per-row
+# objects to the host language.
+
+
+def _native_info(format: str, schema, csv_settings, with_metadata: bool):  # noqa: A002
+    if with_metadata or format not in ("json", "jsonlines", "csv"):
+        return None
+    try:
+        from pathway_tpu.engine.native import dataplane as dp
+    except Exception:  # noqa: BLE001
+        return None
+    if not dp.available():
+        return None
+    names = list(schema.__columns__)
+    pk = schema.primary_key_columns() or []
+    info: dict[str, Any] = {
+        "dp": dp,
+        "names": names,
+        "pk_idx": [names.index(c) for c in pk],
+        "pk": pk,
+        "schema": schema,
+    }
+    if format in ("json", "jsonlines"):
+        info["kind"] = "json"
+        # declared dtype tags for lossless literal coercion in C
+        jt = []
+        for n in names:
+            base = dt.unoptionalize(schema.__columns__[n].dtype)
+            jt.append(2 if base == dt.INT else 3 if base == dt.FLOAT else 0)
+        info["json_tags"] = jt
+        return info
+    # csv needs a native _coerce plan for every column
+    tags, opts = [], []
+    for n in names:
+        d = schema.__columns__[n].dtype
+        base = dt.unoptionalize(d)
+        tag = {dt.INT: 2, dt.FLOAT: 3, dt.BOOL: 1, dt.STR: 4}.get(base)
+        if tag is None and base == getattr(dt, "ANY", None):
+            tag = 4  # _coerce leaves unknown dtypes as the raw string
+        if tag is None:
+            return None
+        tags.append(tag)
+        opts.append(isinstance(d, dt.Optional))
+    delim = getattr(csv_settings, "delimiter", ",") if csv_settings else ","
+    if len(delim) != 1:
+        return None
+    info.update(kind="csv", dtypes=tags, optional=opts, delim=delim.encode())
+    return info
+
+
+def _py_fallback_row(info: dict, line: bytes):
+    """Python parse of one line the C parser rejected; returns a row tuple
+    or None (unparseable -> logged upstream semantics: skip)."""
+    names = info["names"]
+    schema = info["schema"]
+    if info["kind"] == "json":
+        try:
+            rec = _json.loads(line.decode("utf-8", errors="replace"))
+        except (ValueError, UnicodeDecodeError) as e:
+            from pathway_tpu.internals.errors import global_error_log
+
+            global_error_log().log(f"fs.read json parse error: {e}")
+            return None
+        if not isinstance(rec, dict):
+            from pathway_tpu.internals.errors import global_error_log
+
+            global_error_log().log(
+                "fs.read: json line is not an object; row skipped"
+            )
+            return None
+        row = []
+        for n in names:
+            v = rec.get(n)
+            if isinstance(v, (dict, list)):
+                v = Json(v)
+            else:
+                v = _json_coerce(v, schema.__columns__[n].dtype)
+            row.append(v)
+        return tuple(row)
+    # csv
+    from pathway_tpu.engine import native as zs
+
+    fields = zs.split_csv_line(line, info["delim"])
+    field_idx = info["field_idx"]
+    row = []
+    for j, n in enumerate(names):
+        fi = field_idx[j]
+        v = fields[fi] if 0 <= fi < len(fields) else None
+        row.append(_coerce(v, schema.__columns__[n].dtype) if v is not None else None)
+    return tuple(row)
+
+
+def _chunk_bodies(path: str, info: dict):
+    """Yield record-aligned chunk bodies of one file (serial IO +
+    boundary alignment; the CPU-heavy parse runs elsewhere). Consumes the
+    CSV header and fills info['field_idx'] as a side effect."""
+    names = info["names"]
+    is_csv = info["kind"] == "csv"
+    CHUNK = 4 << 20
+    with open(path, "rb") as f:
+        pending = b""
+        header_done = not is_csv
+        while True:
+            chunk = f.read(CHUNK)
+            eof = not chunk
+            data = pending + chunk
+            pending = b""
+            if not data:
+                return
+            if not header_done:
+                # first record is the header (quoted newlines in headers
+                # are not supported by the chunked reader)
+                nl = data.find(b"\n")
+                if nl < 0:
+                    if not eof:
+                        pending = data
+                        continue
+                    nl = len(data)
+                from pathway_tpu.engine import native as zs
+
+                hdr = data[:nl].rstrip(b"\r")
+                cols = zs.split_csv_line(hdr, info["delim"])
+                col_pos = {h: i for i, h in enumerate(cols)}
+                info["field_idx"] = [col_pos.get(n, -1) for n in names]
+                data = data[nl + 1 :] if nl < len(data) else b""
+                header_done = True
+                if not data:
+                    if eof:
+                        return
+                    continue
+            if not eof:
+                if is_csv:
+                    from pathway_tpu.engine import native as zs
+
+                    starts, _ends = zs.split_csv_records(data)
+                    if len(starts) <= 1:
+                        pending = data
+                        continue
+                    cut = int(starts[-1])
+                else:
+                    cut = data.rfind(b"\n") + 1
+                    if cut == 0:
+                        pending = data
+                        continue
+                body, pending = data[:cut], data[cut:]
+            else:
+                body = data
+            if body:
+                yield body
+            if eof:
+                return
+
+
+def _parse_body(info: dict, tab, body: bytes, seq_start: int):
+    """CPU part of one chunk (GIL-released C call). Returns
+    (NativeBatch|None, fallback entries). A chunk containing ANY Python-
+    fallback line is emitted entirely as entries, in file order — the
+    event order a resuming run re-derives must not depend on whether the
+    native parser was available (persistence count-skip resume)."""
+    import numpy as np
+
+    dp = info["dp"]
+    pk_idx = info["pk_idx"]
+    if info["kind"] == "csv":
+        (lo, hi, tok), status, (ls, le) = dp.ingest_csv(
+            tab, body, info["field_idx"], info["dtypes"],
+            info["optional"], pk_idx, 0, seq_start, info["delim"],
+        )
+    else:
+        (lo, hi, tok), status, (ls, le) = dp.ingest_jsonl(
+            tab, body, info["names"], pk_idx, 0, seq_start,
+            info.get("json_tags"),
+        )
+    ok = status == 0
+    if not (status == 1).any():
+        batch = None
+        if ok.any():
+            batch = dp.NativeBatch(
+                tab,
+                np.ascontiguousarray(lo[ok]),
+                np.ascontiguousarray(hi[ok]),
+                np.ascontiguousarray(tok[ok]),
+                np.ones(int(ok.sum()), np.int64),
+            )
+        return batch, []
+    entries = []
+    for i in range(len(status)):
+        if status[i] == 2:
+            continue  # blank line
+        if status[i] == 0:
+            key = Key((int(hi[i]) << 64) | int(lo[i]))
+            entries.append((key, tab.row(int(tok[i]))))
+            continue
+        row = _py_fallback_row(info, body[ls[i] : le[i]])
+        if row is None:
+            continue
+        if pk_idx:
+            key = key_for_values(*[row[j] for j in pk_idx])
+        else:
+            key = sequential_key_at(seq_start + int(i))
+        entries.append((key, row))
+    return None, entries
+
+
+def _native_parse_file(path: str, info: dict, tab, emit_batch, emit_entry):
+    """Chunked native parse of one file: complete records go through the C
+    parser as NativeBatch segments; rejected lines re-parse in Python.
+    Chunks parse CONCURRENTLY on the worker pool (the C parser releases
+    the GIL), a window at a time, emitted in file order.
+    emit_batch(NativeBatch); emit_entry((key, row))."""
+    from pathway_tpu.engine.workers import _pool, worker_threads
+
+    pk_idx = info["pk_idx"]
+
+    window = max(2, worker_threads())
+    pool = _pool() if window > 2 else None
+    inflight: list = []
+
+    def flush_one() -> None:
+        batch, entries = inflight.pop(0).result() if pool else inflight.pop(0)
+        if batch is not None:
+            emit_batch(batch)
+        for e in entries:
+            emit_entry(e)
+
+    for body in _chunk_bodies(path, info):
+        # reserve the key range HERE so sequence ranges follow file order
+        # regardless of pool scheduling
+        n_cap = body.count(b"\n") + (0 if body.endswith(b"\n") else 1)
+        seq_start = reserve_sequential(max(n_cap, 1)) if not pk_idx else 0
+        if pool is not None:
+            inflight.append(pool.submit(_parse_body, info, tab, body, seq_start))
+        else:
+            inflight.append(_parse_body(info, tab, body, seq_start))
+        if len(inflight) >= window:
+            flush_one()
+    while inflight:
+        flush_one()
 
 
 def _list_files(path: str) -> list[str]:
@@ -39,6 +293,19 @@ def _list_files(path: str) -> list[str]:
     if os.path.exists(path):
         return [path]
     return []
+
+
+def _json_coerce(v: Any, dtype: dt.DType) -> Any:
+    """Lossless literal-to-schema coercion for JSON values: 1.0 in an int
+    column becomes int 1; 3 in a float column becomes 3.0. Keeps token
+    identity stable across literal spellings — byte-identical rule to the
+    native parser (dataplane.cpp json_value_piece)."""
+    base = dt.unoptionalize(dtype)
+    if base == dt.INT and type(v) is float and v.is_integer() and abs(v) <= float(1 << 53):
+        return int(v)
+    if base == dt.FLOAT and type(v) is int and abs(v) <= 1 << 53:
+        return float(v)
+    return v
 
 
 def _coerce(value: str, dtype: dt.DType) -> Any:
@@ -178,7 +445,15 @@ def _parse_file(
                 line = line.strip()
                 if not line:
                     continue
-                rec = _json.loads(line)
+                try:
+                    rec = _json.loads(line)
+                except ValueError as e:
+                    from pathway_tpu.internals.errors import global_error_log
+
+                    global_error_log().log(
+                        f"fs.read json parse error in {path}: {e}"
+                    )
+                    continue
                 row = {}
                 for n in names:
                     if n == "_metadata":
@@ -186,6 +461,8 @@ def _parse_file(
                     v = rec.get(n)
                     if isinstance(v, (dict, list)):
                         v = Json(v)
+                    else:
+                        v = _json_coerce(v, schema.__columns__[n].dtype)
                     row[n] = v
                 if with_metadata:
                     row["_metadata"] = meta
@@ -221,7 +498,23 @@ def read(
     names = list(schema.__columns__)
     pk = schema.primary_key_columns()
 
+    native_info = _native_info(format, schema, csv_settings, with_metadata)
+
     if mode == "static":
+        if native_info is not None:
+            from pathway_tpu.engine.native import dataplane as dp
+
+            tab = dp.default_table()
+            batches: list = []
+            data: list = []
+            for f in _list_files(path):
+                _native_parse_file(
+                    f, native_info, tab,
+                    batches.append,
+                    lambda kr: data.append((0, kr[0], kr[1], 1)),
+                )
+            spec = OpSpec("static_native", [], rows=data, batches=batches)
+            return Table(spec, schema, univ.Universe())
         rows = []
         for f in _list_files(path):
             for rec in _parse_file(f, format, schema, csv_settings, with_metadata):
@@ -235,6 +528,13 @@ def read(
     def factory(session: InputSession) -> ThreadConnector:
         def run_fn(sess: InputSession) -> None:
             seen: dict[str, float] = {}
+            # token-resident chunked reads need plain insert sessions
+            # (upsert bookkeeping is per-row) and no journaling wrapper
+            use_native = native_info is not None and not sess.upsert_mode
+            if use_native:
+                from pathway_tpu.engine.native import dataplane as dp
+
+                tab = dp.default_table()
             while True:
                 for f in _list_files(path):
                     try:
@@ -244,6 +544,13 @@ def read(
                     if seen.get(f) == mtime:
                         continue
                     seen[f] = mtime
+                    if use_native:
+                        _native_parse_file(
+                            f, native_info, tab,
+                            sess.insert_batch,
+                            lambda kr: sess.insert(kr[0], kr[1]),
+                        )
+                        continue
                     for rec in _parse_file(f, format, schema, csv_settings, with_metadata):
                         row = tuple(rec.get(n) for n in names)
                         key = (

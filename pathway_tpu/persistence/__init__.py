@@ -619,6 +619,19 @@ def attach_persistence(session: Any, config: Config) -> None:
                     out.append((Key(kv), row, diff))
                 self.tail = []
             live = self.inner.poll()
+            # token-resident segments journal via the object plane (the
+            # journal format is per-event); native speed returns once the
+            # source seeks by offset frontier instead of journaling
+            if any(type(seg) is not tuple for seg in live):
+                flat: list = []
+                for seg in live:
+                    if type(seg) is tuple:
+                        flat.append(seg)
+                    else:
+                        flat.extend(
+                            (k, row, d) for (k, row, d) in seg.materialize()
+                        )
+                live = flat
             wrote = False
             for (key, row, diff) in live:
                 self._seen += 1

@@ -324,3 +324,18 @@ def test_wire_roundtrip_across_tables():
     rb = dp.NativeBatch.from_wire(wire, tab_b)
     assert [r for _k, r, _d in rb.materialize()] == rows
     assert list(rb.key_lo) == list(b.key_lo)
+
+
+def test_ingest_jsonl_schema_coercion():
+    """Literal spelling must not split token identity: 1.0 in an int
+    column coerces to int 1; 3 in a float column to 3.0 (same rule as
+    io.fs._json_coerce)."""
+    tab = dp.InternTable()
+    data = b'{"i": 1, "f": 3}\n{"i": 1.0, "f": 3.0}\n{"i": 1.5, "f": 2}\n'
+    (_, _, tok), status, _ = dp.ingest_jsonl(
+        tab, data, ["i", "f"], [], 0, 0, col_tags=[2, 3]
+    )
+    assert list(status) == [0, 0, 0]
+    assert tok[0] == tok[1]  # coerced to identical rows
+    assert tab.row(int(tok[0])) == (1, 3.0)
+    assert tab.row(int(tok[2])) == (1.5, 2.0)  # lossy int stays float
