@@ -479,15 +479,132 @@ class RowwiseNode(Node):
         return self._main_state_
 
 
-class FilterNode(Node):
-    def __init__(self, graph: Graph, inp: Node, predicate: Callable[[Key, tuple], Any]):
+def decode_cols_dict(dp_mod, tab, tokens, sorted_cols: list[int]):
+    """Shared batch-column decode for native-plan nodes: col idx ->
+    (vals_i, vals_f, tags) with boolness-preserving tags (0 int, 1 float,
+    2 bad, 3 bool). None = malformed batch (caller materializes)."""
+    if not sorted_cols:
+        return {}
+    dec = dp_mod.decode_num_cols(tab, tokens, sorted_cols)
+    if dec is None:
+        return None
+    vi, vf, tg = dec
+    return {c: (vi[j], vf[j], tg[j]) for j, c in enumerate(sorted_cols)}
+
+
+class MapNode(Node):
+    """Stateless per-row map with key passthrough — the token-resident
+    select. Unlike RowwiseNode it keeps NO emitted-state: an update stream
+    (k, old, -1), (k, new, +1) maps to the corresponding output pair,
+    exactly like the reference's map operators (differential `map` does
+    not suppress unchanged outputs either). Lowering uses it only on
+    native-plane tables, where every expression has a vectorized plan.
+
+    native_plan: {"specs": [("col", src_idx) | ("val", slot)],
+                  "plans": [NumpyPlan per slot], "needed_cols": [ints]}.
+    Rows a plan flags BAD fall back to the per-row compiled fn, which
+    reproduces exact Python semantics (ERROR poison + error log).
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        fn: Callable[[Key, tuple], tuple],
+        native_plan: dict | None = None,
+    ):
         super().__init__(graph, [inp])
-        self.predicate = predicate
+        self.fn = fn
+        self._plan = native_plan if _nb_type() is not None else None
+        if self._plan is not None:
+            from pathway_tpu.engine.native import dataplane as _dp
+
+            self._dp = _dp
+
+    def _map_batch(self, time: int, batch) -> None:
+        plan = self._plan
+        n = len(batch)
+        decoded = decode_cols_dict(
+            self._dp, batch.tab, batch.token, plan["needed_cols"]
+        )
+        if decoded is None:
+            self._map_entries(time, batch.materialize())
+            return
+        n_slots = len(plan["plans"])
+        vals_i = np.zeros((max(n_slots, 1), n), np.int64)
+        vals_f = np.zeros((max(n_slots, 1), n), np.float64)
+        vtag = np.zeros((max(n_slots, 1), n), np.uint8)
+        for s, p in enumerate(plan["plans"]):
+            vi, vf, tg = p.eval_map(decoded, n)
+            vals_i[s] = vi
+            vals_f[s] = vf
+            vtag[s] = tg
+        out_tok, status = self._dp.build_rows(
+            batch.tab, batch.token, plan["specs"], vals_i, vals_f, vtag
+        )
+        ok = status == 0
+        if ok.all():
+            self.emit(
+                time,
+                self._dp.NativeBatch(
+                    batch.tab, batch.key_lo, batch.key_hi, out_tok, batch.diff
+                ),
+            )
+            return
+        if ok.any():
+            nb = batch.select(ok)
+            self.emit(
+                time,
+                self._dp.NativeBatch(
+                    batch.tab, nb.key_lo, nb.key_hi,
+                    np.ascontiguousarray(out_tok[ok]), nb.diff,
+                ),
+            )
+        # BAD rows: exact per-row Python semantics
+        self._map_entries(time, batch.select(~ok).materialize())
+
+    def _map_entries(self, time: int, entries: list[Entry]) -> None:
+        out: list[Entry] = []
+        for key, row, diff in entries:
+            out.append((key, self.fn(key, row), diff))
+        self.emit(time, out)
 
     def finish_time(self, time: int) -> None:
-        entries = self.take_input()
-        if not entries:
+        if self._plan is not None:
+            batches, entries = self.take_segments()
+            for b in batches:
+                self._map_batch(time, b)
+            if entries:
+                self._map_entries(time, entries)
             return
+        entries = self.take_input()
+        if entries:
+            self._map_entries(time, entries)
+
+
+class FilterNode(Node):
+    """Predicate filter. `native_plan` (a NumpyPlan for the condition)
+    lets token-resident batches filter by mask; rows the plan can't judge
+    (BAD) re-evaluate per row — matching the Python path's ERROR-to-False
+    + error-log behavior."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        predicate: Callable[[Key, tuple], Any],
+        native_plan=None,
+    ):
+        super().__init__(graph, [inp])
+        self.predicate = predicate
+        self._plan = native_plan if _nb_type() is not None else None
+        if self._plan is not None:
+            from pathway_tpu.engine.native import dataplane as _dp
+
+            self._dp = _dp
+            self._sorted_cols = sorted(self._plan.needed_cols)
+
+    def _filter_entries(self, time: int, entries: list[Entry]) -> None:
         out = []
         for key, row, diff in entries:
             try:
@@ -501,6 +618,29 @@ class FilterNode(Node):
             if keep:
                 out.append((key, row, diff))
         self.emit(time, out)
+
+    def finish_time(self, time: int) -> None:
+        if self._plan is not None:
+            batches, entries = self.take_segments()
+            for b in batches:
+                decoded = decode_cols_dict(
+                    self._dp, b.tab, b.token, self._sorted_cols
+                )
+                if decoded is None:
+                    self._filter_entries(time, b.materialize())
+                    continue
+                keep, bad = self._plan.eval_mask(decoded, len(b))
+                if keep.any():
+                    self.emit(time, b.select(keep))
+                if bad.any():
+                    self._filter_entries(time, b.select(bad).materialize())
+            if entries:
+                self._filter_entries(time, entries)
+            return
+        entries = self.take_input()
+        if not entries:
+            return
+        self._filter_entries(time, entries)
 
 
 class ReindexNode(Node):
@@ -1157,13 +1297,9 @@ class GroupByNode(Node):
             {p[1] for p in col_plans if p[0] == "col"}
             | {c for p in col_plans if p[0] == "numpy" for c in p[1].needed_cols}
         )
-        decoded = {}
-        if need_cols:
-            dec = self._dp.decode_num_cols(self._tab, batch.token, need_cols)
-            if dec is None:
-                return False
-            vi_c, vf_c, tg_c = dec
-            decoded = {c: (vi_c[j], vf_c[j], tg_c[j]) for j, c in enumerate(need_cols)}
+        decoded = decode_cols_dict(self._dp, self._tab, batch.token, need_cols)
+        if decoded is None:
+            return False
         vals_i = np.zeros((n_red, n), np.int64)
         vals_f = np.zeros((n_red, n), np.float64)
         tags = np.zeros((n_red, n), np.uint8)
@@ -1172,6 +1308,8 @@ class GroupByNode(Node):
                 continue  # count
             if p[0] == "col":
                 vi, vf, tg = decoded[p[1]]
+                # fold the boolness tag back to int for zs_agg
+                tg = np.where(tg == 3, 0, tg).astype(np.uint8)
             else:  # ("numpy", NumpyPlan)
                 vi, vf, tg = p[1].eval(decoded, n)
             vals_i[ri] = vi

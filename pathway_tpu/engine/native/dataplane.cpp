@@ -901,10 +901,12 @@ int64_t dp_ingest_csv(void* h, const char* data, int64_t len, char delim,
 
 // ------------------------------------------------------------ decode / agg
 
-// Decode numeric columns into the zs_agg value layout: per (col j, row i)
-// tags[j*n+i]: 0 = int64 (vals_i), 1 = double (vals_f), 2 = other
-// (None / str / malformed -> the aggregation error bucket). Bools decode
-// as ints (Python arithmetic semantics). Returns 0, or -1-row_index of the
+// Decode numeric columns: per (col j, row i) tags[j*n+i]: 0 = int64
+// (vals_i), 1 = double (vals_f), 2 = other (None / str / malformed ->
+// the aggregation error bucket), 3 = BOOL (vals_i 0/1 — int semantics
+// for arithmetic, but the boolness is preserved so vectorized & | ^
+// can emit bool-typed results like the Python plane). Callers feeding
+// zs_agg must fold tag 3 -> 0 first. Returns 0, or -1-row_index of the
 // first malformed row.
 int64_t dp_decode_num_cols(void* h, int64_t n, const uint64_t* tokens,
                            const int64_t* col_idx, int64_t n_cols,
@@ -931,7 +933,7 @@ int64_t dp_decode_num_cols(void* h, int64_t n, const uint64_t* tokens,
                 tags[o] = 1;
             } else if (tag == TAG_BOOL) {
                 vals_i[o] = p[1] ? 1 : 0;
-                tags[o] = 0;
+                tags[o] = 3;
             } else {
                 tags[o] = 2;
             }

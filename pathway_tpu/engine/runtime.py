@@ -648,22 +648,23 @@ class OutputNode(Node):
         write_batch: Callable[[int, list[Entry]], None],
         flush: Callable[[], None] | None = None,
         close: Callable[[], None] | None = None,
+        write_native: Callable[[int, Any], None] | None = None,
     ):
         super().__init__(graph, [inp])
         self.write_batch = write_batch
         self.flush = flush
         self.close = close
+        # optional token-resident fast path: write_native(time, NativeBatch)
+        # formats whole batches in C (e.g. the csv writer); sinks without
+        # it get materialized entries as before
+        self.write_native = write_native
         self._closed = False
 
-    def finish_time(self, time: int) -> None:
-        entries = self.take_input()
-        if not entries:
-            return
-        batch = consolidate(entries)
+    def _write_retrying(self, fn, time: int, payload) -> None:
         last_err: Exception | None = None
         for _attempt in range(self.RETRIES):
             try:
-                self.write_batch(time, batch)
+                fn(time, payload)
                 if self.flush is not None:
                     self.flush()
                 return
@@ -671,6 +672,21 @@ class OutputNode(Node):
                 last_err = e
                 _time.sleep(0.01)
         self.log_error(f"output failed after {self.RETRIES} retries: {last_err}")
+
+    def finish_time(self, time: int) -> None:
+        if self.write_native is not None:
+            batches, entries = self.take_segments()
+            for b in batches:
+                if not b.is_distinct_insert():
+                    b = b.consolidate()
+                self._write_retrying(self.write_native, time, b)
+            if entries:
+                self._write_retrying(self.write_batch, time, consolidate(entries))
+            return
+        entries = self.take_input()
+        if not entries:
+            return
+        self._write_retrying(self.write_batch, time, consolidate(entries))
 
     def on_end(self, time: int) -> None:
         if not self._closed and self.close is not None:

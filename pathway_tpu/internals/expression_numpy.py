@@ -83,10 +83,11 @@ class NumpyPlan:
 def _leaf_col(idx: int) -> Callable:
     def fn(decoded, n):
         vi, vf, tg = decoded[idx]
-        isint = tg == 0
+        isbool = tg == 3  # decode preserves boolness (dataplane tag 3)
+        isint = (tg == 0) | isbool
         bad = tg == 2
         vf_full = np.where(isint, vi.astype(np.float64), vf)
-        return _V(vf_full, vi, isint, np.zeros(n, bool), bad)
+        return _V(vf_full, vi, isint, isbool, bad)
 
     return fn
 

@@ -127,6 +127,10 @@ class Session:
         self._session_seq = next(_session_ids)
         self._connector_seq = 0
         self._exchange_seq = 0
+        # spec ids whose engine nodes emit token-resident NativeBatch
+        # segments (native fs sources and the map/filter nodes downstream
+        # of them) — drives MapNode/FilterNode plan selection
+        self._native_specs: set[int] = set()
 
     def _next_wire_id(self) -> int:
         """Cross-process-stable, cross-session-unique exchange channel id:
@@ -265,6 +269,61 @@ class Session:
         fns = [compile_expression(e, resolver) for e in exprs.values()]
         return input_nodes, self._guarded_row_fn(fns, trace)
 
+    def _try_native_map(
+        self, main: Table, exprs: dict, spec: OpSpec
+    ) -> eng.Node | None:
+        """Select on a native-plane table whose expressions are all plain
+        column projections or vectorizable numerics lowers to a stateless
+        MapNode: rows stay token-resident (keys pass through, new rows
+        build in C), with no sharded exchange at all. Returns None when
+        the shape doesn't qualify (general RowwiseNode path)."""
+        main_node = self.node_of(main)  # building it registers native-ness
+        if main._spec.id not in self._native_specs:
+            return None
+        expr_list = list(exprs.values())
+        side = [
+            t
+            for t in referenced_tables(expr_list)
+            if isinstance(t, Table) and t is not main
+        ]
+        if side or _collect_async(expr_list):
+            return None
+        from pathway_tpu.internals.expression_numpy import compile_numpy
+
+        names = main._column_names()
+        specs: list = []
+        plans: list = []
+        needed: set[int] = set()
+        for e in exprs.values():
+            if (
+                isinstance(e, ex.ColumnReference)
+                and not isinstance(e, ex.IdReference)
+                and e.name in names
+            ):
+                specs.append(("col", names.index(e.name)))
+                continue
+            plan = compile_numpy(e, names)
+            if plan is None:
+                return None
+            specs.append(("val", len(plans)))
+            plans.append(plan)
+            needed |= plan.needed_cols
+        resolver = Resolver([main])
+        fns = [compile_expression(e, resolver) for e in exprs.values()]
+        grf = self._guarded_row_fn(fns, getattr(spec, "trace", None))
+        node = eng.MapNode(
+            self.graph,
+            main_node,
+            lambda key, row: grf(key, row),
+            native_plan={
+                "specs": specs,
+                "plans": plans,
+                "needed_cols": sorted(needed),
+            },
+        )
+        self._native_specs.add(spec.id)
+        return node
+
     def _build_async_node(self, main: Table, ae: ex.AsyncApplyExpression) -> eng.Node:
         resolver = Resolver([main])
         arg_fns = [compile_expression(a, resolver) for a in ae._args]
@@ -307,6 +366,7 @@ class Session:
 
         if kind == "static_native":
             node = eng.InputNode(g)
+            self._native_specs.add(spec.id)
             if self.mesh is not None and self.mesh.process_id != 0:
                 return node  # process 0 owns static rows (see "static")
             for b in spec.params.get("batches", []):
@@ -321,6 +381,8 @@ class Session:
 
         if kind == "connector":
             node = eng.InputNode(g)
+            if spec.params.get("native_plane"):
+                self._native_specs.add(spec.id)
             ordinal = self._connector_seq
             self._connector_seq += 1
             if self.mesh is not None and ordinal % self.mesh.n != self.mesh.process_id:
@@ -344,7 +406,11 @@ class Session:
 
         if kind == "rowwise":
             exprs = spec.params["exprs"]
-            input_nodes, fn = self._compile_rowwise(spec.inputs[0], exprs, trace=spec.trace)
+            main = spec.inputs[0]
+            node = self._try_native_map(main, exprs, spec)
+            if node is not None:
+                return node
+            input_nodes, fn = self._compile_rowwise(main, exprs, trace=spec.trace)
             return self._sharded(
                 input_nodes,
                 lambda sg, ins: eng.RowwiseNode(sg, ins, fn),
@@ -360,8 +426,17 @@ class Session:
             if not side and not _collect_async([cond]):
                 resolver = Resolver([main])
                 cf = compile_expression(cond, resolver)
+                native_plan = None
+                main_node = self.node_of(main)
+                if main._spec.id in self._native_specs:
+                    from pathway_tpu.internals.expression_numpy import compile_numpy
+
+                    native_plan = compile_numpy(cond, main._column_names())
+                    if native_plan is not None:
+                        self._native_specs.add(spec.id)
                 return eng.FilterNode(
-                    g, self.node_of(main), lambda key, row: cf(key, (row,))
+                    g, main_node, lambda key, row: cf(key, (row,)),
+                    native_plan=native_plan,
                 )
             # general case: compute condition as an extra aligned column
             names = main._column_names()
@@ -843,8 +918,14 @@ class Session:
 
         SubscribeNode(self.graph, self.node_of(table), on_change, on_time_end, on_end)
 
-    def output(self, table: Table, write_batch: Callable, flush=None, close=None) -> None:
-        OutputNode(self.graph, self.node_of(table), write_batch, flush, close)
+    def output(
+        self, table: Table, write_batch: Callable, flush=None, close=None,
+        write_native: Callable | None = None,
+    ) -> None:
+        OutputNode(
+            self.graph, self.node_of(table), write_batch, flush, close,
+            write_native=write_native,
+        )
 
     def execute(self) -> None:
         runtime = Runtime(self.graph, autocommit_ms=self.autocommit_ms)

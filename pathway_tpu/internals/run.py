@@ -44,6 +44,7 @@ def run(
                 sink.params["write_batch"],
                 sink.params.get("flush"),
                 sink.params.get("close"),
+                write_native=sink.params.get("write_native"),
             )
         else:
             raise ValueError(f"unknown sink kind {sink.kind}")

@@ -501,7 +501,10 @@ def read(
     native_info = _native_info(format, schema, csv_settings, with_metadata)
 
     if mode == "static":
-        if native_info is not None:
+        # pk sources keep the object plane: duplicate-pk rows rely on the
+        # keyed RowwiseNode's last-write-wins, which the stateless native
+        # map path deliberately doesn't reproduce
+        if native_info is not None and not pk:
             from pathway_tpu.engine.native import dataplane as dp
 
             tab = dp.default_table()
@@ -563,7 +566,10 @@ def read(
 
         return ThreadConnector(name or f"fs:{path}", session, run_fn)
 
-    spec = OpSpec("connector", [], factory=factory, upsert=pk is not None, name=name)
+    spec = OpSpec(
+        "connector", [], factory=factory, upsert=pk is not None, name=name,
+        native_plane=native_info is not None and not pk,
+    )
     return Table(spec, schema, univ.Universe())
 
 
@@ -595,6 +601,31 @@ class _FileWriter:
             else:  # plaintext
                 self._file.write(str(row[0]) + "\n")
 
+    def native_writer(self):
+        """write_native(time, NativeBatch) when this format has a C
+        formatter (csv only), else None."""
+        if self.format != "csv":
+            return None
+        try:
+            from pathway_tpu.engine.native import dataplane as dp
+        except Exception:  # noqa: BLE001
+            return None
+        if not dp.available():
+            return None
+
+        def write_native(time: int, batch) -> None:
+            assert self._file is not None
+            data, fallback = dp.format_csv(batch.tab, batch.token, batch.diff, time)
+            # csv.writer owns the text stream; route bytes through it as
+            # a single pre-formatted blob to keep one file handle
+            self._file.flush()
+            self._file.buffer.write(data) if hasattr(self._file, "buffer") else self._file.write(data.decode("utf-8"))
+            if len(fallback):
+                sub = batch.select(fallback)
+                self.write(time, sub.materialize())
+
+        return write_native
+
     def flush(self) -> None:
         if self._file:
             self._file.flush()
@@ -616,4 +647,5 @@ def write(table: Table, filename: str | os.PathLike, *, format: str = "csv", **k
         write_batch=lambda time, entries: writer.write(time, entries),
         flush=writer.flush,
         close=writer.close,
+        write_native=writer.native_writer(),
     )

@@ -170,7 +170,8 @@ def test_decode_num_cols():
     assert list(vi[0][:2]) == [1, -3]
     assert list(tg[1]) == [1, 1, 1]
     assert list(vf[1]) == [2.5, 0.0, 7.0]
-    assert list(tg[2][:2]) == [0, 0] and list(vi[2][:2]) == [1, 0]  # bools
+    # bools: tag 3 preserves boolness (arithmetic treats it as int)
+    assert list(tg[2][:2]) == [3, 3] and list(vi[2][:2]) == [1, 0]
 
 
 def test_decode_str_cols():
