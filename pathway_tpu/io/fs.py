@@ -527,7 +527,11 @@ def read(
             keys = [key_for_values(*[r[names.index(c)] for c in pk]) for r in rows]
         return Table.from_rows(schema, rows, keys=keys)
 
-    # streaming: poll for new files forever (reference directory watcher)
+    # streaming: poll for new files forever (reference directory watcher).
+    # _single_pass (kwargs, internal/bench): deliver current files once and
+    # finish — a finite stream with streaming-mode chunking/commit waves.
+    single_pass = bool(kwargs.get("_single_pass"))
+
     def factory(session: InputSession) -> ThreadConnector:
         def run_fn(sess: InputSession) -> None:
             seen: dict[str, float] = {}
@@ -562,6 +566,8 @@ def read(
                             else sequential_key()
                         )
                         sess.insert(key, row)
+                if single_pass:
+                    return
                 _time.sleep((autocommit_duration_ms or 1500) / 1000.0)
 
         return ThreadConnector(name or f"fs:{path}", session, run_fn)
