@@ -170,6 +170,25 @@ class ShardedNode(Node):
         """
         n = self.n_shards
         route = self.route_fns[input_idx]
+        # ICI data plane: vector-carrying rows move their numeric payload
+        # over the device mesh (PATHWAY_DEVICE_EXCHANGE=1); control
+        # metadata stays host-side. Routing is the same _shard_of rule.
+        from pathway_tpu.parallel.device_exchange import engine_exchanger
+
+        dev = engine_exchanger()
+        if dev is not None:
+
+            def shard_of_entry(key: Any, row: tuple) -> int:
+                return _shard_of(route(key, row), n)
+
+            routed = dev.try_exchange(entries, shard_of_entry, n)
+            if routed is not None:
+                touched = []
+                for s, ents in enumerate(routed):
+                    if ents:
+                        self.replicas[s].accept(input_idx, ents)
+                        touched.append(s)
+                return touched
         buckets: list[list[Entry]] = [[] for _ in range(n)]
         for entry in entries:
             key, row, _diff = entry

@@ -1,0 +1,138 @@
+"""Engine hook for the ICI data plane: batches whose rows carry numeric
+vector columns (embeddings etc.) move those payloads across the worker
+shards through the device-mesh `all_to_all` (parallel/exchange.py) instead
+of the host object plane; only per-row control metadata (key, scalar
+columns, diff) stays host-side.
+
+Reference parity: SURVEY §5's TPU-native replacement for timely's TCP
+exchange (external/timely-dataflow/communication/src/networking.rs) — the
+bulk bytes of a shuffle ride the interconnect, the progress/control plane
+stays on sockets. In a multi-host deployment each engine process drives
+its slice of one global mesh and this same program spans hosts over
+ICI/DCN; single-host it runs across the local (or virtual) devices, which
+is what the multichip dryrun validates.
+
+Enabled with PATHWAY_DEVICE_EXCHANGE=1 (off by default: for small host
+batches the device round-trip costs more than it saves; it pays off when
+vector payloads dominate, e.g. DocumentStore embedding shuffles).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.parallel.exchange import exchange_with_respill
+from pathway_tpu.parallel.mesh import default_mesh
+
+
+def enabled() -> bool:
+    return os.environ.get("PATHWAY_DEVICE_EXCHANGE", "0") == "1"
+
+
+class DeviceExchanger:
+    """Routes the ndarray columns of an entry batch over the device mesh.
+
+    Per batch: rows' float ndarray columns (uniform dtype/shape across the
+    batch) are stacked into one [n, d] matrix and shuffled to their
+    destination shard via bucketize + all_to_all with host-exact routing;
+    every other column travels as control metadata. Rows are reassembled
+    at the destination in deterministic (src-major, arrival) order.
+    """
+
+    MIN_ROWS = 8  # below this the dispatch overhead always dominates
+
+    def __init__(self, mesh=None, axis: str = "data"):
+        self.mesh = mesh if mesh is not None else default_mesh((axis,))
+        self.axis = axis
+        self.invocations = 0
+        self.rows_exchanged = 0
+
+    # ------------------------------------------------------------ detection
+
+    @staticmethod
+    def _vector_columns(row: tuple) -> list[int]:
+        # float32 only: the exchange carries f32, and a float64 column
+        # would come back rounded — silently different row bytes break
+        # downstream retraction matching
+        return [
+            i
+            for i, v in enumerate(row)
+            if isinstance(v, np.ndarray)
+            and v.dtype == np.float32
+            and v.ndim >= 1
+        ]
+
+    def try_exchange(
+        self,
+        entries: list,
+        shard_of_entry: Callable[[Any, tuple], int],
+        n_shards: int,
+    ) -> list[list] | None:
+        """Returns per-shard entry lists, or None when the batch isn't
+        eligible (no/irregular vector columns, too small, mesh mismatch).
+        shard_of_entry(key, row) must be the operator's exact host
+        routing rule — device routing follows it bit-for-bit."""
+        if len(entries) < self.MIN_ROWS:
+            return None
+        if n_shards > self.mesh.shape[self.axis]:
+            return None
+        first_row = entries[0][1]
+        vcols = self._vector_columns(first_row)
+        if not vcols:
+            return None
+        shapes = [first_row[c].shape for c in vcols]
+        dtypes = [first_row[c].dtype for c in vcols]
+        n = len(entries)
+        dests = np.empty(n, np.int64)
+        mats = []
+        try:
+            for j, c in enumerate(vcols):
+                mat = np.stack([e[1][c] for e in entries])
+                if mat.dtype != np.float32:
+                    # some LATER row wasn't f32: casting would change row
+                    # bytes silently (see _vector_columns) — host path
+                    return None
+                mats.append(mat.reshape(n, -1))
+            for i, (key, row, _diff) in enumerate(entries):
+                dests[i] = shard_of_entry(key, row)
+        except Exception:  # noqa: BLE001 — ragged rows / failing routes
+            return None
+        widths = [m.shape[1] for m in mats]
+        payload = np.concatenate(mats, axis=1) if len(mats) > 1 else mats[0]
+        # u32 ids are only for debugging; reassembly uses src indices
+        ids = (np.arange(n) & 0xFFFFFFFF).astype(np.uint32)
+        _keys, pays, srcs = exchange_with_respill(
+            ids, payload, dests, self.mesh, self.axis
+        )
+        self.invocations += 1
+        self.rows_exchanged += n
+        out: list[list] = [[] for _ in range(n_shards)]
+        for d in range(n_shards):
+            for vec_row, i in zip(pays[d], srcs[d]):
+                key, row, diff = entries[int(i)]
+                parts = np.split(vec_row, np.cumsum(widths)[:-1]) if len(mats) > 1 else [vec_row]
+                new_row = list(row)
+                for j, c in enumerate(vcols):
+                    new_row[c] = parts[j].reshape(shapes[j]).astype(dtypes[j])
+                out[d].append((key, tuple(new_row), diff))
+        return out
+
+
+_ENGINE_EXCHANGER: DeviceExchanger | None = None
+
+
+def engine_exchanger() -> DeviceExchanger | None:
+    """Process-wide exchanger for ShardedNode, when enabled and a device
+    mesh is constructible."""
+    global _ENGINE_EXCHANGER
+    if not enabled():
+        return None
+    if _ENGINE_EXCHANGER is None:
+        try:
+            _ENGINE_EXCHANGER = DeviceExchanger()
+        except Exception:  # noqa: BLE001 — no usable devices
+            return None
+    return _ENGINE_EXCHANGER

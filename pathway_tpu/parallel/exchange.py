@@ -9,10 +9,22 @@ and a single `all_to_all` moves the buckets across the interconnect. Scalar
 control traffic stays on host; bulk numeric payloads ride ICI.
 
 Static-shape design: XLA needs fixed shapes, so each shard sends exactly
-`capacity` slots to every destination, padding unused slots with a validity
-flag. capacity defaults to the full per-shard row count (worst case: all
-rows hash to one destination); callers with balanced keys can pass a
-smaller capacity and check `overflowed`.
+`capacity + 1` slots to every destination (the extra slot is a trash slot
+absorbing masked-out and overflowing rows), padding unused slots with a
+validity flag.
+
+Routing over the REAL 128-bit key space: the u32 `keys` carried through
+the exchange are identifiers, not the routing domain. Callers pass
+`dests` — the destination shard per row, computed host-side with the
+exact 128-bit `key % n_shards` (dataplane.dp_route_key) or any other
+content-stable rule — so device routing agrees bit-for-bit with the
+engine's host exchange (engine/workers._shard_of).
+
+Overflow: `exchange_by_key` flags it; `exchange_with_respill` handles it
+properly — the host knows every (src, dst) bucket count exactly, so it
+ships rows in ceil(max_count / capacity) rounds, each round sending the
+next `capacity` rows of each bucket. No data is dropped and capacity
+never balloons to the worst case.
 """
 
 from __future__ import annotations
@@ -22,47 +34,84 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 Array = jax.Array
 
 
 class ExchangeResult(NamedTuple):
-    keys: Array  # [shards, cap * shards] u32 — received keys per shard slot
-    payloads: Array  # [shards, cap * shards, d] — received payloads
-    valid: Array  # [shards, cap * shards] bool — slot occupancy
-    # some bucket exceeded capacity: the overflowing rows were scattered
-    # into the bucket's LAST slot with duplicate indices (XLA duplicate
-    # scatter order is unspecified), so the whole result must be treated
-    # as invalid when this is set — use exchange_by_key_checked for the
-    # host wrapper that retries with doubled capacity instead
+    keys: Array  # [shards, (cap+1) * shards] u32 — received keys per slot
+    payloads: Array  # [shards, (cap+1) * shards, d] — received payloads
+    valid: Array  # [shards, (cap+1) * shards] bool — slot occupancy
+    # some bucket exceeded capacity: the overflowing rows landed in the
+    # trash slot (marked invalid), so rows are MISSING when this is set —
+    # use exchange_with_respill for the wrapper that re-ships them
     overflowed: Array  # [] bool
 
 
-def _bucketize(keys: Array, payloads: Array, n_shards: int, cap: int):
-    """Sort one shard's rows into n_shards buckets of `cap` slots each."""
-    dest = keys % n_shards  # [rows]
-    # stable order: rows of destination d, in arrival order
+def _bucketize(keys, payloads, dests, valid_in, n_shards: int, cap: int,
+               axis: str):
+    """Sort one shard's rows into n_shards buckets of cap+1 slots each
+    (slot `cap` of each bucket is the trash slot: masked-out rows and
+    bucket overflow land there, always marked invalid)."""
+    me = jax.lax.axis_index(axis)
+    dest = jnp.where(valid_in, dests, me)  # masked rows stay "local"
     order = jnp.argsort(dest, stable=True)
     sorted_dest = dest[order]
-    # slot within destination bucket = running index among same-destination rows
-    same = sorted_dest[:, None] == jnp.arange(n_shards)[None, :]
+    sorted_valid = valid_in[order]
+    # slot within destination bucket = running index among VALID
+    # same-destination rows (arrival order preserved by the stable sort)
+    same = (sorted_dest[:, None] == jnp.arange(n_shards)[None, :]) & sorted_valid[:, None]
     within = jnp.cumsum(same, axis=0)[jnp.arange(keys.shape[0]), sorted_dest] - 1
-    counts = jnp.sum(same, axis=0)
-    overflow = jnp.any(counts > cap)
-    slot = sorted_dest * cap + jnp.minimum(within, cap - 1)
-    bucket_keys = jnp.zeros((n_shards * cap,), keys.dtype).at[slot].set(keys[order])
+    fits = sorted_valid & (within < cap)
+    overflow = jnp.any(sorted_valid & (within >= cap))
+    slot = sorted_dest * (cap + 1) + jnp.where(fits, within, cap)
+    width = n_shards * (cap + 1)
+    bucket_keys = jnp.zeros((width,), keys.dtype).at[slot].set(keys[order])
     bucket_pay = (
-        jnp.zeros((n_shards * cap,) + payloads.shape[1:], payloads.dtype)
+        jnp.zeros((width,) + payloads.shape[1:], payloads.dtype)
         .at[slot]
         .set(payloads[order])
     )
-    bucket_valid = (
-        jnp.zeros((n_shards * cap,), bool)
-        .at[slot]
-        .set(within < cap)
-    )
+    bucket_valid = jnp.zeros((width,), bool).at[slot].set(fits)
+    # the trash slot may have been scattered with a row's data; force-mark
+    # every bucket's slot `cap` invalid
+    trash = jnp.arange(n_shards) * (cap + 1) + cap
+    bucket_valid = bucket_valid.at[trash].set(False)
     return bucket_keys, bucket_pay, bucket_valid, overflow
+
+
+@functools.lru_cache(maxsize=64)
+def _exchange_program(mesh: Mesh, axis: str, n_shards: int, cap: int):
+    """One compiled exchange program per (mesh, axis, capacity): rebuilding
+    the shard_map closure per call would retrace+recompile every batch."""
+
+    def local(k, p, d, v):
+        bk, bp, bv, overflow = _bucketize(k, p, d, v, n_shards, cap, axis)
+        w = cap + 1
+        bk = bk.reshape(n_shards, w)
+        bp = bp.reshape((n_shards, w) + p.shape[1:])
+        bv = bv.reshape(n_shards, w)
+        rk = jax.lax.all_to_all(bk, axis, 0, 0, tiled=False)
+        rp = jax.lax.all_to_all(bp, axis, 0, 0, tiled=False)
+        rv = jax.lax.all_to_all(bv, axis, 0, 0, tiled=False)
+        ov = jax.lax.pmax(overflow.astype(jnp.int32), axis)
+        return (
+            rk.reshape(1, n_shards * w),
+            rp.reshape((1, n_shards * w) + p.shape[1:]),
+            rv.reshape(1, n_shards * w),
+            ov.reshape(1),
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(axis), P(axis), P(axis), P(axis)),
+        )
+    )
 
 
 def exchange_by_key(
@@ -71,13 +120,20 @@ def exchange_by_key(
     mesh: Mesh,
     axis: str = "data",
     capacity: int | None = None,
+    dests: Array | None = None,
+    valid: Array | None = None,
 ) -> ExchangeResult:
-    """Shuffle rows so shard s receives every row with key % n_shards == s.
+    """Shuffle rows so shard s receives every row with dests == s
+    (default dests: keys % n_shards).
 
-    keys: [n] uint32 (row key hashes), sharded over `axis`.
+    keys: [n] uint32 (row key identifiers), sharded over `axis`.
     payloads: [n, d] numeric payloads, same sharding.
-    Output arrays keep the shard dimension explicit: result.keys[s] are the
-    rows now owned by shard s.
+    dests: [n] int32 destination shard per row (host-computed exact
+    128-bit routing) — MUST be in [0, n_shards): out-of-range scatter
+    indices are dropped by XLA without any signal, so host-array dests
+    are validated here; valid: [n] bool row mask (False rows don't ship).
+    Output arrays keep the shard dimension explicit: result.keys[s] are
+    the rows now owned by shard s.
     """
     n_shards = mesh.shape[axis]
     rows_total = keys.shape[0]
@@ -85,31 +141,21 @@ def exchange_by_key(
         raise ValueError(f"row count {rows_total} not divisible by {n_shards}")
     rows_local = rows_total // n_shards
     cap = capacity or rows_local
+    if dests is None:
+        dests = (keys % n_shards).astype(jnp.int32)
+    elif isinstance(dests, np.ndarray):
+        if len(dests) and (dests.min() < 0 or dests.max() >= n_shards):
+            raise ValueError(
+                f"dests outside [0, {n_shards}): rows would be silently "
+                "dropped by the device scatter"
+            )
+    if valid is None:
+        valid = jnp.ones(rows_total, bool)
 
-    def local(k, p):
-        bk, bp, bv, overflow = _bucketize(k, p, n_shards, cap)
-        # [n_shards*cap] -> split into n_shards chunks -> all_to_all
-        bk = bk.reshape(n_shards, cap)
-        bp = bp.reshape((n_shards, cap) + p.shape[1:])
-        bv = bv.reshape(n_shards, cap)
-        rk = jax.lax.all_to_all(bk, axis, 0, 0, tiled=False)
-        rp = jax.lax.all_to_all(bp, axis, 0, 0, tiled=False)
-        rv = jax.lax.all_to_all(bv, axis, 0, 0, tiled=False)
-        ov = jax.lax.pmax(overflow.astype(jnp.int32), axis)
-        return (
-            rk.reshape(1, n_shards * cap),
-            rp.reshape((1, n_shards * cap) + p.shape[1:]),
-            rv.reshape(1, n_shards * cap),
-            ov.reshape(1),
-        )
-
-    fn = jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(axis), P(axis)),
-        out_specs=(P(axis), P(axis), P(axis), P(axis)),
+    fn = _exchange_program(mesh, axis, n_shards, cap)
+    rk, rp, rv, ov = fn(
+        keys, payloads, jnp.asarray(dests, jnp.int32), valid
     )
-    rk, rp, rv, ov = jax.jit(fn)(keys, payloads)
     return ExchangeResult(
         keys=rk, payloads=rp, valid=rv, overflowed=jnp.any(ov > 0)
     )
@@ -123,10 +169,8 @@ def exchange_by_key_checked(
     capacity: int | None = None,
     max_retries: int = 3,
 ) -> ExchangeResult:
-    """Host wrapper: retries the exchange with doubled capacity while
-    `overflowed` is set (an overflowed result is corrupt — see
-    ExchangeResult). Engine integrations must use this, never the raw
-    primitive, so skewed batches cannot silently drop rows."""
+    """Legacy wrapper: retries with doubled capacity while `overflowed`.
+    Prefer exchange_with_respill (no data loss, bounded memory)."""
     n_shards = mesh.shape[axis]
     cap = capacity or keys.shape[0] // n_shards
     for _ in range(max_retries + 1):
@@ -139,6 +183,135 @@ def exchange_by_key_checked(
         f"({max_retries} retries) — key distribution is pathologically "
         "skewed; pre-aggregate or rebalance keys"
     )
+
+
+def route128(key_lo: np.ndarray, key_hi: np.ndarray, n_shards: int) -> np.ndarray:
+    """Exact destination over the 128-bit key space (key % n_shards),
+    identical to engine/workers._shard_of for record keys. Uses the C
+    kernel when present."""
+    try:
+        from pathway_tpu.engine.native import dataplane as dp
+
+        if dp.available():
+            return dp.route_key(
+                np.ascontiguousarray(key_lo, np.uint64),
+                np.ascontiguousarray(key_hi, np.uint64),
+                n_shards,
+            )
+    except Exception:  # noqa: BLE001
+        pass
+    m = n_shards
+    r64 = pow(2, 64, m)
+    return np.asarray(
+        [
+            (int(hi) % m * r64 + int(lo) % m) % m
+            for lo, hi in zip(key_lo, key_hi)
+        ],
+        np.int64,
+    )
+
+
+def exchange_with_respill(
+    key_ids: np.ndarray,
+    payloads: np.ndarray,
+    dests: np.ndarray,
+    mesh: Mesh,
+    axis: str = "data",
+    capacity: int | None = None,
+):
+    """Host-orchestrated multi-round exchange: rows are shipped in
+    ceil(max_bucket / capacity) rounds, each round sending at most
+    `capacity` rows of every (src, dst) bucket — overflow rows are
+    RE-SPILLED to later rounds instead of retrying the whole batch at a
+    bigger capacity.
+
+    key_ids: [n] uint32 identifiers; payloads: [n, d]; dests: [n] exact
+    destination shards (route128 of the full key). Rows are split evenly
+    over source shards in order. Returns (keys_per_dest, payload_per_dest,
+    src_index_per_dest): numpy arrays per destination shard, in GLOBAL
+    ARRIVAL ORDER (each row's original index), which is the engine's
+    same-key ordering invariant — a retraction never overtakes the insert
+    it cancels, even when they land in different respill rounds.
+    """
+    n_shards = mesh.shape[axis]
+    n = len(key_ids)
+    pad = (-n) % n_shards
+    if pad:
+        key_ids = np.concatenate([key_ids, np.zeros(pad, key_ids.dtype)])
+        payloads = np.concatenate(
+            [payloads, np.zeros((pad,) + payloads.shape[1:], payloads.dtype)]
+        )
+        dests = np.concatenate([dests, np.zeros(pad, dests.dtype)])
+    total = len(key_ids)
+    rows_local = total // n_shards
+    src_of = np.arange(total) // rows_local
+    # per-(src,dst) bucket position of every row, vectorized: global index
+    # order IS (src-major, arrival) order, so within-bucket rank is the
+    # running count per (src,dst) pair
+    sd = src_of * n_shards + np.asarray(dests, np.int64)
+    order = np.argsort(sd, kind="stable")
+    sorted_sd = sd[order]
+    group_start = np.r_[0, np.nonzero(np.diff(sorted_sd))[0] + 1]
+    group_len = np.diff(np.r_[group_start, total])
+    within_sorted = np.arange(total) - np.repeat(group_start, group_len)
+    within = np.empty(total, np.int64)
+    within[order] = within_sorted
+    row_valid = np.ones(total, bool)
+    if pad:
+        row_valid[n:] = False
+    max_bucket = int(group_len.max()) if total else 0
+    cap = capacity or max(min(max_bucket, max(rows_local // 2, 1)), 1)
+    rounds = max(1, -(-max_bucket // cap))
+
+    keys_d = jax.device_put(
+        jnp.asarray(key_ids, jnp.uint32),
+        NamedSharding(mesh, P(axis)),
+    )
+    pay_d = jax.device_put(
+        jnp.asarray(payloads), NamedSharding(mesh, P(axis, *([None] * (payloads.ndim - 1))))
+    )
+    dest_d = jax.device_put(
+        jnp.asarray(dests, jnp.int32), NamedSharding(mesh, P(axis))
+    )
+    acc_pay: list[list] = [[] for _ in range(n_shards)]
+    acc_keys: list[list] = [[] for _ in range(n_shards)]
+    acc_src: list[list] = [[] for _ in range(n_shards)]
+    dests_np = np.asarray(dests, np.int64)
+    for r in range(rounds):
+        sel = row_valid & (within >= r * cap) & (within < (r + 1) * cap)
+        valid_d = jax.device_put(
+            jnp.asarray(sel), NamedSharding(mesh, P(axis))
+        )
+        res = exchange_by_key(
+            keys_d, pay_d, mesh, axis, capacity=cap, dests=dest_d,
+            valid=valid_d,
+        )
+        assert not bool(res.overflowed)  # capacity rounds preclude overflow
+        rk = np.asarray(res.keys)
+        rp = np.asarray(res.payloads)
+        rv = np.asarray(res.valid)
+        for d in range(n_shards):
+            # received slot order is (src-major, within-bucket arrival) =
+            # ascending global index among this round's selected rows
+            idx = np.nonzero(sel & (dests_np == d))[0]
+            acc_keys[d].append(rk[d][rv[d]])
+            acc_pay[d].append(rp[d][rv[d]])
+            acc_src[d].append(idx)
+    out_keys, out_pay, out_src = [], [], []
+    for d in range(n_shards):
+        k = np.concatenate(acc_keys[d]) if acc_keys[d] else np.empty(0, np.uint32)
+        p = (
+            np.concatenate(acc_pay[d])
+            if acc_pay[d]
+            else np.empty((0,) + payloads.shape[1:], payloads.dtype)
+        )
+        s = np.concatenate(acc_src[d]) if acc_src[d] else np.empty(0, np.int64)
+        # restore global arrival order across rounds
+        reorder = np.argsort(s, kind="stable")
+        out_keys.append(k[reorder])
+        out_pay.append(p[reorder])
+        out_src.append(s[reorder])
+    return out_keys, out_pay, out_src
 
 
 @functools.partial(jax.jit, static_argnames=("n_shards",))

@@ -66,3 +66,80 @@ def test_partition_counts():
     keys = jnp.asarray([0, 1, 2, 3, 4, 8, 12], jnp.uint32)
     counts = np.asarray(partition_counts(keys, 4))
     assert counts.tolist() == [4, 1, 1, 1]
+
+
+def test_exchange_with_respill_skewed():
+    """All rows to one destination at tiny capacity: respill rounds ship
+    everything, nothing dropped, arrival order preserved."""
+    from pathway_tpu.parallel.exchange import exchange_with_respill
+
+    mesh = make_mesh((N_DEV,), ("data",))
+    n = N_DEV * 8
+    ids = np.arange(n, dtype=np.uint32)
+    pay = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, 3), np.float32)
+    dests = np.full(n, 2 % N_DEV, np.int64)  # pathological skew
+    keys, pays, srcs = exchange_with_respill(
+        ids, pay, dests, mesh, capacity=2
+    )
+    d = 2 % N_DEV
+    assert sum(len(k) for k in keys) == n
+    assert len(keys[d]) == n
+    # GLOBAL ARRIVAL ORDER across respill rounds: a retraction shipped in
+    # round 2 must not overtake its insert from round 1
+    assert [int(i) for i in srcs[d]] == list(range(n))
+    for j, i in enumerate(srcs[d]):
+        assert pays[d][j][0] == float(i)
+
+
+def test_exchange_dests_route_128bit():
+    """dests computed from the full 128-bit key space override the u32
+    identity routing."""
+    from pathway_tpu.parallel.exchange import exchange_with_respill, route128
+
+    mesh = make_mesh((N_DEV,), ("data",))
+    rng = np.random.default_rng(3)
+    n = N_DEV * 4
+    lo = rng.integers(0, 2**63, n, dtype=np.uint64)
+    hi = rng.integers(0, 2**63, n, dtype=np.uint64)
+    dests = route128(lo, hi, N_DEV)
+    for i in range(n):
+        assert dests[i] == ((int(hi[i]) << 64) | int(lo[i])) % N_DEV
+    ids = np.arange(n, dtype=np.uint32)
+    pay = rng.normal(size=(n, 2)).astype(np.float32)
+    _keys, pays, srcs = exchange_with_respill(ids, pay, dests, mesh)
+    for d in range(N_DEV):
+        for j, i in enumerate(srcs[d]):
+            assert dests[int(i)] == d
+            np.testing.assert_array_equal(pays[d][j], pay[int(i)])
+
+
+def test_engine_groupby_routes_vectors_through_device_exchange(monkeypatch):
+    """A thread-sharded groupby whose rows carry f32 embedding columns
+    moves the vectors through the device-mesh exchange (the VERDICT's
+    'assert on the code path' test) and produces identical results."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.parallel import device_exchange as dx
+
+    def build_and_run():
+        G.clear()
+        rows = [
+            (f"cat{i % 5}", np.full(16, float(i), np.float32)) for i in range(64)
+        ]
+        t = pw.Table.from_rows(
+            pw.schema_from_types(cat=str, emb=np.ndarray), rows
+        )
+        res = t.groupby(t.cat).reduce(t.cat, n=pw.reducers.count())
+        return sorted(map(tuple, pw.debug.table_to_pandas(res).values.tolist()))
+
+    monkeypatch.setenv("PATHWAY_THREADS", "4")
+    base = build_and_run()
+
+    monkeypatch.setenv("PATHWAY_DEVICE_EXCHANGE", "1")
+    dx._ENGINE_EXCHANGER = None  # fresh counters under the new env
+    got = build_and_run()
+    ex = dx._ENGINE_EXCHANGER
+    assert ex is not None and ex.invocations > 0, "device exchange not taken"
+    assert ex.rows_exchanged >= 64
+    assert got == base == [(f"cat{i}", 13 if i < 4 else 12) for i in range(5)]
+    dx._ENGINE_EXCHANGER = None
