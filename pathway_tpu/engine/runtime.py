@@ -28,6 +28,21 @@ from pathway_tpu.internals.errors import ERROR
 from pathway_tpu.internals.keys import Key, key_for_values, sequential_key
 
 
+class OffsetMark:
+    """In-stream frontier marker (reference: OffsetAntichain,
+    src/persistence/frontier.rs): every event staged BEFORE this mark is
+    covered by `frontier` — a {partition: position} dict whose shape the
+    source owns (file -> byte position / ('done', mtime, size); kafka
+    topic:partition -> next offset). The persistence layer checkpoints the
+    frontier instead of journaling seekable sources' events; plain runs
+    drop marks at poll time."""
+
+    __slots__ = ("frontier",)
+
+    def __init__(self, frontier: dict):
+        self.frontier = frontier
+
+
 class InputSession:
     """Thread-safe staging buffer feeding an InputNode.
 
@@ -43,6 +58,17 @@ class InputSession:
         self._staged: list[Entry] = []
         self._current: dict[Key, tuple] = {}  # for upsert sessions
         self.closed = False
+        self.has_marks = False
+        # persistence sets this before the reader starts: sources seek
+        # past everything a committed checkpoint already covers
+        self.resume_frontier: dict | None = None
+
+    def mark_frontier(self, frontier: dict) -> None:
+        """Stage an offset-frontier mark covering everything staged so
+        far (offset-aware sources call this at record-aligned positions)."""
+        self.has_marks = True
+        with self._lock:
+            self._staged.append(OffsetMark(dict(frontier)))
 
     def insert(self, key: Key, row: tuple) -> None:
         with self._lock:
@@ -104,7 +130,13 @@ class Connector:
         pass
 
     def poll(self) -> list[Entry]:
-        return self.session.drain()
+        staged = self.session.drain()
+        if self.session.has_marks:
+            # frontier marks matter only under persistence (the
+            # PersistentConnector drains the session itself); plain runs
+            # drop them here so they never reach the engine
+            staged = [s for s in staged if type(s) is not OffsetMark]
+        return staged
 
     @property
     def done(self) -> bool:
@@ -161,6 +193,7 @@ class Runtime:
             self.graph.step(t)
             self.graph.end(t)
             return
+        ckpt_dirty = False
         while True:
             _time.sleep(self.autocommit_ms / 1000.0)
             any_data = False
@@ -172,10 +205,20 @@ class Runtime:
             if any_data:
                 t = self.next_time()
                 self.graph.step(t)
+                ckpt_dirty = True
                 for m in self.monitors:
                     m(t)
-                if self.checkpointer is not None and self.checkpointer.due():
-                    self.checkpointer.checkpoint(t)
+            # checkpoint on cadence whenever there is anything new to
+            # commit — processed waves OR offset-frontier advances (a
+            # quiet stream whose source finished a file still needs its
+            # frontier made durable)
+            if (
+                self.checkpointer is not None
+                and self.checkpointer.due()
+                and (ckpt_dirty or self.checkpointer.frontier_advanced())
+            ):
+                self.checkpointer.checkpoint(self.time)
+                ckpt_dirty = False
             stopped = self.stop_event is not None and self.stop_event.is_set()
             if stopped or all(c.done for c in self.connectors):
                 # final drain

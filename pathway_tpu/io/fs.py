@@ -96,7 +96,7 @@ def _py_fallback_row(info: dict, line: bytes):
     or None (unparseable -> logged upstream semantics: skip)."""
     names = info["names"]
     schema = info["schema"]
-    if info["kind"] == "json":
+    if info["kind"] == "json":  # (fallback-line reparse, not resume)
         try:
             rec = _json.loads(line.decode("utf-8", errors="replace"))
         except (ValueError, UnicodeDecodeError) as e:
@@ -133,16 +133,38 @@ def _py_fallback_row(info: dict, line: bytes):
     return tuple(row)
 
 
-def _chunk_bodies(path: str, info: dict):
-    """Yield record-aligned chunk bodies of one file (serial IO +
-    boundary alignment; the CPU-heavy parse runs elsewhere). Consumes the
-    CSV header and fills info['field_idx'] as a side effect."""
-    names = info["names"]
+def _read_csv_header(f, info: dict) -> int:
+    """Read + apply the header record from the current position; returns
+    the byte offset just past it. (Quoted newlines in headers are not
+    supported by the chunked reader.)"""
+    from pathway_tpu.engine import native as zs
+
+    hdr = f.readline()
+    end = f.tell()
+    cols = zs.split_csv_line(hdr.rstrip(b"\r\n"), info["delim"])
+    col_pos = {h: i for i, h in enumerate(cols)}
+    info["field_idx"] = [col_pos.get(n, -1) for n in info["names"]]
+    return end
+
+
+def _chunk_bodies(path: str, info: dict, start_pos: int = 0):
+    """Yield (body, end_abs_pos) record-aligned chunks of one file
+    (serial IO + boundary alignment; the CPU-heavy parse runs elsewhere).
+    Consumes the CSV header (always from byte 0 — the field mapping) and
+    fills info['field_idx'] as a side effect. `start_pos` (a previously
+    reported record-aligned frontier position) seeks past consumed data."""
     is_csv = info["kind"] == "csv"
-    CHUNK = 4 << 20
+    # PATHWAY_FS_CHUNK: chunk-size override (tests force multi-chunk
+    # files to exercise mid-file frontier positions)
+    CHUNK = int(os.environ.get("PATHWAY_FS_CHUNK", 4 << 20))
     with open(path, "rb") as f:
+        abs_pos = 0
+        if is_csv:
+            abs_pos = _read_csv_header(f, info)
+        if start_pos > abs_pos:
+            f.seek(start_pos)
+            abs_pos = start_pos
         pending = b""
-        header_done = not is_csv
         while True:
             chunk = f.read(CHUNK)
             eof = not chunk
@@ -150,27 +172,6 @@ def _chunk_bodies(path: str, info: dict):
             pending = b""
             if not data:
                 return
-            if not header_done:
-                # first record is the header (quoted newlines in headers
-                # are not supported by the chunked reader)
-                nl = data.find(b"\n")
-                if nl < 0:
-                    if not eof:
-                        pending = data
-                        continue
-                    nl = len(data)
-                from pathway_tpu.engine import native as zs
-
-                hdr = data[:nl].rstrip(b"\r")
-                cols = zs.split_csv_line(hdr, info["delim"])
-                col_pos = {h: i for i, h in enumerate(cols)}
-                info["field_idx"] = [col_pos.get(n, -1) for n in names]
-                data = data[nl + 1 :] if nl < len(data) else b""
-                header_done = True
-                if not data:
-                    if eof:
-                        return
-                    continue
             if not eof:
                 if is_csv:
                     from pathway_tpu.engine import native as zs
@@ -189,7 +190,8 @@ def _chunk_bodies(path: str, info: dict):
             else:
                 body = data
             if body:
-                yield body
+                abs_pos += len(body)
+                yield body, abs_pos
             if eof:
                 return
 
@@ -245,12 +247,107 @@ def _parse_body(info: dict, tab, body: bytes, seq_start: int):
     return None, entries
 
 
-def _native_parse_file(path: str, info: dict, tab, emit_batch, emit_entry):
+def _file_head_sig(path: str, size: int) -> list:
+    """Identity of a file's head: [n, blake2b(first n bytes)] with
+    n = min(4096, size at record time). Frontier positions are only valid
+    against the file they came from (log rotation / replacement must
+    trigger a full re-read, not a seek into unrelated content); hashing a
+    RECORDED length keeps the signature stable when a small file grows."""
+    import hashlib as _hl
+
+    n = min(4096, size)
+    try:
+        with open(path, "rb") as f:
+            return [n, _hl.blake2b(f.read(n), digest_size=8).hexdigest()]
+    except OSError:
+        return [0, ""]
+
+
+def _head_sig_matches(path: str, st, ent_sig) -> bool:
+    try:
+        n, want = int(ent_sig[0]), ent_sig[1]
+    except (TypeError, ValueError, IndexError):
+        return False
+    if st.st_size < n:
+        return False
+    return _file_head_sig(path, n) == [n, want]
+
+
+def _py_resume_rows(
+    path: str, format: str, schema, csv_settings, start_pos: int, pk  # noqa: A002
+):
+    """Object-plane resume from a record-aligned byte frontier (used when
+    a 'pos' frontier exists but the native parser is unavailable —
+    e.g. resuming on a host without a C++ toolchain). Yields (key, row)."""
+    names = list(schema.__columns__)
+    pk = pk or []
+    delim = getattr(csv_settings, "delimiter", ",") if csv_settings else ","
+    with open(path, "rb") as f:
+        header = b""
+        if format == "csv":
+            header = f.readline()
+        if start_pos > f.tell():
+            f.seek(start_pos)
+        rest = f.read()
+    if format == "csv":
+        import io as _io
+
+        reader = _csv.DictReader(
+            _io.StringIO((header + rest).decode("utf-8", errors="replace")),
+            delimiter=delim,
+        )
+        for rec in reader:
+            row = tuple(
+                _coerce(rec.get(n), schema.__columns__[n].dtype)
+                if rec.get(n) is not None
+                else None
+                for n in names
+            )
+            key = (
+                key_for_values(*[row[names.index(c)] for c in pk])
+                if pk
+                else sequential_key()
+            )
+            yield key, row
+        return
+    for line in rest.split(b"\n"):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = _json.loads(line.decode("utf-8", errors="replace"))
+        except ValueError as e:
+            from pathway_tpu.internals.errors import global_error_log
+
+            global_error_log().log(f"fs.read json parse error in {path}: {e}")
+            continue
+        row = tuple(
+            Json(v)
+            if isinstance(v := rec.get(n), (dict, list))
+            else _json_coerce(v, schema.__columns__[n].dtype)
+            for n in names
+        )
+        # key from the COERCED values — must match the normal path's keys
+        # or resume splits one logical row across two identities
+        key = (
+            key_for_values(*[row[names.index(c)] for c in pk])
+            if pk
+            else sequential_key()
+        )
+        yield key, row
+
+
+def _native_parse_file(
+    path: str, info: dict, tab, emit_batch, emit_entry,
+    start_pos: int = 0, on_progress: Callable[[int], None] | None = None,
+):
     """Chunked native parse of one file: complete records go through the C
     parser as NativeBatch segments; rejected lines re-parse in Python.
     Chunks parse CONCURRENTLY on the worker pool (the C parser releases
     the GIL), a window at a time, emitted in file order.
-    emit_batch(NativeBatch); emit_entry((key, row))."""
+    emit_batch(NativeBatch); emit_entry((key, row)); on_progress(abs_pos)
+    fires after each chunk's rows are emitted (record-aligned byte
+    frontier for persistence)."""
     from pathway_tpu.engine.workers import _pool, worker_threads
 
     pk_idx = info["pk_idx"]
@@ -260,21 +357,26 @@ def _native_parse_file(path: str, info: dict, tab, emit_batch, emit_entry):
     inflight: list = []
 
     def flush_one() -> None:
-        batch, entries = inflight.pop(0).result() if pool else inflight.pop(0)
+        job, end_pos = inflight.pop(0)
+        batch, entries = job.result() if pool else job
         if batch is not None:
             emit_batch(batch)
         for e in entries:
             emit_entry(e)
+        if on_progress is not None:
+            on_progress(end_pos)
 
-    for body in _chunk_bodies(path, info):
+    for body, end_pos in _chunk_bodies(path, info, start_pos):
         # reserve the key range HERE so sequence ranges follow file order
         # regardless of pool scheduling
         n_cap = body.count(b"\n") + (0 if body.endswith(b"\n") else 1)
         seq_start = reserve_sequential(max(n_cap, 1)) if not pk_idx else 0
         if pool is not None:
-            inflight.append(pool.submit(_parse_body, info, tab, body, seq_start))
+            inflight.append(
+                (pool.submit(_parse_body, info, tab, body, seq_start), end_pos)
+            )
         else:
-            inflight.append(_parse_body(info, tab, body, seq_start))
+            inflight.append((_parse_body(info, tab, body, seq_start), end_pos))
         if len(inflight) >= window:
             flush_one()
     while inflight:
@@ -535,8 +637,14 @@ def read(
     def factory(session: InputSession) -> ThreadConnector:
         def run_fn(sess: InputSession) -> None:
             seen: dict[str, float] = {}
+            # persistence offset frontier (reference: OffsetAntichain,
+            # src/persistence/frontier.rs): ['done', mtime, size] marks a
+            # fully-consumed file; ['pos', p] a record-aligned byte
+            # position inside one — the source SEEKS on resume instead of
+            # the journal count-skipping replayed events
+            resume = dict(sess.resume_frontier or {})
             # token-resident chunked reads need plain insert sessions
-            # (upsert bookkeeping is per-row) and no journaling wrapper
+            # (upsert bookkeeping is per-row)
             use_native = native_info is not None and not sess.upsert_mode
             if use_native:
                 from pathway_tpu.engine.native import dataplane as dp
@@ -545,32 +653,73 @@ def read(
             while True:
                 for f in _list_files(path):
                     try:
-                        mtime = os.path.getmtime(f)
+                        st = os.stat(f)
+                        mtime = st.st_mtime
                     except OSError:
                         continue
                     if seen.get(f) == mtime:
                         continue
+                    sig = _file_head_sig(f, st.st_size)
+                    start_pos = 0
+                    ent = resume.pop(f, None)
+                    if ent is not None:
+                        # frontier entries carry a head signature: a
+                        # rotated/replaced file must never resume at a
+                        # byte offset of unrelated content — mismatch
+                        # falls back to a full re-read (duplicates are
+                        # recoverable; silent loss/garbage is not)
+                        sig_ok = _head_sig_matches(f, st, ent[-1])
+                        if ent[0] == "done" and sig_ok:
+                            if ent[1] == mtime and ent[2] == st.st_size:
+                                seen[f] = mtime
+                                continue
+                            if st.st_size > ent[2]:
+                                # appended tail: resume at the consumed
+                                # end instead of re-reading everything
+                                start_pos = int(ent[2])
+                        elif ent[0] == "pos" and sig_ok and st.st_size >= int(ent[1]):
+                            start_pos = int(ent[1])
                     seen[f] = mtime
+                    # last consumed position: exact even when the file
+                    # grows during the read (the 'done' stat is taken
+                    # BEFORE parsing, so growth re-delivers, never loses)
+                    last_pos = st.st_size
                     if use_native:
+                        def prog(pos: int, _f=f, _sig=sig) -> None:
+                            nonlocal last_pos
+                            last_pos = pos
+                            sess.mark_frontier({_f: ["pos", pos, _sig]})
+
                         _native_parse_file(
                             f, native_info, tab,
                             sess.insert_batch,
                             lambda kr: sess.insert(kr[0], kr[1]),
+                            start_pos=start_pos,
+                            on_progress=prog,
                         )
-                        continue
-                    for rec in _parse_file(f, format, schema, csv_settings, with_metadata):
-                        row = tuple(rec.get(n) for n in names)
-                        key = (
-                            key_for_values(*[rec.get(c) for c in pk])
-                            if pk
-                            else sequential_key()
-                        )
-                        sess.insert(key, row)
+                    elif start_pos:
+                        for key, row in _py_resume_rows(
+                            f, format, schema, csv_settings, start_pos, pk
+                        ):
+                            sess.insert(key, row)
+                    else:
+                        for rec in _parse_file(f, format, schema, csv_settings, with_metadata):
+                            row = tuple(rec.get(n) for n in names)
+                            key = (
+                                key_for_values(*[rec.get(c) for c in pk])
+                                if pk
+                                else sequential_key()
+                            )
+                            sess.insert(key, row)
+                    sess.mark_frontier({f: ["done", mtime, last_pos, sig]})
                 if single_pass:
                     return
                 _time.sleep((autocommit_duration_ms or 1500) / 1000.0)
 
-        return ThreadConnector(name or f"fs:{path}", session, run_fn)
+        conn = ThreadConnector(name or f"fs:{path}", session, run_fn)
+        # offset-frontier resume: seek instead of journal count-skip
+        conn.replay_style = "offset"
+        return conn
 
     spec = OpSpec(
         "connector", [], factory=factory, upsert=pk is not None, name=name,

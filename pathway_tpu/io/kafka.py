@@ -82,7 +82,26 @@ def read(
         def run(self) -> None:
             consumer = ck.Consumer(settings)
             self._consumer = consumer
-            if start_from_timestamp_ms is not None:
+            resume = self.resume_frontier()
+            if resume:
+                # offset-frontier resume (reference: data_storage.rs
+                # seek_to_committed): start each partition exactly past
+                # the last checkpointed message, independent of broker
+                # group state
+                parts = []
+                for t in topics:
+                    meta = consumer.list_topics(t, timeout=10)
+                    for p in meta.topics[t].partitions:
+                        off = resume.get(f"{t}\x00{p}")
+                        parts.append(
+                            ck.TopicPartition(
+                                t, p,
+                                int(off) if off is not None
+                                else ck.OFFSET_STORED,
+                            )
+                        )
+                consumer.assign(parts)
+            elif start_from_timestamp_ms is not None:
                 parts = []
                 for t in topics:
                     meta = consumer.list_topics(t, timeout=10)
@@ -114,9 +133,14 @@ def read(
                         continue
                     raise RuntimeError(f"kafka: {msg.error()}")
                 self._deliver(msg)
-                # broker-side position tracking: committed offsets make the
-                # consumer deliver only new messages across restarts, which
-                # matches replay_style='live' (journal supplies history)
+                # client-side offset frontier: the checkpoint records it
+                # and resume seeks exactly past this message — the journal
+                # never sees kafka events
+                self.mark_frontier(
+                    {f"{msg.topic()}\x00{msg.partition()}": msg.offset() + 1}
+                )
+                # broker-side committed offsets stay best-effort (other
+                # consumers / lag monitoring)
                 try:
                     consumer.commit(msg, asynchronous=True)
                 except Exception:  # noqa: BLE001 — commit is best-effort
@@ -152,7 +176,7 @@ def read(
         name=name or f"kafka:{','.join(topics)}",
         # committed broker offsets mean only-new delivery after restart;
         # the persistence journal replays history (never skip live events)
-        replay_style="live",
+        replay_style="offset",  # client-side offset frontier + seek-on-resume
     )
 
 

@@ -49,6 +49,21 @@ class ConnectorSubject:
         row = tuple(kwargs.get(n) for n in self._schema_names)
         self._session.insert(self._key_for(kwargs), row)
 
+    # -------------------------------------------------- offset frontiers
+    # (reference: src/persistence/frontier.rs OffsetAntichain) — subjects
+    # over seekable sources mark consumed positions and seek on resume;
+    # pair with read(replay_style="offset").
+
+    def mark_frontier(self, frontier: dict) -> None:
+        """Everything delivered so far is covered by {partition: position}."""
+        assert self._session is not None
+        self._session.mark_frontier(frontier)
+
+    def resume_frontier(self) -> dict:
+        """The committed frontier of the previous run ({} = cold start)."""
+        assert self._session is not None
+        return dict(self._session.resume_frontier or {})
+
     def next_json(self, message: dict | str | bytes) -> None:
         if isinstance(message, (str, bytes)):
             message = _json.loads(message)

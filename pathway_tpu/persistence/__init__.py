@@ -254,12 +254,17 @@ class MetadataStore:
         signature: str,
         finalized_time: int,
         prev: "dict | None | object" = _UNSET,
+        frontiers: dict | None = None,
     ) -> None:
         record = {
             "epoch": epoch,
             "offsets": offsets,
             "signature": signature,
             "finalized_time": finalized_time,
+            # per-source offset frontiers (seekable sources: the source
+            # seeks here on resume instead of journaling every event —
+            # reference: src/persistence/frontier.rs OffsetAntichain)
+            "frontiers": frontiers or {},
             "committed_at": _time.time(),
         }
         # keep the PREVIOUS epoch's record: multi-process recovery may
@@ -273,7 +278,8 @@ class MetadataStore:
         if prev is not None:
             record["history"] = [
                 {k: prev[k] for k in
-                 ("epoch", "offsets", "signature", "finalized_time")
+                 ("epoch", "offsets", "signature", "finalized_time",
+                  "frontiers")
                  if k in prev}
             ]
         _fsync_write(self.path, _json.dumps(record).encode())
@@ -368,6 +374,9 @@ class CheckpointManager:
         self._last_checkpoint = _time.monotonic()
         self._writers: dict[str, _SegmentWriter] = {}
         self._restored_offsets: dict[str, int] = {}
+        # per-connector offset frontiers from the restored epoch (seekable
+        # sources seek here instead of journal replay)
+        self.restored_frontiers: dict[str, dict] = {}
         self.restored = False
 
     # ------------------------------------------------------------ restore
@@ -455,6 +464,7 @@ class CheckpointManager:
                 self.epoch = int(meta["epoch"])
                 self.restored = True
                 self._restored_offsets = offsets
+                self.restored_frontiers = dict(meta.get("frontiers", {}))
                 if epoch is not None:
                     # rollback: rewrite the on-disk record to the agreed
                     # epoch NOW, else the next commit would chain its
@@ -466,6 +476,7 @@ class CheckpointManager:
                         str(meta.get("signature")),
                         int(meta.get("finalized_time", 0)),
                         prev=None,
+                        frontiers=self.restored_frontiers,
                     )
                 return offsets
         # fall back to full journal replay — only sound if the head exists
@@ -505,14 +516,33 @@ class CheckpointManager:
         interval = self.config.snapshot_interval_ms / 1000.0
         return (_time.monotonic() - self._last_checkpoint) >= interval
 
+    def frontier_advanced(self) -> bool:
+        """True when some offset-aware connector's frontier moved past
+        what the last checkpoint committed (the pump checkpoints even on
+        data-quiet streams then)."""
+        committed = getattr(self, "_committed_frontiers", {})
+        for c in getattr(self.session, "connectors", []):
+            fr = getattr(c, "current_frontier", None)
+            if fr is not None and fr != committed.get(c.name):
+                return True
+        return False
+
     def checkpoint(self, finalized_time: int) -> None:
         """Durable commit of everything consumed so far."""
         self._last_checkpoint = _time.monotonic()
-        # 1. journal segments durable
+        # 1. journal segments durable + offset frontiers of seekable
+        # sources (their events are never journaled; the frontier IS the
+        # durable input record)
         offsets: dict[str, int] = {}
         for name, w in self._writers.items():
             w.flush(sync=True)
             offsets[name] = w.next_offset
+        frontiers: dict[str, dict] = {}
+        for c in getattr(self.session, "connectors", []):
+            fr = getattr(c, "current_frontier", None)
+            if fr is not None:
+                frontiers[c.name] = dict(fr)
+        self._committed_frontiers = frontiers
         # 2. operator snapshots for the next epoch
         epoch = self.epoch + 1
         wrote_ops = False
@@ -525,7 +555,8 @@ class CheckpointManager:
         # 3. metadata commit (the linearization point)
         prev_record = self.metadata.load()
         self.metadata.commit(
-            epoch, offsets, self.signature, finalized_time, prev=prev_record
+            epoch, offsets, self.signature, finalized_time, prev=prev_record,
+            frontiers=frontiers,
         )
         self.epoch = epoch
         # 4. compaction — keep TWO epochs of snapshots and the journal
@@ -586,24 +617,47 @@ def attach_persistence(session: Any, config: Config) -> None:
     else:
         replay_offsets = manager.restore()
 
-    from pathway_tpu.engine.runtime import Connector
+    from pathway_tpu.engine.runtime import Connector, OffsetMark
 
     class PersistentConnector(Connector):
-        """Journals the parsed event stream; on restart replays the
-        journal tail (after the committed offset — operator snapshots
-        already contain everything before it) and seeks the live source
-        past every journaled event."""
+        """Durability wrapper, per the source's replay style:
+
+        * 'offset' — the source emits OffsetMark frontiers (fs byte
+          positions, kafka offsets). NOTHING is journaled: events are
+          delivered only up to the last mark (the rest is held one poll),
+          the checkpoint records the frontier, and on restart the source
+          SEEKS past it (reference: frontier.rs OffsetAntichain +
+          data_storage.rs:303-320 seek). Token-resident batches flow
+          through untouched — full native ingest speed under persistence.
+        * 'seekable' — deterministic re-readers without offsets: journal
+          everything, count-skip the re-read on resume.
+        * 'live' — message queues: journal everything; the journal
+          supplies history, the source only ever delivers new events.
+        """
 
         def __init__(self, inner: Connector, name: str):
             super().__init__(name, inner.session)
             self.inner = inner
+            self.style = (
+                "offset" if inner.replay_style == "offset" else
+                "seekable" if inner.replay_style == "seekable" else "live"
+            )
+            if self.style == "offset":
+                self.frontier: dict = dict(
+                    manager.restored_frontiers.get(name, {})
+                )
+                inner.session.resume_frontier = dict(self.frontier)
+                self._held: list = []
+                self.tail = []
+                self.skip = 0
+                return
             self.committed = replay_offsets.get(name, 0)
             self.tail = manager.journal.load_from(name, self.committed)
             total = manager.journal.total_events(name)
             # seekable sources re-read from the start: skip events the
             # journal already has. Live sources (message queues) only
             # deliver new events — skip nothing.
-            self.skip = total if inner.replay_style == "seekable" else 0
+            self.skip = total if self.style == "seekable" else 0
             manager.open_writer(name, total)
             self._replay_done = False
             self._seen = 0
@@ -611,7 +665,30 @@ def attach_persistence(session: Any, config: Config) -> None:
         def start(self) -> None:
             self.inner.start()
 
+        @property
+        def current_frontier(self) -> dict | None:
+            """Checkpointed by the manager: covers exactly the events
+            delivered so far (held events are re-read after resume)."""
+            return self.frontier if self.style == "offset" else None
+
+        def _poll_offset(self) -> list:
+            staged = self.session.drain()
+            out: list = []
+            for seg in staged:
+                if type(seg) is OffsetMark:
+                    out.extend(self._held)
+                    self._held.clear()
+                    self.frontier.update(seg.frontier)
+                else:
+                    self._held.append(seg)
+            if self.inner.finished.is_set() and not self.session._staged:
+                out.extend(self._held)
+                self._held.clear()
+            return out
+
         def poll(self) -> list:
+            if self.style == "offset":
+                return self._poll_offset()
             out = []
             if not self._replay_done:
                 self._replay_done = True
@@ -620,8 +697,8 @@ def attach_persistence(session: Any, config: Config) -> None:
                 self.tail = []
             live = self.inner.poll()
             # token-resident segments journal via the object plane (the
-            # journal format is per-event); native speed returns once the
-            # source seeks by offset frontier instead of journaling
+            # per-event journal format); offset-style sources keep native
+            # speed because they never journal
             if any(type(seg) is not tuple for seg in live):
                 flat: list = []
                 for seg in live:
@@ -646,6 +723,8 @@ def attach_persistence(session: Any, config: Config) -> None:
 
         @property
         def done(self) -> bool:
+            if self.style == "offset":
+                return self.inner.done and not self._held
             return self.inner.done
 
     session.connectors = [
