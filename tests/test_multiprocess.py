@@ -394,3 +394,140 @@ def test_multiprocess_kill_both_and_resume_exact(tmp_path):
         t0, n0 = expected.get(g, (0, 0))
         expected[g] = (t0 + i, n0 + 1)
     assert state == expected, (state, expected)
+
+
+CRASH_SCRIPT = textwrap.dedent(
+    """
+    import json, os, sys, threading, time
+    sys.path.insert(0, {repo!r})
+    import pathway_tpu as pw
+    from pathway_tpu.io.python import ConnectorSubject
+
+    OUT = sys.argv[1]       # deliveries jsonl, appended across runs
+    PDIR = sys.argv[2]
+    MODE = sys.argv[3]      # 'crash' or 'finish'
+    PID = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+
+    class Part(ConnectorSubject):
+        def __init__(self, lo, hi):
+            self.lo, self.hi = lo, hi
+
+        def run(self):
+            for i in range(self.lo, self.hi):
+                self.next(g=f"g{{i % 5}}", v=i)
+                time.sleep(0.002)
+
+    a = pw.io.python.read(Part(0, 200), schema=pw.schema_from_types(g=str, v=int), name="a")
+    b = pw.io.python.read(Part(200, 400), schema=pw.schema_from_types(g=str, v=int), name="b")
+    t = a.concat_reindex(b)
+    agg = t.groupby(t.g).reduce(t.g, total=pw.reducers.sum(t.v), n=pw.reducers.count())
+    sink = open(OUT + f".{{PID}}", "a")
+    def on_change(key, row, time, is_addition):
+        sink.write(json.dumps(
+            {{"g": row["g"], "total": row["total"], "n": row["n"], "add": is_addition}}
+        ) + "\\n")
+        sink.flush()
+    pw.io.subscribe(agg, on_change=on_change)
+
+    if MODE == "crash" and PID == 1:
+        def crasher():
+            # kill -9 semantics AFTER both processes committed an epoch
+            metas = [os.path.join(PDIR, f"proc-{{p}}", "metadata.json") for p in (0, 1)]
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if all(os.path.exists(m) for m in metas):
+                    os._exit(9)
+                time.sleep(0.005)
+            os._exit(3)
+        threading.Thread(target=crasher, daemon=True).start()
+
+    pw.run(persistence_config=pw.persistence.Config(
+        pw.persistence.Backend.filesystem(PDIR),
+        snapshot_interval_ms=60))
+    """
+)
+
+
+def _consolidate_deliveries(path):
+    state = {}
+    if not os.path.exists(path):
+        return state
+    with open(path) as f:
+        for line in f:
+            ev = json.loads(line)
+            if ev["add"]:
+                state[ev["g"]] = (ev["total"], ev["n"])
+            elif state.get(ev["g"]) == (ev["total"], ev["n"]):
+                del state[ev["g"]]
+    return state
+
+
+def _spawn_mesh(out, pdir, mode, base, n=2):
+    procs = []
+    for pid in range(n):
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PATHWAY_PROCESSES": str(n),
+            "PATHWAY_PROCESS_ID": str(pid),
+            "PATHWAY_FIRST_PORT": str(base),
+        }
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", CRASH_SCRIPT.format(repo=REPO), out, pdir, mode],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    return procs
+
+
+def test_mesh_kill9_coordinated_recovery(tmp_path):
+    """Fault injection (the wordcount test_recovery pattern): kill -9 one
+    process of a 2-process mesh mid-stream after a committed epoch, kill
+    the stalled survivor, restart the mesh on the same persistence roots
+    — coordinated min-epoch recovery yields EXACT aggregates."""
+    out = str(tmp_path / "deliv")
+    pdir = str(tmp_path / "pstorage")
+    base = _free_port_base(2)
+
+    procs = _spawn_mesh(out, pdir, "crash", base)
+    # process 1 self-kills (os._exit(9)) after both epochs commit
+    try:
+        _o, err1 = procs[1].communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+    assert procs[1].returncode == 9, (procs[1].returncode, err1[-2000:])
+    # the survivor is now stuck/broken on the dead peer: kill -9 it too
+    try:
+        procs[0].wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        procs[0].wait()
+
+    # restart the whole mesh on fresh ports, same persistence roots
+    base2 = _free_port_base(2)
+    procs2 = _spawn_mesh(out, pdir, "finish", base2)
+    for p in procs2:
+        try:
+            _o, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs2:
+                q.kill()
+            raise
+        assert p.returncode == 0, err[-3000:]
+
+    combined: dict = {}
+    for pid in range(2):
+        share = _consolidate_deliveries(out + f".{pid}")
+        for g, tn in share.items():
+            assert g not in combined, f"group {g} delivered on two processes"
+            combined[g] = tn
+    expected: dict = {}
+    for i in range(400):
+        g = f"g{i % 5}"
+        t0, n0 = expected.get(g, (0, 0))
+        expected[g] = (t0 + i, n0 + 1)
+    assert combined == expected, (combined, expected)
