@@ -53,8 +53,48 @@ class Backend:
         return b
 
     @classmethod
-    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
-        raise NotImplementedError("s3 persistence backend requires boto3 (unavailable)")
+    def s3(
+        cls,
+        root_path: str,
+        bucket_settings: Any = None,
+        *,
+        bucket: str | None = None,
+        client: Any = None,
+    ) -> "Backend":
+        """Object-store persistence (reference: src/persistence/backends/
+        s3.rs). The run stages through a local directory; every checkpoint
+        syncs changed journal segments / operator snapshots up and commits
+        `metadata.json` LAST (the S3 linearization point), and attach
+        rebuilds the staging directory from the bucket, so a fresh host
+        resumes from object storage alone.
+
+        `bucket_settings`: pw.io.s3.AwsS3Settings (boto3-gated);
+        `client`: injected boto3-compatible client (tests / custom auth);
+        PATHWAY_S3_FAKE_DIR routes to the built-in directory-backed fake
+        (dev machines without S3)."""
+        b = cls(root_path.strip("/"))
+        b.kind = "s3"
+        fake_dir = os.environ.get("PATHWAY_S3_FAKE_DIR")
+        if client is None and fake_dir:
+            client = _DirS3Client(fake_dir)
+            # bucket id doubles as the staging-dir key: make it unique
+            # per fake directory so concurrent test runs never share one
+            bucket = bucket or fake_dir
+        if client is None:
+            if bucket_settings is None:
+                raise ValueError(
+                    "Backend.s3 needs bucket_settings (pw.io.s3."
+                    "AwsS3Settings) or an injected client"
+                )
+            client = bucket_settings.client()
+            bucket = bucket or bucket_settings.bucket_name
+        if not bucket:
+            raise ValueError(
+                "Backend.s3 with an injected client needs bucket=..."
+            )
+        b.s3_client = client
+        b.s3_bucket = bucket
+        return b
 
     @classmethod
     def azure(cls, *args: Any, **kwargs: Any) -> "Backend":
@@ -87,6 +127,17 @@ class Config:
             "udf_caching",
         )
 
+    def with_backend(self, backend: Backend) -> "Config":
+        """Same settings against another backend (mesh per-process roots,
+        S3 staging redirection)."""
+        return Config(
+            backend,
+            snapshot_interval_ms=self.snapshot_interval_ms,
+            persistence_mode=self.persistence_mode,
+            continue_after_replay=self.continue_after_replay,
+            operator_snapshots=self.operator_snapshots,
+        )
+
     @classmethod
     def simple_config(cls, backend: Backend, **kwargs: Any) -> "Config":
         return cls(backend, **kwargs)
@@ -97,6 +148,122 @@ class Config:
         cached under the backend, but no input journaling / replay /
         operator snapshots are attached (reference: udf caching mode)."""
         return cls(backend, persistence_mode="UDF_CACHING")
+
+
+class _DirS3Client:
+    """Directory-backed stand-in for the boto3 S3 surface the sync layer
+    uses (put/get/list/delete) — the mocked-S3 test target and a dev
+    shim; enable via PATHWAY_S3_FAKE_DIR."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _p(self, key: str) -> str:
+        return os.path.join(self.root, key.replace("/", "\x01"))
+
+    def put_object(self, Bucket: str, Key: str, Body: bytes) -> None:  # noqa: N803
+        _fsync_write(self._p(Key), Body)
+
+    def get_object(self, Bucket: str, Key: str) -> dict:  # noqa: N803
+        import io as _io
+
+        p = self._p(Key)
+        if not os.path.exists(p):
+            raise KeyError(Key)
+        with open(p, "rb") as f:
+            return {"Body": _io.BytesIO(f.read())}
+
+    def delete_object(self, Bucket: str, Key: str) -> None:  # noqa: N803
+        try:
+            os.unlink(self._p(Key))
+        except OSError:
+            pass
+
+    def list_objects_v2(self, Bucket: str, Prefix: str = "", **kw: Any) -> dict:  # noqa: N803
+        out = []
+        for fn in sorted(os.listdir(self.root)):
+            key = fn.replace("\x01", "/")
+            if key.startswith(Prefix):
+                out.append({"Key": key, "Size": os.path.getsize(os.path.join(self.root, fn))})
+        return {"Contents": out} if out else {}
+
+
+class _S3Sync:
+    """Staging-directory <-> object-store synchronizer.
+
+    Layout: every file under the local root maps to `{root_path}/{rel}`.
+    `pull` resets the staging dir from the bucket (S3 is the source of
+    truth on attach); `push` uploads new/changed files and deletes
+    removed ones, with metadata.json strictly LAST so a crash mid-push
+    leaves the previous epoch intact and readable.
+    """
+
+    def __init__(self, client: Any, bucket: str, root_path: str, local: str):
+        self.client = client
+        self.bucket = bucket
+        self.prefix = root_path.strip("/") + "/"
+        self.local = local
+        self._pushed: dict[str, tuple[float, int]] = {}
+
+    def _keys(self) -> list[str]:
+        resp = self.client.list_objects_v2(Bucket=self.bucket, Prefix=self.prefix)
+        return [c["Key"] for c in resp.get("Contents", [])]
+
+    def pull(self) -> None:
+        import shutil
+
+        if os.path.exists(self.local):
+            shutil.rmtree(self.local)
+        os.makedirs(self.local, exist_ok=True)
+        self._pushed.clear()
+        for key in self._keys():
+            rel = key[len(self.prefix):]
+            dst = os.path.join(self.local, rel)
+            os.makedirs(os.path.dirname(dst) or self.local, exist_ok=True)
+            body = self.client.get_object(Bucket=self.bucket, Key=key)["Body"].read()
+            with open(dst, "wb") as f:
+                f.write(body)
+            st = os.stat(dst)
+            self._pushed[rel] = (st.st_mtime, st.st_size)
+
+    def push(self) -> None:
+        current: dict[str, tuple[float, int]] = {}
+        meta_rel = None
+        for dirpath, _dirs, files in os.walk(self.local):
+            for fn in files:
+                if fn.endswith(".tmp"):
+                    continue
+                p = os.path.join(dirpath, fn)
+                rel = os.path.relpath(p, self.local)
+                st = os.stat(p)
+                current[rel] = (st.st_mtime, st.st_size)
+        ordered = sorted(current)
+        for rel in ordered:
+            if rel == MetadataStore.FILE:
+                meta_rel = rel
+                continue
+            if self._pushed.get(rel) != current[rel]:
+                with open(os.path.join(self.local, rel), "rb") as f:
+                    self.client.put_object(
+                        Bucket=self.bucket, Key=self.prefix + rel, Body=f.read()
+                    )
+        # deletions (compacted segments / old snapshots)
+        for rel in list(self._pushed):
+            if rel not in current:
+                self.client.delete_object(
+                    Bucket=self.bucket, Key=self.prefix + rel
+                )
+        # the commit point: metadata.json goes up last, and ALWAYS — an
+        # (mtime, size) quick-check could skip a same-size rewrite on
+        # coarse-timestamp filesystems and strand the bucket one epoch
+        # behind; the file is tiny
+        if meta_rel is not None:
+            with open(os.path.join(self.local, meta_rel), "rb") as f:
+                self.client.put_object(
+                    Bucket=self.bucket, Key=self.prefix + meta_rel, Body=f.read()
+                )
+        self._pushed = current
 
 
 def _fsync_write(path: str, data: bytes) -> None:
@@ -588,25 +755,56 @@ class CheckpointManager:
 
 def attach_persistence(session: Any, config: Config) -> None:
     """Wire journaling + operator snapshots + replay into a session."""
-    if config.backend.kind != "filesystem" or not config.backend.path:
-        return
     if config.persistence_mode in ("UDF_CACHING", "udf_caching"):
         return  # cache-only mode: UDF caches use the backend directly
-    if getattr(session, "mesh", None) is not None:
+    s3_sync = None
+    if config.backend.kind == "s3":
+        import tempfile
+
+        root_path = config.backend.path or "pathway"
+        if getattr(session, "mesh", None) is not None:
+            root_path = f"{root_path}/proc-{session.mesh.process_id}"
+        # per-run private staging dir: a fixed shared path would let a
+        # second attach rmtree a live run's tree (pull() resets from the
+        # bucket anyway, so nothing needs to survive locally)
+        local = tempfile.mkdtemp(prefix="pathway-s3-stage-")
+        s3_sync = _S3Sync(
+            config.backend.s3_client, config.backend.s3_bucket, root_path, local
+        )
+        s3_sync.pull()  # the bucket is the source of truth on attach
+        config = config.with_backend(Backend.filesystem(local))
+    elif config.backend.kind != "filesystem" or not config.backend.path:
+        return
+    elif getattr(session, "mesh", None) is not None:
         # each cooperating process owns its shard of operator state and
         # its own sources: persistence roots are per-process
-        config = Config(
+        config = config.with_backend(
             Backend.filesystem(
                 os.path.join(
                     config.backend.path, f"proc-{session.mesh.process_id}"
                 )
-            ),
-            snapshot_interval_ms=config.snapshot_interval_ms,
-            persistence_mode=config.persistence_mode,
-            continue_after_replay=config.continue_after_replay,
-            operator_snapshots=config.operator_snapshots,
+            )
         )
     manager = CheckpointManager(session, config)
+    if s3_sync is not None:
+        # every durable commit ships to the bucket; metadata.json last
+        # (see _S3Sync.push) so a crash mid-upload keeps the prior epoch
+        _orig_ckpt = manager.checkpoint
+        _orig_close = manager.close
+
+        def _ckpt_and_push(t: int) -> None:
+            _orig_ckpt(t)
+            s3_sync.push()
+
+        def _close_and_push() -> None:
+            import shutil
+
+            _orig_close()
+            s3_sync.push()
+            shutil.rmtree(s3_sync.local, ignore_errors=True)
+
+        manager.checkpoint = _ckpt_and_push  # type: ignore[method-assign]
+        manager.close = _close_and_push  # type: ignore[method-assign]
     if getattr(session, "mesh", None) is not None:
         # coordinated recovery: a crash can land BETWEEN two processes'
         # commits of the same epoch, so resume from the MINIMUM epoch all

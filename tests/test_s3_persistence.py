@@ -1,0 +1,159 @@
+"""S3/object-store persistence backend (VERDICT r2 item 6): the staging
+sync layer against the built-in directory-backed S3 fake — journal +
+snapshot roundtrip, restart-from-bucket-only, and kill -9 recovery.
+Reference: /root/reference/src/persistence/backends/s3.rs."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+SCRIPT = textwrap.dedent(
+    """
+    import os, sys, threading, time
+    sys.path.insert(0, {repo!r})
+    import pathway_tpu as pw
+    from pathway_tpu.io.python import ConnectorSubject
+
+    OUT = sys.argv[1]
+    MODE = sys.argv[2]  # 'run' | 'crash'
+    N = int(sys.argv[3])
+
+    class Words(ConnectorSubject):
+        def run(self):
+            for i in range(N):
+                self.next(word=f"w{{i % 7}}")
+                time.sleep(0.002)
+
+    t = pw.io.python.read(Words(), schema=pw.schema_from_types(word=str), name="words")
+    counts = t.groupby(t.word).reduce(t.word, count=pw.reducers.count())
+    sink = open(OUT, "a")
+    def on_change(key, row, time, is_addition):
+        sink.write(__import__("json").dumps(
+            {{"word": row["word"], "count": row["count"], "add": is_addition}}
+        ) + "\\n")
+        sink.flush()
+    pw.io.subscribe(counts, on_change=on_change)
+
+    if MODE == "crash":
+        def crasher():
+            fake = os.environ["PATHWAY_S3_FAKE_DIR"]
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                # wait for a metadata.json OBJECT in the bucket
+                if any("metadata.json" in f for f in os.listdir(fake)):
+                    os._exit(17)
+                time.sleep(0.01)
+            os._exit(3)
+        threading.Thread(target=crasher, daemon=True).start()
+
+    pw.run(persistence_config=pw.persistence.Config(
+        pw.persistence.Backend.s3("ckpt/root"),
+        snapshot_interval_ms=50))
+    """
+)
+
+
+def _run(repo, fake_dir, out, mode, n, timeout=120):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PATHWAY_S3_FAKE_DIR": fake_dir,
+    }
+    return subprocess.run(
+        [sys.executable, "-c", SCRIPT.format(repo=repo), out, mode, str(n)],
+        capture_output=True, timeout=timeout, text=True, env=env,
+    )
+
+
+def _consolidate(path):
+    state = {}
+    if not os.path.exists(path):
+        return state
+    with open(path) as f:
+        for line in f:
+            ev = json.loads(line)
+            if ev["add"]:
+                state[ev["word"]] = ev["count"]
+            elif state.get(ev["word"]) == ev["count"]:
+                del state[ev["word"]]
+    return state
+
+
+@pytest.fixture()
+def repo():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_s3_sync_roundtrip(tmp_path):
+    """Unit roundtrip: push a staging tree, wipe it, pull it back."""
+    from pathway_tpu.persistence import _DirS3Client, _S3Sync
+
+    fake = str(tmp_path / "bucket")
+    local = str(tmp_path / "stage")
+    os.makedirs(os.path.join(local, "operator"))
+    with open(os.path.join(local, "words.0.seg"), "wb") as f:
+        f.write(b"journal-bytes")
+    with open(os.path.join(local, "operator", "n1.1.state"), "wb") as f:
+        f.write(b"snapshot-bytes")
+    with open(os.path.join(local, "metadata.json"), "w") as f:
+        json.dump({"epoch": 1}, f)
+
+    sync = _S3Sync(_DirS3Client(fake), "fake", "ckpt/root", local)
+    sync.push()
+    keys = sorted(sync._keys())
+    assert keys == [
+        "ckpt/root/metadata.json",
+        "ckpt/root/operator/n1.1.state",
+        "ckpt/root/words.0.seg",
+    ]
+
+    sync2 = _S3Sync(_DirS3Client(fake), "fake", "ckpt/root", local)
+    sync2.pull()  # resets staging from the bucket
+    with open(os.path.join(local, "words.0.seg"), "rb") as f:
+        assert f.read() == b"journal-bytes"
+    with open(os.path.join(local, "operator", "n1.1.state"), "rb") as f:
+        assert f.read() == b"snapshot-bytes"
+    with open(os.path.join(local, "metadata.json")) as f:
+        assert json.load(f) == {"epoch": 1}
+
+    # deletion propagates (journal compaction)
+    os.unlink(os.path.join(local, "words.0.seg"))
+    sync2.push()
+    assert "ckpt/root/words.0.seg" not in sync2._keys()
+
+
+def test_s3_backend_end_to_end_restart(repo, tmp_path):
+    """A full run persists to the bucket; a SECOND run (fresh staging —
+    different fake dir path is the same bucket, staging is keyed off it)
+    replays nothing and emits nothing new; exact counts survive."""
+    fake = str(tmp_path / "bucket")
+    out = str(tmp_path / "deliveries.jsonl")
+    r1 = _run(repo, fake, out, "run", 140)
+    assert r1.returncode == 0, r1.stderr[-2000:]
+    expected = {f"w{i}": 20 for i in range(7)}
+    assert _consolidate(out) == expected
+    assert any("metadata.json" in f for f in os.listdir(fake))
+
+    r2 = _run(repo, fake, out, "run", 140)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert _consolidate(out) == expected
+
+
+def test_s3_backend_kill9_recovery(repo, tmp_path):
+    """kill -9 after the first bucket commit: resume pulls the staging
+    tree from the bucket and finishes with exact counts."""
+    fake = str(tmp_path / "bucket")
+    out = str(tmp_path / "deliveries.jsonl")
+    r1 = _run(repo, fake, out, "crash", 400)
+    assert r1.returncode == 17, (r1.returncode, r1.stderr[-2000:])
+
+    r2 = _run(repo, fake, out, "run", 400)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    expected = {f"w{i}": 400 // 7 + (1 if i < 400 % 7 else 0) for i in range(7)}
+    assert _consolidate(out) == expected
