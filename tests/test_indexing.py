@@ -365,3 +365,59 @@ def test_quantized_knn_recall():
             docs.astype(jnp.float32)[qz])),
         atol=2e-2,
     )
+
+
+def test_sort_incremental_update_cost():
+    """SortNode updates are O(delta log n), not O(n log n) per wave
+    (VERDICT r2 item 8): after building a 100k-row instance, a 1-row
+    update must re-emit only the 3 affected rows and run orders of
+    magnitude faster than a rebuild."""
+    import time as _time
+
+    from pathway_tpu.engine.core import Graph, InputNode, SortNode
+    from pathway_tpu.internals.keys import Key
+
+    g = Graph()
+    inp = InputNode(g)
+    node = SortNode(g, inp, lambda key, row: row[0], lambda key, row: 0)
+
+    n = 100_000
+    entries = [(Key(i + 1), (i * 2,), 1) for i in range(n)]
+    inp.push(entries)
+    g.step(2)
+    assert node.rows_out == n  # initial emission covers everything
+
+    before = node.rows_out
+    t0 = _time.perf_counter()
+    waves = 50
+    for w in range(waves):
+        # insert between two existing sort values -> 3 affected rows each
+        inp.push([(Key(n + 10 + w), (2 * w + 100_001,), 1)])
+        g.step(4 + 2 * w)
+    per_wave = (_time.perf_counter() - t0) / waves
+    emitted = node.rows_out - before
+    # 1 new row + up to 2 neighbor updates, each a retract+insert pair
+    assert emitted <= waves * 5, emitted
+    # a full 100k re-sort per wave costs >25ms in this engine; the
+    # incremental path is bisect + 3 emissions
+    assert per_wave < 0.005, f"per-wave {per_wave*1000:.1f}ms — not incremental"
+
+
+def test_sort_bulk_load_not_quadratic():
+    """A descending-order bulk wave must take the one-sort path, not
+    per-row list inserts at position 0 (O(n^2) memmove)."""
+    import time as _time
+
+    from pathway_tpu.engine.core import Graph, InputNode, SortNode
+    from pathway_tpu.internals.keys import Key
+
+    g = Graph()
+    inp = InputNode(g)
+    node = SortNode(g, inp, lambda key, row: row[0], lambda key, row: 0)
+    n = 100_000
+    t0 = _time.perf_counter()
+    inp.push([(Key(i + 1), (n - i,), 1) for i in range(n)])
+    g.step(2)
+    el = _time.perf_counter() - t0
+    assert node.rows_out == n
+    assert el < 2.0, f"descending bulk load took {el:.2f}s"
