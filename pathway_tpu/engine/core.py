@@ -1562,7 +1562,11 @@ class SortNode(Node):
 
     def _bulk_load(self, entries: list[Entry], affected: dict) -> None:
         """Pure-insert wave: group, extend, ONE sort per instance — per-
-        entry bisect.insert would be O(n^2) memmove on descending input."""
+        entry bisect.insert would be O(n^2) memmove on descending input.
+        Only inserted items and their post-sort neighbors are affected
+        (an instance much larger than the wave must not be re-emitted)."""
+        import bisect
+
         per_inst: dict[Any, list] = defaultdict(list)
         for key, row, _diff in entries:
             inst = freeze_value(self.instance_fn(key, row))
@@ -1573,8 +1577,17 @@ class SortNode(Node):
             order = self.instances[inst]
             order.extend(items)
             order.sort()
-            for _sv, _kv, key in order:
-                affected.setdefault(key, None)
+            if len(items) * 2 >= len(order):
+                for _sv, _kv, key in order:
+                    affected.setdefault(key, None)
+                continue
+            for item in items:
+                i = bisect.bisect_left(order, item)
+                affected.setdefault(item[2], None)
+                if i > 0:
+                    affected.setdefault(order[i - 1][2], None)
+                if i + 1 < len(order):
+                    affected.setdefault(order[i + 1][2], None)
 
     def finish_time(self, time: int) -> None:
         import bisect

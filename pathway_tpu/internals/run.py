@@ -74,6 +74,14 @@ def run(
         else:
             session.execute()
     finally:
+        # restore the terminal if the monitoring TUI was live
+        for m in session.monitors:
+            live = getattr(m, "live", None)
+            if live is not None:
+                try:
+                    live.stop()
+                except Exception:  # noqa: BLE001
+                    pass
         if telemetry is not None:
             telemetry.operator_stats(session.graph)
             telemetry.shutdown()

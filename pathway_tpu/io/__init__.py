@@ -26,6 +26,7 @@ from pathway_tpu.io import (  # noqa: E402
     nats,
     postgres,
     pubsub,
+    pyfilesystem,
     redpanda,
     s3,
     s3_csv,
@@ -37,5 +38,5 @@ __all__ = [
     "csv", "fs", "jsonlines", "null", "plaintext", "python", "subscribe",
     "kafka", "redpanda", "s3", "s3_csv", "minio", "deltalake", "sqlite",
     "nats", "postgres", "elasticsearch", "mongodb", "debezium", "bigquery",
-    "pubsub", "logstash", "http", "gdrive", "slack", "airbyte",
+    "pubsub", "pyfilesystem", "logstash", "http", "gdrive", "slack", "airbyte",
 ]
