@@ -328,10 +328,15 @@ class ProcessExchangeNode(Node):
         mesh: Any,
         route: RouteFn | None,
         wire_id: int,
+        native_route: Any = None,
     ):
         super().__init__(graph, [inp])
         self.mesh = mesh
         self.route = route
+        # token-resident route plan (('key',) | ('group', cols)): native
+        # batches split in C and cross the mesh in wire form — unique-row
+        # blob + flat arrays — instead of per-row pickled tuples
+        self.native_route = native_route
         # wire identity: must match across processes (same program, same
         # creation order) and be unique across sessions sharing one
         # process-wide mesh — the lowering allocates it
@@ -347,13 +352,42 @@ class ProcessExchangeNode(Node):
     def restore_state(self, st: dict) -> None:
         self.round = st["round"]
 
+    def _split_native(self, batch: Any, n: int):
+        """Per-process sub-batches of a NativeBatch, or None (no plan /
+        plan rejected the batch -> object-plane fallback)."""
+        plan = self.native_route
+        if plan is None:
+            return None
+        from pathway_tpu.engine.native import dataplane as dp
+
+        if plan[0] == "key":
+            shards = dp.route_key(batch.key_lo, batch.key_hi, n)
+        else:
+            res = dp.project_group(batch.tab, batch.token, plan[1], n_shards=n)
+            if res is None:
+                return None
+            shards = res[1]
+        return [batch.select(shards == p) for p in range(n)]
+
     def finish_time(self, time: int) -> None:
-        entries = self.take_input()
+        batches, entries = self.take_segments()
         n = self.mesh.n
         me = self.mesh.process_id
         buckets: list[list[Entry]] = [[] for _ in range(n)]
+        nb_buckets: list[list] = [[] for _ in range(n)]
+        for b in batches:
+            subs = self._split_native(b, n) if self.route is not None else None
+            if subs is None:
+                if self.route is None:
+                    nb_buckets[0].append(b)
+                else:
+                    entries = b.materialize() + entries
+                continue
+            for p, sub in enumerate(subs):
+                if len(sub):
+                    nb_buckets[p].append(sub)
         if self.route is None:
-            buckets[0] = entries
+            buckets[0].extend(entries)
         else:
             for entry in entries:
                 key, row, _diff = entry
@@ -363,10 +397,27 @@ class ProcessExchangeNode(Node):
                     p = 0
                 buckets[p].append(entry)
         for p in self.mesh.peers:
-            self.mesh.send_bucket(p, self.wire_id, self.round, buckets[p])
+            wires = [b.to_wire() for b in nb_buckets[p]]
+            self.mesh.send_bucket(
+                p, self.wire_id, self.round, (buckets[p], wires)
+            )
         merged = list(buckets[me])
+        local_batches = list(nb_buckets[me])
         for p in self.mesh.peers:
-            merged.extend(self.mesh.recv_bucket(p, self.wire_id, self.round))
+            payload = self.mesh.recv_bucket(p, self.wire_id, self.round)
+            if isinstance(payload, tuple):
+                ents, wires = payload
+                merged.extend(ents)
+                if wires:
+                    from pathway_tpu.engine.native import dataplane as dp
+
+                    local_batches.extend(
+                        dp.NativeBatch.from_wire(w) for w in wires
+                    )
+            else:  # legacy plain-entry frame
+                merged.extend(payload)
         self.round += 1
+        for b in local_batches:
+            self.emit(time, b)
         if merged:
             self.emit(time, merged)

@@ -141,10 +141,15 @@ class Session:
         return self._session_seq * 1_000_000 + self._exchange_seq
 
     def _process_exchange(
-        self, nodes: list[eng.Node], route_fns: list[Callable] | None
+        self,
+        nodes: list[eng.Node],
+        route_fns: list[Callable] | None,
+        native_routes: list | None = None,
     ) -> list[eng.Node]:
         """Wrap operator inputs with inter-process exchange boundaries.
-        route_fns=None pins everything to process 0 (global-state ops)."""
+        route_fns=None pins everything to process 0 (global-state ops).
+        native_routes lets token batches split in C and cross the mesh in
+        wire form instead of per-row pickles."""
         if self.mesh is None:
             return nodes
         from pathway_tpu.engine.workers import ProcessExchangeNode
@@ -156,6 +161,9 @@ class Session:
                 self.mesh,
                 None if route_fns is None else route_fns[i],
                 wire_id=self._next_wire_id(),
+                native_route=(
+                    None if native_routes is None else native_routes[i]
+                ),
             )
             for i, node in enumerate(nodes)
         ]
@@ -184,7 +192,7 @@ class Session:
             native_routes = [
                 ("key",) if fn is _route_key else None for fn in route_fns
             ]
-        inputs = self._process_exchange(list(inputs), route_fns)
+        inputs = self._process_exchange(list(inputs), route_fns, native_routes)
         if self.n_workers <= 1:
             return factory(self.graph, list(inputs))
         return ShardedNode(

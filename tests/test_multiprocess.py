@@ -531,3 +531,80 @@ def test_mesh_kill9_coordinated_recovery(tmp_path):
         t0, n0 = expected.get(g, (0, 0))
         expected[g] = (t0 + i, n0 + 1)
     assert combined == expected, (combined, expected)
+
+
+NATIVE_WIRE_SCRIPT = textwrap.dedent(
+    """
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    import pathway_tpu as pw
+
+    OUT = sys.argv[1]
+    INPUT = sys.argv[2]
+    PID = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+
+    class S(pw.Schema):
+        word: str
+
+    # one fs source (owned by process 0); the groupby exchange ships the
+    # token batches to their owner processes in wire form
+    t = pw.io.fs.read(INPUT, format="json", schema=S, mode="streaming",
+                      autocommit_duration_ms=20, _single_pass=True)
+    agg = t.groupby(t.word).reduce(t.word, n=pw.reducers.count())
+    rows = {{}}
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            rows[row["word"]] = row["n"]
+        elif rows.get(row["word"]) == row["n"]:
+            del rows[row["word"]]
+    pw.io.subscribe(agg, on_change=on_change)
+    pw.run()
+    json.dump(rows, open(OUT + f".{{PID}}", "w"))
+    """
+)
+
+
+def test_native_batches_cross_process_wire(tmp_path):
+    """Token-resident fs ingest under a 2-process mesh: batches split in
+    C and cross the TCP mesh in wire form; combined counts are exact."""
+    inp = tmp_path / "in.jsonl"
+    with open(inp, "w") as f:
+        for i in range(900):
+            f.write('{"word": "w%d"}\n' % (i % 6))
+    out = str(tmp_path / "out")
+    base = _free_port_base(2)
+    procs = []
+    for pid in range(2):
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PATHWAY_PROCESSES": "2",
+            "PATHWAY_PROCESS_ID": str(pid),
+            "PATHWAY_FIRST_PORT": str(base),
+        }
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c",
+                 NATIVE_WIRE_SCRIPT.format(repo=REPO), out, str(inp)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    for p in procs:
+        try:
+            _o, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, err[-3000:]
+    combined = {}
+    shares = []
+    for pid in range(2):
+        share = json.load(open(out + f".{pid}"))
+        shares.append(share)
+        for w, n in share.items():
+            assert w not in combined
+            combined[w] = n
+    assert combined == {f"w{i}": 150 for i in range(6)}
+    assert all(shares), f"one process owned everything: {shares}"
