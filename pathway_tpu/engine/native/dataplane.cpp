@@ -198,7 +198,13 @@ struct InternTable {
 // ----------------------------------------------------------- piece helpers
 
 constexpr uint8_t TAG_NONE = 0x00, TAG_BOOL = 0x01, TAG_INT = 0x02,
-                  TAG_FLOAT = 0x03, TAG_STR = 0x04, TAG_BYTES = 0x05;
+                  TAG_FLOAT = 0x03, TAG_STR = 0x04, TAG_BYTES = 0x05,
+                  TAG_KEY = 0x06;
+// Plane-internal ERROR poison marker (self-describing, 1 byte). NOT part
+// of keys._serialize_value: rows carrying it never feed key hashing —
+// group keys for ERROR groups are computed Python-side canonically, and
+// join keys containing it are dropped (forbid_tag below).
+constexpr uint8_t TAG_ERROR = 0x0E;
 
 inline void put_i64(std::string& out, int64_t v) {
     char b[8];
@@ -231,6 +237,14 @@ inline void piece_str(std::string& out, const char* s, int64_t len) {
     out.append(s, static_cast<size_t>(len));
 }
 
+inline void piece_key(std::string& out, uint64_t lo, uint64_t hi) {
+    out.push_back(static_cast<char>(TAG_KEY));
+    char b[16];
+    std::memcpy(b, &lo, 8);
+    std::memcpy(b + 8, &hi, 8);
+    out.append(b, 16);  // 128-bit key, little-endian (keys.py Key piece)
+}
+
 // Walk one piece starting at p (within [p, end)); returns pointer past it,
 // or nullptr on malformed/unsupported data.
 inline const char* skip_piece(const char* p, const char* end) {
@@ -238,9 +252,11 @@ inline const char* skip_piece(const char* p, const char* end) {
     uint8_t tag = static_cast<uint8_t>(*p++);
     switch (tag) {
         case TAG_NONE: return p;
+        case TAG_ERROR: return p;
         case TAG_BOOL: return p + 1 <= end ? p + 1 : nullptr;
         case TAG_INT:
         case TAG_FLOAT: return p + 8 <= end ? p + 8 : nullptr;
+        case TAG_KEY: return p + 16 <= end ? p + 16 : nullptr;
         case TAG_STR:
         case TAG_BYTES: {
             if (p + 8 > end) return nullptr;
@@ -994,10 +1010,13 @@ int64_t dp_decode_str_cols(void* h, int64_t n, const uint64_t* tokens,
 // blake2b(canonical tuple serialization)[0:8] % n_shards when n_shards>0
 // (must stay byte-identical to workers._shard_of). Returns 0 or -1-i on
 // malformed row i.
+// forbid_tag != 0: rows whose projected pieces include that tag get
+// gtoken 0 (invalid) instead of a group — join keys must drop ERROR rows
+// like the object plane's _jk_of, while group-bys keep them as a group.
 int64_t dp_project_group(void* h, int64_t n, const uint64_t* tokens,
                          const int64_t* col_idx, int64_t n_cols,
                          int64_t n_shards, uint64_t* out_gtoken,
-                         int64_t* out_shard) {
+                         int64_t* out_shard, uint8_t forbid_tag) {
     auto* tab = static_cast<InternTable*>(h);
     std::vector<const char*> starts(static_cast<size_t>(n_cols));
     std::vector<const char*> ends(static_cast<size_t>(n_cols));
@@ -1018,8 +1037,17 @@ int64_t dp_project_group(void* h, int64_t n, const uint64_t* tokens,
             !find_cols(row, rlen, col_idx, n_cols, starts.data(), ends.data()))
             return -1 - i;
         gbytes.clear();
-        for (int64_t j = 0; j < n_cols; ++j)
+        bool forbidden = false;
+        for (int64_t j = 0; j < n_cols; ++j) {
+            if (forbid_tag != 0 &&
+                static_cast<uint8_t>(*starts[j]) == forbid_tag)
+                forbidden = true;
             gbytes.append(starts[j], static_cast<size_t>(ends[j] - starts[j]));
+        }
+        if (forbidden) {
+            gid_of_row[static_cast<size_t>(i)] = -1;
+            continue;
+        }
         auto it = local.find(std::string_view(gbytes));
         int64_t gid;
         if (it != local.end()) {
@@ -1070,6 +1098,11 @@ int64_t dp_project_group(void* h, int64_t n, const uint64_t* tokens,
     }
     for (int64_t i = 0; i < n; ++i) {
         int64_t gid = gid_of_row[static_cast<size_t>(i)];
+        if (gid < 0) {  // forbidden (ERROR join key)
+            out_gtoken[i] = 0;
+            if (n_shards > 0) out_shard[i] = 0;
+            continue;
+        }
         out_gtoken[i] = gtok[static_cast<size_t>(gid)];
         if (n_shards > 0) out_shard[i] = shard_of_gid[static_cast<size_t>(gid)];
     }
@@ -1403,6 +1436,156 @@ int64_t dp_export_tokens(void* h, int64_t n, uint64_t* tokens, char* blob,
         tokens[i] = static_cast<uint64_t>(it->second);
     }
     return (used <= blob_cap && n_u <= ulen_cap) ? n_u : -used;
+}
+
+// ----------------------------------------------------------- join kernel
+//
+// Token-resident incremental equi-join (reference: join_tables,
+// src/engine/dataflow.rs:2270, over differential's arrange/join). Each
+// side keeps jk_token -> multiset of (key_lo, key_hi, row_token); the
+// delta rule dL ⋈ R_old + L_new ⋈ dR runs entirely on these flat ids,
+// and output rows assemble as piece(lkey)+piece(rkey)+lrow+rrow bytes
+// with blake2b output keys — byte-identical to the Python plane's
+// hash_values(lkey, rkey) rows.
+
+namespace {
+
+struct JRow {
+    uint64_t lo, hi, tok;
+    bool operator==(const JRow& o) const {
+        return lo == o.lo && hi == o.hi && tok == o.tok;
+    }
+};
+
+struct JRowHash {
+    size_t operator()(const JRow& r) const {
+        uint64_t x = r.lo ^ (r.hi * 0x9E3779B97F4A7C15ull) ^
+                     (r.tok * 0xBF58476D1CE4E5B9ull);
+        x ^= x >> 33;
+        x *= 0xFF51AFD7ED558CCDull;
+        x ^= x >> 33;
+        return static_cast<size_t>(x);
+    }
+};
+
+struct JoinArr {
+    std::unordered_map<uint64_t,
+                       std::unordered_map<JRow, int64_t, JRowHash>> groups;
+};
+
+}  // namespace
+
+void* dj_new() { return new JoinArr(); }
+void dj_free(void* h) { delete static_cast<JoinArr*>(h); }
+
+void dj_update(void* h, int64_t n, const uint64_t* jk, const uint64_t* klo,
+               const uint64_t* khi, const uint64_t* tok, const int64_t* diff) {
+    auto* arr = static_cast<JoinArr*>(h);
+    for (int64_t i = 0; i < n; ++i) {
+        auto& g = arr->groups[jk[i]];
+        JRow r{klo[i], khi[i], tok[i]};
+        int64_t c = (g[r] += diff[i]);
+        if (c == 0) {
+            g.erase(r);
+            if (g.empty()) arr->groups.erase(jk[i]);
+        }
+    }
+}
+
+// Cross each input row with the OTHER side's current group. Emits flat
+// (input_idx, other_klo, other_khi, other_tok, other_count) tuples.
+// Returns count, or negated required capacity when cap is too small.
+int64_t dj_probe(void* other_h, int64_t n, const uint64_t* jk, int64_t cap,
+                 int64_t* out_idx, uint64_t* out_klo, uint64_t* out_khi,
+                 uint64_t* out_tok, int64_t* out_cnt) {
+    auto* other = static_cast<JoinArr*>(other_h);
+    int64_t m = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        auto it = other->groups.find(jk[i]);
+        if (it == other->groups.end()) continue;
+        for (const auto& kv : it->second) {
+            if (m < cap) {
+                out_idx[m] = i;
+                out_klo[m] = kv.first.lo;
+                out_khi[m] = kv.first.hi;
+                out_tok[m] = kv.first.tok;
+                out_cnt[m] = kv.second;
+            }
+            ++m;
+        }
+    }
+    return m <= cap ? m : -m;
+}
+
+int64_t dj_len(void* h) {
+    auto* arr = static_cast<JoinArr*>(h);
+    int64_t n = 0;
+    for (const auto& g : arr->groups) n += static_cast<int64_t>(g.second.size());
+    return n;
+}
+
+// Full-state export for operator snapshots: one row per (jk, row) pair.
+int64_t dj_export(void* h, uint64_t* jk, uint64_t* klo, uint64_t* khi,
+                  uint64_t* tok, int64_t* cnt) {
+    auto* arr = static_cast<JoinArr*>(h);
+    int64_t m = 0;
+    for (const auto& g : arr->groups) {
+        for (const auto& kv : g.second) {
+            jk[m] = g.first;
+            klo[m] = kv.first.lo;
+            khi[m] = kv.first.hi;
+            tok[m] = kv.first.tok;
+            cnt[m] = kv.second;
+            ++m;
+        }
+    }
+    return m;
+}
+
+// Assemble joined output rows: for pair p, row bytes =
+// piece_key(lkey) + piece_key(rkey) + lrow_bytes + rrow_bytes, interned;
+// out key: id_mode 0 = blake2b(piece_key(l)+piece_key(r)) (hash),
+// 1 = left key, 2 = right key. Returns 0 or -1-p on a bad row token.
+int64_t dp_join_rows(void* h, int64_t n, const uint64_t* l_lo,
+                     const uint64_t* l_hi, const uint64_t* l_tok,
+                     const uint64_t* r_lo, const uint64_t* r_hi,
+                     const uint64_t* r_tok, int64_t id_mode,
+                     uint64_t* out_lo, uint64_t* out_hi, uint64_t* out_tok) {
+    auto* tab = static_cast<InternTable*>(h);
+    std::string row_bytes, keys_bytes;
+    PendingRows pend;
+    {
+        std::shared_lock<std::shared_mutex> rg(tab->mu);
+        for (int64_t i = 0; i < n; ++i) {
+            const char* lrow;
+            int64_t llen;
+            const char* rrow;
+            int64_t rlen;
+            if (!tab->get(l_tok[i], &lrow, &llen) ||
+                !tab->get(r_tok[i], &rrow, &rlen))
+                return -1 - i;
+            row_bytes.clear();
+            piece_key(row_bytes, l_lo[i], l_hi[i]);
+            piece_key(row_bytes, r_lo[i], r_hi[i]);
+            row_bytes.append(lrow, static_cast<size_t>(llen));
+            row_bytes.append(rrow, static_cast<size_t>(rlen));
+            pend.add(row_bytes, i);
+            if (id_mode == 1) {
+                out_lo[i] = l_lo[i];
+                out_hi[i] = l_hi[i];
+            } else if (id_mode == 2) {
+                out_lo[i] = r_lo[i];
+                out_hi[i] = r_hi[i];
+            } else {
+                keys_bytes.assign(row_bytes, 0, 34);  // the two key pieces
+                blake2b_128(
+                    reinterpret_cast<const uint8_t*>(keys_bytes.data()),
+                    keys_bytes.size(), &out_lo[i], &out_hi[i]);
+            }
+        }
+    }
+    pend.intern_all(tab, out_tok);
+    return 0;
 }
 
 // Import: intern each blob row (offsets implied by ulen), then map local

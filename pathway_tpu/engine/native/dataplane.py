@@ -118,7 +118,8 @@ def _load() -> ctypes.CDLL | None:
         ]
         lib.dp_project_group.restype = c.c_int64
         lib.dp_project_group.argtypes = [
-            c.c_void_p, c.c_int64, u64p, i64p, c.c_int64, c.c_int64, u64p, i64p,
+            c.c_void_p, c.c_int64, u64p, i64p, c.c_int64, c.c_int64, u64p,
+            i64p, c.c_uint8,
         ]
         lib.dp_route_key.argtypes = [c.c_int64, u64p, u64p, c.c_int64, i64p]
         lib.dp_build_rows.restype = c.c_int64
@@ -135,6 +136,24 @@ def _load() -> ctypes.CDLL | None:
         lib.dp_distinct_check.argtypes = [c.c_int64, u64p, u64p, i64p]
         lib.dp_consolidate.restype = c.c_int64
         lib.dp_consolidate.argtypes = [c.c_int64, u64p, u64p, u64p, i64p]
+        lib.dj_new.restype = c.c_void_p
+        lib.dj_free.argtypes = [c.c_void_p]
+        lib.dj_update.argtypes = [
+            c.c_void_p, c.c_int64, u64p, u64p, u64p, u64p, i64p,
+        ]
+        lib.dj_probe.restype = c.c_int64
+        lib.dj_probe.argtypes = [
+            c.c_void_p, c.c_int64, u64p, c.c_int64, i64p, u64p, u64p, u64p, i64p,
+        ]
+        lib.dj_len.restype = c.c_int64
+        lib.dj_len.argtypes = [c.c_void_p]
+        lib.dj_export.restype = c.c_int64
+        lib.dj_export.argtypes = [c.c_void_p, u64p, u64p, u64p, u64p, i64p]
+        lib.dp_join_rows.restype = c.c_int64
+        lib.dp_join_rows.argtypes = [
+            c.c_void_p, c.c_int64, u64p, u64p, u64p, u64p, u64p, u64p,
+            c.c_int64, u64p, u64p, u64p,
+        ]
         lib.dp_export_tokens.restype = c.c_int64
         lib.dp_export_tokens.argtypes = [
             c.c_void_p, c.c_int64, u64p, c.c_char_p, c.c_int64, i64p, c.c_int64,
@@ -153,7 +172,9 @@ def available() -> bool:
 
 # -------------------------------------------------------- row (de)serialize
 
-_TAG_NONE, _TAG_BOOL, _TAG_INT, _TAG_FLOAT, _TAG_STR, _TAG_BYTES = range(6)
+_TAG_NONE, _TAG_BOOL, _TAG_INT, _TAG_FLOAT, _TAG_STR, _TAG_BYTES, _TAG_KEY = (
+    range(7)
+)
 
 
 def decode_row(b: bytes) -> tuple:
@@ -185,6 +206,13 @@ def decode_row(b: bytes) -> tuple:
             pos += 8
             out.append(b[pos : pos + ln])
             pos += ln
+        elif tag == _TAG_KEY:
+            out.append(Key(int.from_bytes(b[pos : pos + 16], "little")))
+            pos += 16
+        elif tag == 0x0E:
+            from pathway_tpu.internals.errors import ERROR
+
+            out.append(ERROR)
         else:
             raise ValueError(f"non-scalar tag {tag} in native row")
     return tuple(out)
@@ -210,6 +238,13 @@ def encode_scalar(v: Any) -> bytes | None:
         return b"\x04" + struct.pack("<q", len(eb)) + eb
     if t is bytes:
         return b"\x05" + struct.pack("<q", len(v)) + v
+    if t is Key:
+        return b"\x06" + v.value.to_bytes(16, "little")
+    from pathway_tpu.internals.errors import ErrorValue
+
+    if isinstance(v, ErrorValue):
+        # plane-internal poison marker (never feeds key hashing)
+        return b"\x0e"
     return None
 
 
@@ -412,6 +447,87 @@ class NativeBatch:
         return NativeBatch(tab, lo, hi, tok, diff)
 
 
+class NativeJoinArr:
+    """C++ join-side arrangement: jk_token -> multiset of (key, row token)."""
+
+    def __init__(self) -> None:
+        lib = _load()
+        assert lib is not None
+        self._lib = lib
+        self._h = lib.dj_new()
+
+    def __del__(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.dj_free(self._h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return self._lib.dj_len(self._h)
+
+    def update(self, jk, key_lo, key_hi, token, diff) -> None:
+        self._lib.dj_update(
+            self._h, len(jk),
+            np.ascontiguousarray(jk), np.ascontiguousarray(key_lo),
+            np.ascontiguousarray(key_hi), np.ascontiguousarray(token),
+            np.ascontiguousarray(diff),
+        )
+
+    def probe(self, jk: np.ndarray):
+        """Cross each jk[i] with this arrangement's group: returns
+        (input_idx, key_lo, key_hi, token, count) flat match arrays."""
+        n = len(jk)
+        jk = np.ascontiguousarray(jk)
+        cap = max(4 * n, 256)
+        while True:
+            idx = np.empty(cap, np.int64)
+            klo = np.empty(cap, np.uint64)
+            khi = np.empty(cap, np.uint64)
+            tok = np.empty(cap, np.uint64)
+            cnt = np.empty(cap, np.int64)
+            m = self._lib.dj_probe(self._h, n, jk, cap, idx, klo, khi, tok, cnt)
+            if m >= 0:
+                return idx[:m], klo[:m], khi[:m], tok[:m], cnt[:m]
+            cap = -m
+
+    def export_state(self):
+        n = len(self)
+        jk = np.empty(n, np.uint64)
+        klo = np.empty(n, np.uint64)
+        khi = np.empty(n, np.uint64)
+        tok = np.empty(n, np.uint64)
+        cnt = np.empty(n, np.int64)
+        m = self._lib.dj_export(self._h, jk, klo, khi, tok, cnt)
+        assert m == n
+        return jk, klo, khi, tok, cnt
+
+
+def join_rows(
+    tab: InternTable,
+    l_lo, l_hi, l_tok,
+    r_lo, r_hi, r_tok,
+    id_mode: int = 0,
+):
+    """Assemble joined output rows (lkey, rkey, *lrow, *rrow) as interned
+    tokens with output keys (id_mode 0=hash, 1=left, 2=right) —
+    byte-identical to the object plane's join output rows."""
+    lib = _load()
+    n = len(l_tok)
+    out_lo = np.empty(n, np.uint64)
+    out_hi = np.empty(n, np.uint64)
+    out_tok = np.empty(n, np.uint64)
+    rc = lib.dp_join_rows(
+        tab._h, n,
+        np.ascontiguousarray(l_lo), np.ascontiguousarray(l_hi),
+        np.ascontiguousarray(l_tok),
+        np.ascontiguousarray(r_lo), np.ascontiguousarray(r_hi),
+        np.ascontiguousarray(r_tok),
+        id_mode, out_lo, out_hi, out_tok,
+    )
+    if rc != 0:
+        return None
+    return out_lo, out_hi, out_tok
+
+
 # ------------------------------------------------------------------ ingest
 
 
@@ -550,9 +666,12 @@ def decode_str_cols(tab: InternTable, tokens: np.ndarray, col_idx: list[int]):
 
 
 def project_group(
-    tab: InternTable, tokens: np.ndarray, col_idx: list[int], n_shards: int = 0
+    tab: InternTable, tokens: np.ndarray, col_idx: list[int], n_shards: int = 0,
+    forbid_error: bool = False,
 ):
-    """-> (gtokens, shards|None); None result on malformed rows."""
+    """-> (gtokens, shards|None); None result on malformed rows.
+    forbid_error: rows whose projected pieces carry the ERROR tag get
+    gtoken 0 (join-key semantics — the object plane drops ERROR jks)."""
     lib = _load()
     n = len(tokens)
     gt = np.empty(n, np.uint64)
@@ -560,6 +679,7 @@ def project_group(
     rc = lib.dp_project_group(
         tab._h, n, np.ascontiguousarray(tokens),
         np.asarray(col_idx, np.int64), len(col_idx), n_shards, gt, sh,
+        0x0E if forbid_error else 0,
     )
     if rc != 0:
         return None

@@ -94,6 +94,21 @@ def _canon(v: Any) -> Any:
     return v
 
 
+def native_shards(batch: Any, plan: Any, n: int):
+    """Shard array for a NativeBatch under a route plan (('key',) |
+    ('group', cols)), or None when the plan can't judge the batch. The
+    SINGLE dispatch point for thread- AND process-level native routing —
+    both must agree byte-for-byte with _shard_of."""
+    if plan is None:
+        return None
+    from pathway_tpu.engine.native import dataplane as dp
+
+    if plan[0] == "key":
+        return dp.route_key(batch.key_lo, batch.key_hi, n)
+    res = dp.project_group(batch.tab, batch.token, plan[1], n_shards=n)
+    return None if res is None else res[1]
+
+
 def _shard_of(token: Any, n: int) -> int:
     """Process-stable shard assignment. Python's hash() is salted per
     process (PYTHONHASHSEED), which would route a group to a different
@@ -212,15 +227,7 @@ class ShardedNode(Node):
         if plan is not None:
             import numpy as np
 
-            from pathway_tpu.engine.native import dataplane as dp
-
-            if plan[0] == "key":
-                shards = dp.route_key(batch.key_lo, batch.key_hi, self.n_shards)
-            else:  # ("group", [col_idx...])
-                res = dp.project_group(
-                    batch.tab, batch.token, plan[1], n_shards=self.n_shards
-                )
-                shards = None if res is None else res[1]
+            shards = native_shards(batch, plan, self.n_shards)
             if shards is not None:
                 touched = []
                 for s in np.unique(shards):
@@ -355,18 +362,9 @@ class ProcessExchangeNode(Node):
     def _split_native(self, batch: Any, n: int):
         """Per-process sub-batches of a NativeBatch, or None (no plan /
         plan rejected the batch -> object-plane fallback)."""
-        plan = self.native_route
-        if plan is None:
+        shards = native_shards(batch, self.native_route, n)
+        if shards is None:
             return None
-        from pathway_tpu.engine.native import dataplane as dp
-
-        if plan[0] == "key":
-            shards = dp.route_key(batch.key_lo, batch.key_hi, n)
-        else:
-            res = dp.project_group(batch.tab, batch.token, plan[1], n_shards=n)
-            if res is None:
-                return None
-            shards = res[1]
         return [batch.select(shards == p) for p in range(n)]
 
     def finish_time(self, time: int) -> None:

@@ -817,13 +817,55 @@ class Session:
         left_width = len(left_t._column_names())
         right_width = len(right_t._column_names())
         asof_now = spec.params.get("asof_now", False)
+
+        # Token-resident inner join (dataplane dj_* arrangements): applies
+        # when both sides are native-plane and every join key is a plain
+        # stably-typed scalar column (same identity gate as groupby).
+        left_node = self.node_of(left_t)
+        right_node = self.node_of(right_t)
+        native_plan = None
+        if (
+            mode == "inner"
+            and not asof_now
+            and id_mode in ("hash", "left", "right")
+            and left_t._spec.id in self._native_specs
+            and right_t._spec.id in self._native_specs
+        ):
+            def _plain_cols(exprs_side, table):
+                names = table._column_names()
+                cols = []
+                for e in exprs_side:
+                    if (
+                        isinstance(e, ex.ColumnReference)
+                        and not isinstance(e, ex.IdReference)
+                        and e.name in names
+                        and table._dtype_of(e.name) in (dt.INT, dt.STR, dt.BOOL)
+                    ):
+                        cols.append(names.index(e.name))
+                    else:
+                        return None
+                return cols
+
+            from pathway_tpu.internals import dtype as dt
+
+            l_cols = _plain_cols([le for le, _ in on], left_t)
+            r_cols = _plain_cols([re_ for _, re_ in on], right_t)
+            # per-pair dtype match: token identity is byte-based, so a
+            # BOOL key must not be asked to join an INT key (the object
+            # plane's dict equality would fold True == 1)
+            if l_cols is not None and r_cols is not None and all(
+                left_t._dtype_of(le.name) == right_t._dtype_of(re_.name)
+                for le, re_ in on
+            ):
+                native_plan = {"l_cols": l_cols, "r_cols": r_cols}
         jnode = self._sharded(
-            [self.node_of(left_t), self.node_of(right_t)],
+            [left_node, right_node],
             lambda sg, ins: eng.JoinNode(
                 sg, ins[0], ins[1], left_jk, right_jk,
                 mode=mode, id_mode=id_mode,
                 left_width=left_width, right_width=right_width,
                 asof_now=asof_now,
+                native_plan=native_plan,
             ),
             # exchange both sides on the join key (reference: Shard impls on
             # join arrangements, src/engine/dataflow/shard.rs)
@@ -831,10 +873,48 @@ class Session:
                 lambda key, row: eng.freeze_value(left_jk(key, row)),
                 lambda key, row: eng.freeze_value(right_jk(key, row)),
             ],
+            native_routes=(
+                [("group", native_plan["l_cols"]), ("group", native_plan["r_cols"])]
+                if native_plan
+                else None
+            ),
         )
         jres = JoinResolver(left_t, right_t)
         fns = [compile_expression(e, jres) for e in out_exprs.values()]
         fn = self._guarded_row_fn(fns, getattr(spec, "trace", None))
+        if native_plan is not None:
+            # joined rows stay token-resident through the post-process
+            # select when every output is a plain column of the combined
+            # (lkey, rkey, *lrow, *rrow) row
+            specs: list | None = []
+            for e in out_exprs.values():
+                try:
+                    from pathway_tpu.internals.joins import _JoinIdRef
+
+                    if isinstance(e, _JoinIdRef):
+                        specs = None
+                        break
+                    if isinstance(e, ex.ColumnReference):
+                        _inp, idx = jres.resolve(e)
+                        if idx is None:
+                            specs = None
+                            break
+                        specs.append(("col", idx))
+                        continue
+                except Exception:  # noqa: BLE001
+                    specs = None
+                    break
+                specs = None
+                break
+            if specs is not None:
+                node = eng.MapNode(
+                    self.graph, jnode, fn,  # fn(key, *rows) ≡ fn(key, row)
+                    native_plan={
+                        "specs": specs, "plans": [], "needed_cols": [],
+                    },
+                )
+                self._native_specs.add(spec.id)
+                return node
         return self._sharded(
             [jnode], lambda sg, ins: eng.RowwiseNode(sg, ins, fn), [_route_key]
         )
