@@ -197,6 +197,29 @@ pw.run()
 print("ROWS_PER_SEC", {n} / (time.time() - t0))
 """
 
+_JOIN_SCRIPT = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import pathway_tpu as pw
+
+class U(pw.Schema):
+    uid: int
+    name: str
+
+class E(pw.Schema):
+    uid: int
+    amount: float
+
+t0 = time.time()
+u = pw.io.fs.read({users!r}, format="json", schema=U, mode="static")
+e = pw.io.fs.read({events!r}, format="json", schema=E, mode="static")
+j = e.join(u, e.uid == u.uid).select(name=u.name, amount=e.amount)
+agg = j.groupby(j.name).reduce(j.name, total=pw.reducers.sum(j.amount))
+pw.io.csv.write(agg, {out!r})
+pw.run()
+print("ROWS_PER_SEC", {n} / (time.time() - t0))
+"""
+
 _REGRESSION_SCRIPT = r"""
 import sys, time
 sys.path.insert(0, {repo!r})
@@ -320,6 +343,32 @@ def bench_dataflow(repo: str) -> dict:
             2,
         )
         out["bench_host_cpus"] = os.cpu_count()
+
+        # join ladder rung: 1M events x 10k users inner join -> groupby
+        # (token-resident C delta-join; not in BASELINE's ladder but the
+        # engine op the reference is famous for)
+        n_ev, n_users = 1_000_000, 10_000
+        uinp = os.path.join(tmp, "users.jsonl")
+        einp = os.path.join(tmp, "events.jsonl")
+        with open(uinp, "w") as f:
+            for i in range(n_users):
+                f.write('{"uid": %d, "name": "user%d"}\n' % (i, i))
+        with open(einp, "w") as f:
+            chunkw = []
+            for i in range(n_ev):
+                chunkw.append('{"uid": %d, "amount": %r}' % (i % n_users, float(i)))
+                if len(chunkw) == 200_000:
+                    f.write("\n".join(chunkw) + "\n")
+                    chunkw = []
+            if chunkw:
+                f.write("\n".join(chunkw) + "\n")
+        js = _JOIN_SCRIPT.format(
+            repo=repo, users=uinp, events=einp,
+            out=os.path.join(tmp, "join_out.csv"), n=n_ev,
+        )
+        out["join_rows_per_sec"] = round(
+            _run_engine_script(js, {"PATHWAY_THREADS": "1"}), 1
+        )
 
         rinp = os.path.join(tmp, "reg.jsonl")
         _gen_regression_input(rinp, REGRESSION_ROWS)
