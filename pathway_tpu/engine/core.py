@@ -217,6 +217,81 @@ class MultisetState:
         return dkey in self.groups
 
 
+# ------------------------------------------------- shard-rescale protocol
+#
+# Operator snapshots are taken per worker shard. The reference pins a
+# snapshot to its worker count (changing `-w` forces a cold start); here
+# a snapshot taken at PATHWAY_THREADS=N restores at THREADS=M by merging
+# the N shard states and re-partitioning along the operator's shard key
+# — the same `_shard_of` routing the exchange uses, so the restored
+# layout is byte-identical to what a fresh M-shard run would hold.
+#
+# `_state_routing` maps each persisted attr to how its entries route:
+#   "key"    — dict keyed by Key (or KeyedState): token = key.value
+#   "keytup" — dict keyed by (key.value, ...) tuples: token = entry[0]
+#   "token"  — dict (or MultisetState) keyed by the shard token itself
+# A list-valued attr (side tables) applies its rule element-wise. Nodes
+# whose state cannot be expressed this way override merge_shard_states /
+# split_shard_state; nodes that declare nothing refuse (the checkpoint
+# manager falls back to journal replay).
+
+
+class RescaleUnsupported(RuntimeError):
+    """This operator cannot re-partition its snapshot across a different
+    worker count; resume falls back to full journal replay."""
+
+
+def _merge_pair(a: Any, b: Any) -> Any:
+    """Union two per-shard state containers (disjoint by construction:
+    every shard key lives on exactly one shard)."""
+    if isinstance(a, KeyedState):
+        a.rows.update(b.rows)
+        return a
+    if isinstance(a, MultisetState):
+        a.groups.update(b.groups)
+        return a
+    if isinstance(a, dict):
+        a.update(b)
+        return a
+    if isinstance(a, list):
+        return [_merge_pair(x, y) for x, y in zip(a, b)]
+    raise RescaleUnsupported(f"cannot merge state of type {type(a).__name__}")
+
+
+def _split_container(value: Any, rule: str, n: int, shard_of) -> list[Any]:
+    """Partition one state container into n shard-local containers."""
+    if isinstance(value, list):
+        parts_per_elem = [_split_container(v, rule, n, shard_of) for v in value]
+        return [[pe[s] for pe in parts_per_elem] for s in range(n)]
+    if isinstance(value, KeyedState):
+        outs = [KeyedState() for _ in range(n)]
+        for key, row in value.rows.items():
+            outs[shard_of(key.value)].rows[key] = row
+        return outs
+    if isinstance(value, MultisetState):
+        outs = [MultisetState() for _ in range(n)]
+        for dkey, group in value.groups.items():
+            outs[shard_of(dkey)].groups[dkey] = group
+        return outs
+    if isinstance(value, dict):
+        if isinstance(value, defaultdict) and value.default_factory is not None:
+            factory = value.default_factory
+            fresh: Callable[[], dict] = lambda: defaultdict(factory)  # noqa: E731
+        else:
+            fresh = dict
+        outs = [fresh() for _ in range(n)]
+        for k, v in value.items():
+            if rule == "key":
+                tok = k.value
+            elif rule == "keytup":
+                tok = k[0]
+            else:  # "token"
+                tok = k
+            outs[shard_of(tok)][k] = v
+        return outs
+    raise RescaleUnsupported(f"cannot split state of type {type(value).__name__}")
+
+
 # ------------------------------------------------------------------- nodes
 
 
@@ -335,6 +410,47 @@ class Node:
         for a, v in state.items():
             setattr(self, a, v)
 
+    # See the shard-rescale protocol above: declares, per persisted attr,
+    # how snapshot entries route across worker shards. None = this node
+    # type refuses rescale (journal-replay fallback). The methods take
+    # `self` so nodes with run-local state (native join/groupby intern
+    # tokens) can consult their plan; they are called on a template
+    # replica, never mutate it.
+    _state_routing: dict[str, str] | None = None
+
+    def merge_shard_states(self, states: list[dict]) -> dict:
+        """Union per-shard snapshots into one logical state (shard keys
+        are disjoint across shards by construction)."""
+        if not states:
+            return {}
+        merged = dict(states[0])
+        for st in states[1:]:
+            for attr, v in st.items():
+                if attr in merged:
+                    merged[attr] = _merge_pair(merged[attr], v)
+                else:
+                    merged[attr] = v
+        return merged
+
+    def split_shard_state(self, merged: dict, n: int, shard_of) -> list[dict]:
+        """Partition a merged snapshot into n shard-local snapshots using
+        the same routing the exchange applies to live rows."""
+        routing = self._state_routing
+        if routing is None:
+            raise RescaleUnsupported(
+                f"{type(self).__name__} does not support worker-count rescale"
+            )
+        outs: list[dict] = [{} for _ in range(n)]
+        for attr, value in merged.items():
+            rule = routing.get(attr)
+            if rule is None:
+                raise RescaleUnsupported(
+                    f"{type(self).__name__}.{attr} has no shard routing"
+                )
+            for s, part in enumerate(_split_container(value, rule, n, shard_of)):
+                outs[s][attr] = part
+        return outs
+
 
 class Graph:
     """Owns nodes in topological (creation) order."""
@@ -428,6 +544,13 @@ class RowwiseNode(Node):
     Input 0 drives the universe; inputs 1..n are key-aligned side tables
     whose current row is visible to the expressions.
     """
+
+    _state_routing = {
+        "side_states": "key",
+        "emitted": "key",
+        "deferred": "key",
+        "_main_state_": "key",
+    }
 
     def __init__(
         self,
@@ -717,6 +840,7 @@ class SetOpNode(Node):
     """
 
     _persist_attrs = ("main", "others", "emitted")
+    _state_routing = {"main": "key", "others": "key", "emitted": "key"}
 
     def persist_signature(self) -> str:
         return f"SetOpNode/{len(self.inputs)}/{self.mode}"
@@ -755,6 +879,7 @@ class UpdateRowsNode(Node):
     """union with right-priority (reference: update_rows dataflow.rs)."""
 
     _persist_attrs = ("left", "right", "emitted")
+    _state_routing = {"left": "key", "right": "key", "emitted": "key"}
 
     def __init__(self, graph: Graph, left: Node, right: Node):
         super().__init__(graph, [left, right])
@@ -784,6 +909,7 @@ class UpdateCellsNode(Node):
     """Override selected columns where the right table has the key."""
 
     _persist_attrs = ("left", "right", "emitted")
+    _state_routing = {"left": "key", "right": "key", "emitted": "key"}
 
     def persist_signature(self) -> str:
         return f"UpdateCellsNode/{self.col_map}"
@@ -831,6 +957,7 @@ class JoinNode(Node):
     """
 
     _persist_attrs = ("left_state", "right_state")
+    _state_routing = {"left_state": "token", "right_state": "token"}
 
     def persist_signature(self) -> str:
         return (
@@ -838,6 +965,80 @@ class JoinNode(Node):
             f"/{self.right_width}/{int(self.asof_now)}"
             f"/native={int(getattr(self, '_plan', None) is not None)}"
         )
+
+    def merge_shard_states(self, states: list[dict]) -> dict:
+        if not states or "njoin" not in states[0]:
+            return super().merge_shard_states(states)
+        # native arrangements: concat the flat arrays; intern ids are
+        # consistent across shards (one process-wide table wrote them),
+        # so the byte maps union without renumbering
+        merged = []
+        for side in range(2):
+            exps = [st["njoin"][side] for st in states]
+            jk_bytes: dict = {}
+            tok_bytes: dict = {}
+            for e in exps:
+                jk_bytes.update(e["jk_bytes"])
+                tok_bytes.update(e["tok_bytes"])
+            merged.append({
+                "jk": np.concatenate([e["jk"] for e in exps]),
+                "klo": np.concatenate([e["klo"] for e in exps]),
+                "khi": np.concatenate([e["khi"] for e in exps]),
+                "tok": np.concatenate([e["tok"] for e in exps]),
+                "cnt": np.concatenate([e["cnt"] for e in exps]),
+                "jk_bytes": jk_bytes,
+                "tok_bytes": tok_bytes,
+            })
+        return {"njoin": merged}
+
+    def split_shard_state(self, merged: dict, n: int, shard_of) -> list[dict]:
+        if "njoin" not in merged:
+            return super().split_shard_state(merged, n, shard_of)
+        # shard of a jk = shard of its VALUE tuple: decode the canonical
+        # bytes back to values and route through the same _shard_of the
+        # live exchange uses (byte-identical to the C group route)
+        from pathway_tpu.engine.native import dataplane as _dp
+
+        outs: list[dict] = [{"njoin": [None, None]} for _ in range(n)]
+        for side in range(2):
+            exp = merged["njoin"][side]
+            jk = exp["jk"]
+            # vectorized: decode each UNIQUE jk once, scatter via inverse
+            uniq, inverse = (
+                np.unique(jk, return_inverse=True)
+                if len(jk)
+                else (np.empty(0, np.uint64), np.empty(0, np.intp))
+            )
+            uniq_shard = np.array(
+                [
+                    shard_of(_dp.decode_row(exp["jk_bytes"][int(t)]))
+                    for t in uniq
+                ],
+                dtype=np.int64,
+            )
+            shards = (
+                uniq_shard[inverse] if len(jk) else np.empty(0, np.int64)
+            )
+            for s in range(n):
+                sel = shards == s
+                sub_jk = exp["jk"][sel]
+                sub_tok = exp["tok"][sel]
+                outs[s]["njoin"][side] = {
+                    "jk": sub_jk,
+                    "klo": exp["klo"][sel],
+                    "khi": exp["khi"][sel],
+                    "tok": sub_tok,
+                    "cnt": exp["cnt"][sel],
+                    "jk_bytes": {
+                        int(t): exp["jk_bytes"][int(t)]
+                        for t in np.unique(sub_jk)
+                    },
+                    "tok_bytes": {
+                        int(t): exp["tok_bytes"][int(t)]
+                        for t in np.unique(sub_tok)
+                    },
+                }
+        return outs
 
     def persist_state(self) -> dict:
         if self._plan is None:
@@ -1247,6 +1448,205 @@ class GroupByNode(Node):
         )
         return f"GroupByNode/[{reds}]/native={int(self._native is not None)}"
 
+    # ------------------------------------------------------ shard rescale
+
+    def merge_shard_states(self, states: list[dict]) -> dict:
+        if not states:
+            return {}
+        if "native_plan" in states[0]:
+            # group-aligned arrays concatenate; slots align positionally
+            aggs = [st["native_plan"] for st in states]
+            merged_agg = {
+                k: np.concatenate([a[k] for a in aggs]) for k in aggs[0]
+            }
+            slots: list = []
+            emitted: dict = {}
+            for st in states:
+                slots.extend(st["slots"])
+                emitted.update(st["emitted"])
+            return {
+                "native_plan": merged_agg, "slots": slots, "emitted": emitted
+            }
+        if "native" in states[0]:
+            # dense per-shard group ids renumber into one merged id space
+            # (merged gid = row order); the result is a valid restore_state
+            # input so merge alone serves the rescale-to-one-worker case
+            merged_g2t: dict = {}
+            merged_info: list = []
+            total: list = []
+            red: dict[str, list] = {
+                k: [] for k in ("isum", "fsum", "cnt", "fseen", "err", "ovf")
+            }
+            emitted: dict = {}
+            for st in states:
+                exp, g2t, info = st["native"], st["gid_by_token"], st["ginfo"]
+                gid_to_tok = {gid: t for t, gid in g2t.items()}
+                m = len(exp["g"])
+                r = len(exp["isum"]) // m if m else 0
+                for i in range(m):
+                    gid = int(exp["g"][i])
+                    merged_g2t[gid_to_tok[gid]] = len(merged_info)
+                    merged_info.append(info[gid])
+                    total.append(exp["total"][i])
+                    for k in red:
+                        red[k].append(exp[k][i * r:(i + 1) * r])
+                emitted.update(st["emitted"])
+            m = len(merged_info)
+            exp_out = {"g": np.arange(m, dtype=np.uint64),
+                       "total": np.asarray(total, np.int64)}
+            for k, dt_ in (
+                ("isum", np.int64), ("fsum", np.float64), ("cnt", np.int64),
+                ("fseen", np.int64), ("err", np.int64), ("ovf", np.uint8),
+            ):
+                exp_out[k] = (
+                    np.concatenate(red[k]).astype(dt_)
+                    if red[k]
+                    else np.empty(0, dt_)
+                )
+            return {
+                "native": exp_out,
+                "gid_by_token": merged_g2t,
+                "ginfo": merged_info,
+                "emitted": emitted,
+            }
+        return super().merge_shard_states(states)
+
+    def split_shard_state(self, merged: dict, n: int, shard_of) -> list[dict]:
+        if "native" in merged:
+            # decompose the canonical merged export, routed by group token
+            exp, g2t, info = (
+                merged["native"], merged["gid_by_token"], merged["ginfo"]
+            )
+            gid_to_tok = {gid: t for t, gid in g2t.items()}
+            m = len(exp["g"])
+            r = len(exp["isum"]) // m if m else 0
+            gkey_shard: dict = {}
+            parts: list[dict] = [
+                {
+                    "native": {
+                        "g": [], "total": [],
+                        "isum": [], "fsum": [], "cnt": [],
+                        "fseen": [], "err": [], "ovf": [],
+                    },
+                    "gid_by_token": {},
+                    "ginfo": [],
+                    "emitted": {},
+                }
+                for _ in range(n)
+            ]
+            for i in range(m):
+                gid = int(exp["g"][i])
+                tok = gid_to_tok[gid]
+                s = shard_of(tok)
+                p = parts[s]
+                ngid = len(p["ginfo"])
+                p["ginfo"].append(info[gid])
+                p["gid_by_token"][tok] = ngid
+                p["native"]["g"].append(ngid)
+                p["native"]["total"].append(exp["total"][i])
+                for k in ("isum", "fsum", "cnt", "fseen", "err", "ovf"):
+                    p["native"][k].append(exp[k][i * r:(i + 1) * r])
+                gkey_shard[info[gid][0]] = s
+            for p in parts:
+                pe = p["native"]
+                pe["g"] = np.asarray(pe["g"], np.uint64)
+                pe["total"] = np.asarray(pe["total"], np.int64)
+                for k, dt_ in (
+                    ("isum", np.int64), ("fsum", np.float64),
+                    ("cnt", np.int64), ("fseen", np.int64),
+                    ("err", np.int64), ("ovf", np.uint8),
+                ):
+                    pe[k] = (
+                        np.concatenate(pe[k]).astype(dt_)
+                        if pe[k]
+                        else np.empty(0, dt_)
+                    )
+            for gkey, rrow in merged["emitted"].items():
+                s = gkey_shard.get(gkey)
+                if s is None:
+                    raise RescaleUnsupported(
+                        "groupby emitted key missing from ginfo"
+                    )
+                parts[s]["emitted"][gkey] = rrow
+            return parts
+        if "native_plan" in merged:
+            agg, slots = merged["native_plan"], merged["slots"]
+            m = len(slots)
+            r = len(agg["isum"]) // m if m else 0
+            # per-slot route token = the group's VALUE tuple, decoded from
+            # its canonical bytes ("b") or taken raw ("v" — the object
+            # plane routes these, same freeze_value token)
+            from pathway_tpu.engine.native import dataplane as _dp
+
+            shard_by_slot = np.empty(m, np.int64)
+            gkey_shard: dict[Key, int] = {}
+            for i, (kind, payload) in enumerate(slots):
+                if kind == "b":
+                    s = shard_of(_dp.decode_row(payload))
+                    gkey = Key(_hash_bytes_128(payload))
+                else:
+                    s = shard_of(freeze_value(tuple(payload)))
+                    gkey = key_for_values(*payload)
+                shard_by_slot[i] = s
+                gkey_shard[gkey] = s
+            outs: list[dict] = []
+            for s in range(n):
+                gi = np.nonzero(shard_by_slot == s)[0]
+                red_idx = (
+                    (gi[:, None] * r + np.arange(r)).ravel()
+                    if r
+                    else np.empty(0, np.int64)
+                )
+                sub_agg = {
+                    k: (
+                        v[gi]
+                        if k in ("g", "total")
+                        else v[red_idx]
+                    )
+                    for k, v in agg.items()
+                }
+                sub_emitted = {}
+                for k, v in merged["emitted"].items():
+                    ks = gkey_shard.get(k)
+                    if ks is None:
+                        raise RescaleUnsupported(
+                            "groupby emitted key missing from group slots"
+                        )
+                    if ks == s:
+                        sub_emitted[k] = v
+                outs.append({
+                    "native_plan": sub_agg,
+                    "slots": [slots[int(i)] for i in gi],
+                    "emitted": sub_emitted,
+                })
+            return outs
+        # python mode: keyed by the frozen group token; emitted is keyed
+        # by the group's OUTPUT key — derive its token through gkeys
+        key_tok = {
+            gkey: tok for tok, (gkey, _g) in merged.get("gkeys", {}).items()
+        }
+        outs = [
+            {
+                "state": st, "gkeys": gk, "stateful_state": ss, "emitted": {}
+            }
+            for st, gk, ss in zip(
+                _split_container(merged["state"], "token", n, shard_of),
+                _split_container(merged["gkeys"], "token", n, shard_of),
+                # stateful_state keys are (group_token, reducer_idx)
+                _split_container(
+                    merged["stateful_state"], "keytup", n, shard_of
+                ),
+            )
+        ]
+        for gkey, row in merged.get("emitted", {}).items():
+            tok = key_tok.get(gkey)
+            if tok is None:
+                raise RescaleUnsupported(
+                    "groupby emitted key missing from gkeys"
+                )
+            outs[shard_of(tok)]["emitted"][gkey] = row
+        return outs
+
     def persist_state(self) -> dict:
         if self._native is not None and self._plan is not None:
             # intern tokens are run-local: snapshot each group's canonical
@@ -1563,6 +1963,7 @@ class DeduplicateNode(Node):
     (reference: deduplicate dataflow.rs:3101)."""
 
     _persist_attrs = ("accepted", "ikeys")
+    _state_routing = {"accepted": "token", "ikeys": "token"}
 
     def __init__(
         self,
@@ -1624,6 +2025,30 @@ class IxNode(Node):
     pointer_fn(key, row) (reference: ix_table dataflow.rs:2133)."""
 
     _persist_attrs = ("source_by_ptr", "target_state", "emitted")
+
+    def split_shard_state(self, merged: dict, n: int, shard_of) -> list[dict]:
+        # input 0 routes by pointer token, input 1 by record key (the two
+        # agree: a Key pointer's token IS the target key's value); emitted
+        # is keyed by the SOURCE key, whose pointer token is recorded in
+        # source_by_ptr
+        outs = [
+            {"source_by_ptr": sp, "target_state": ts, "emitted": {}}
+            for sp, ts in zip(
+                _split_container(merged["source_by_ptr"], "token", n, shard_of),
+                _split_container(merged["target_state"], "key", n, shard_of),
+            )
+        ]
+        skey_shard: dict[Key, int] = {}
+        for ptr_tok, group in merged["source_by_ptr"].groups.items():
+            s = shard_of(ptr_tok)
+            for (skey, _srow, _ptr), _c in group.values():
+                skey_shard[skey] = s
+        for skey, row in merged["emitted"].items():
+            s = skey_shard.get(skey)
+            if s is None:
+                raise RescaleUnsupported("ix emitted key missing source row")
+            outs[s]["emitted"][skey] = row
+        return outs
 
     def __init__(
         self,
@@ -1705,6 +2130,26 @@ class SortNode(Node):
     re-emits 3 rows instead of 1M."""
 
     _persist_attrs = ("instances", "sortvals", "emitted")
+
+    def split_shard_state(self, merged: dict, n: int, shard_of) -> list[dict]:
+        # routed by instance; sortvals/emitted are keyed by row Key but
+        # each key's instance is recorded in sortvals
+        insts = _split_container(merged["instances"], "token", n, shard_of)
+        outs = [
+            {"instances": inst, "sortvals": {}, "emitted": {}}
+            for inst in insts
+        ]
+        key_shard: dict[Key, int] = {}
+        for key, (inst, sv) in merged["sortvals"].items():
+            s = shard_of(inst)
+            key_shard[key] = s
+            outs[s]["sortvals"][key] = (inst, sv)
+        for key, row in merged["emitted"].items():
+            s = key_shard.get(key)
+            if s is None:
+                raise RescaleUnsupported("sort emitted key missing sortval")
+            outs[s]["emitted"][key] = row
+        return outs
 
     def __init__(
         self,

@@ -577,6 +577,8 @@ class AsyncApplyNode(Node):
     the value the insertion produced, even for non-deterministic functions.
     """
 
+    _state_routing = {"memo": "keytup"}  # memo keys are (key.value, row)
+
     def __init__(
         self,
         graph: Graph,

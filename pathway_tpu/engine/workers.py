@@ -285,7 +285,10 @@ class ShardedNode(Node):
     # ----------------------------------------------- operator snapshots
 
     def persist_signature(self) -> str:
-        return f"Sharded({self.replicas[0].persist_signature()})x{self.n_shards}"
+        # worker-count independent: a snapshot taken at PATHWAY_THREADS=N
+        # restores at M by re-partitioning along the shard key (the
+        # checkpoint manager adapts the state before restore_state runs)
+        return self.replicas[0].persist_signature()
 
     def persist_state(self) -> dict | None:
         shards = [r.persist_state() for r in self.replicas]
@@ -295,15 +298,61 @@ class ShardedNode(Node):
 
     def restore_state(self, state: dict) -> None:
         if state.get("n_shards") != self.n_shards:
-            # resharding persisted per-worker state is not supported; the
-            # checkpoint manager falls back to full journal replay
+            # the checkpoint manager rescales before applying; reaching
+            # here means a caller skipped adaptation
             raise RuntimeError(
                 f"snapshot has {state.get('n_shards')} worker shards, "
-                f"session has {self.n_shards} (set PATHWAY_THREADS to match)"
+                f"session has {self.n_shards} (rescale adaptation missing)"
             )
         for replica, st in zip(self.replicas, state["shards"]):
             if st is not None:
                 replica.restore_state(st)
+
+    def rescale_state(self, state: dict) -> dict:
+        """Re-partition a snapshot taken at a different worker count onto
+        this node's shards (raises RescaleUnsupported when the inner node
+        type cannot express its routing)."""
+        template = self.replicas[0]
+        shards = (
+            [s for s in state["shards"] if s is not None]
+            if "n_shards" in state
+            else [state]
+        )
+        merged = template.merge_shard_states(shards)
+        n = self.n_shards
+        parts = template.split_shard_state(
+            merged, n, lambda tok: _shard_of(tok, n)
+        )
+        return {"n_shards": n, "shards": parts}
+
+
+def adapt_shard_state(node: Any, st: dict) -> dict:
+    """Re-shape a snapshot for the node's current worker layout: rescales
+    ShardedNode states across PATHWAY_THREADS changes, merges multi-shard
+    snapshots into unsharded sessions, and recurses into nodes embedding a
+    sub-graph (IterateNode) whose states carry per-node `sub` lists.
+    Raises RescaleUnsupported when an operator cannot re-partition — the
+    checkpoint manager catches it in its read phase and falls back to
+    journal replay before any node has mutated."""
+    if isinstance(node, ShardedNode):
+        if st.get("n_shards") == node.n_shards:
+            return st
+        return node.rescale_state(st)
+    sub_graph = getattr(node, "sub_graph", None)
+    if sub_graph is not None and isinstance(st, dict) and "sub" in st:
+        st = dict(st)
+        st["sub"] = [
+            None if s is None else adapt_shard_state(n2, s)
+            for n2, s in zip(sub_graph.nodes, st["sub"])
+        ]
+        return st
+    if "n_shards" in st and "shards" in st:
+        # snapshot from a multi-worker run restoring into an unsharded
+        # session: merge the shard states
+        return node.merge_shard_states(
+            [s for s in st["shards"] if s is not None]
+        )
+    return st
 
     # Aggregate observability over replicas (rows_in counted at exchange).
     @property

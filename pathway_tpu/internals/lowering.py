@@ -213,6 +213,11 @@ class Session:
             node.trace = trace
             for replica in getattr(node, "replicas", []):
                 replica.trace = trace
+        # semantic fingerprint incl. UDF bytecode — persistence signature
+        # invalidates snapshots when only a function body changes
+        from pathway_tpu.internals.fingerprint import fingerprint_spec
+
+        node.state_fingerprint = fingerprint_spec(spec)
         self.cache[spec.id] = node
         return node
 

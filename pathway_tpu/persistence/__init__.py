@@ -505,23 +505,36 @@ class OperatorSnapshotStore:
                     pass
 
 
-def _pipeline_signature(graph: Any, n_workers: int) -> str:
+def _pipeline_signature(graph: Any) -> str:
     """Stable id of the lowered pipeline: node order + each operator's
-    semantic signature (class, mode, reducer set, widths, …) + worker
-    count + native kernel availability. A change means persisted operator
-    state cannot be mapped back onto the graph. Function bodies (UDFs,
-    predicates) are not capturable — that caveat is documented on
-    Node.persist_signature."""
+    semantic signature (class, mode, reducer set, widths, …) + native
+    kernel availability. A change means persisted operator state cannot
+    be mapped back onto the graph. Deliberately NOT included: the worker
+    count — snapshots re-partition across PATHWAY_THREADS changes (see
+    engine/core.py shard-rescale protocol; the reference pins `-w`)."""
     from pathway_tpu.engine import native
 
-    parts = [f"workers={n_workers}", f"native={native.available()}"]
+    parts = [f"native={native.available()}"]
     for node in graph.nodes:
-        parts.append(f"{node.node_id}:{node.persist_signature()}")
+        parts.append(
+            f"{node.node_id}:{node.persist_signature()}"
+            f":{getattr(node, 'state_fingerprint', '')}"
+        )
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
 
 
 def _persistent_id(node: Any) -> str:
-    return f"n{node.node_id}-{type(node).__name__}"
+    # a ShardedNode is named after its inner operator so snapshots match
+    # across worker counts (THREADS=1 builds the inner node directly)
+    replicas = getattr(node, "replicas", None)
+    inner = replicas[0] if replicas else node
+    return f"n{node.node_id}-{type(inner).__name__}"
+
+
+def _adapt_shard_state(node: Any, st: dict) -> dict:
+    from pathway_tpu.engine.workers import adapt_shard_state
+
+    return adapt_shard_state(node, st)
 
 
 class CheckpointManager:
@@ -536,7 +549,7 @@ class CheckpointManager:
         self.journal = SegmentedJournal(root)
         self.metadata = MetadataStore(root)
         self.ops = OperatorSnapshotStore(root)
-        self.signature = _pipeline_signature(session.graph, session.n_workers)
+        self.signature = _pipeline_signature(session.graph)
         self.epoch = 0
         self._last_checkpoint = _time.monotonic()
         self._writers: dict[str, _SegmentWriter] = {}
@@ -608,7 +621,10 @@ class CheckpointManager:
                 for node in self.session.graph.nodes:
                     st = self.ops.read(_persistent_id(node), int(meta["epoch"]))
                     if st is not None:
-                        restored.append((node, st))
+                        # worker-count changes re-partition here, BEFORE
+                        # any node mutates — RescaleUnsupported falls back
+                        # to journal replay cleanly
+                        restored.append((node, _adapt_shard_state(node, st)))
             except Exception as e:  # noqa: BLE001
                 readable = False
                 self.session.graph.log_error(f"operator snapshot unreadable: {e}")
