@@ -325,6 +325,11 @@ class ShardedNode(Node):
         )
         return {"n_shards": n, "shards": parts}
 
+    # Aggregate observability over replicas (rows_in counted at exchange).
+    @property
+    def shard_rows(self) -> list[tuple[int, int]]:
+        return [(r.rows_in, r.rows_out) for r in self.replicas]
+
 
 def adapt_shard_state(node: Any, st: dict) -> dict:
     """Re-shape a snapshot for the node's current worker layout: rescales
@@ -353,11 +358,6 @@ def adapt_shard_state(node: Any, st: dict) -> dict:
             [s for s in st["shards"] if s is not None]
         )
     return st
-
-    # Aggregate observability over replicas (rows_in counted at exchange).
-    @property
-    def shard_rows(self) -> list[tuple[int, int]]:
-        return [(r.rows_in, r.rows_out) for r in self.replicas]
 
 
 class ProcessExchangeNode(Node):

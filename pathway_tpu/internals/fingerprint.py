@@ -40,7 +40,7 @@ def _is_table(obj: Any) -> bool:
     return hasattr(obj, "_spec") and hasattr(obj, "_column_names")
 
 
-def _feed_code(h: Any, fn: Any, seen: set[int], depth: int) -> None:
+def _feed_code(h: Any, fn: Any, seen: dict, depth: int) -> None:
     code = getattr(fn, "__code__", None)
     if code is None:
         if isinstance(fn, functools.partial):
@@ -69,7 +69,7 @@ def _feed_code(h: Any, fn: Any, seen: set[int], depth: int) -> None:
             h.update(b"emptycell")
 
 
-def _feed(h: Any, obj: Any, seen: set[int], depth: int = 0) -> None:
+def _feed(h: Any, obj: Any, seen: dict, depth: int = 0) -> None:
     if depth > _MAX_DEPTH:
         h.update(b"deep")
         return
@@ -80,7 +80,10 @@ def _feed(h: Any, obj: Any, seen: set[int], depth: int = 0) -> None:
     if oid in seen:
         h.update(b"seen")
         return
-    seen.add(oid)
+    # the memo VALUE keeps the object alive for the walk's duration — a
+    # plain id-set would let a freed temporary's id be reused by a
+    # different object, which would then silently hash as b"seen"
+    seen[oid] = obj
     if _is_table(obj):
         h.update(b"Table")
         return
@@ -146,7 +149,7 @@ def fingerprint_spec(spec: Any) -> str:
     try:
         h.update(str(getattr(spec, "kind", "?")).encode())
         params = getattr(spec, "params", None) or {}
-        _feed(h, params, set())
+        _feed(h, params, {})
     except Exception:  # noqa: BLE001 — degrade, never break lowering
         pass
     return h.hexdigest()

@@ -214,10 +214,16 @@ class Session:
             for replica in getattr(node, "replicas", []):
                 replica.trace = trace
         # semantic fingerprint incl. UDF bytecode — persistence signature
-        # invalidates snapshots when only a function body changes
-        from pathway_tpu.internals.fingerprint import fingerprint_spec
-
-        node.state_fingerprint = fingerprint_spec(spec)
+        # invalidates snapshots when only a function body changes. Kept
+        # LAZY (spec reference, hashed on first access) so sessions that
+        # never attach persistence don't pay for hashing bulk static rows.
+        # Source connectors are exempt: their params are deployment
+        # details (broker URL, port, credentials) — reconnecting the same
+        # named source to a moved endpoint must keep persisted state
+        # (the reference keys source persistence by name for the same
+        # reason).
+        if spec.kind != "connector":
+            node._fingerprint_spec = spec
         self.cache[spec.id] = node
         return node
 

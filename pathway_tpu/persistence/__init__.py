@@ -2,7 +2,8 @@
 
 Reference parity: src/persistence/ —
   * operator snapshots with compaction (operator_snapshot.rs:1) →
-    `OperatorSnapshotStore` (per-node pickled state, one file per epoch,
+    `OperatorSnapshotStore` (per-node typed-binary state with crc
+    framing — codec.py, the bincode equivalent — one file per epoch,
     old epochs deleted after the metadata commit),
   * metadata / finalized-frontier store (state.rs:35 MetadataAccessor) →
     `MetadataStore` (per-connector committed offsets + epoch, written
@@ -33,11 +34,11 @@ from __future__ import annotations
 import hashlib
 import json as _json
 import os
-import pickle
 import time as _time
 from typing import Any
 
 from pathway_tpu.internals.keys import Key
+from pathway_tpu.persistence import codec
 
 
 class Backend:
@@ -312,19 +313,17 @@ class SegmentedJournal:
 
     def load_from(self, name: str, offset: int) -> list[tuple[int, Any, tuple, int]]:
         """All journaled events with global offset >= `offset`, as
-        (offset, key_value, row, diff)."""
+        (offset, key_value, row, diff). Records are typed-binary with
+        per-record crc (codec.py); a torn tail stops the read."""
         out: list[tuple[int, Any, tuple, int]] = []
         for start, path in self._segments(name):
             pos = start
             with open(path, "rb") as f:
-                while True:
-                    try:
-                        (kv, row, diff) = pickle.load(f)  # noqa: S301
-                    except (EOFError, pickle.UnpicklingError):
-                        break  # torn tail write from a crash: discard
-                    if pos >= offset:
-                        out.append((pos, kv, row, diff))
-                    pos += 1
+                buf = f.read()
+            for (kv, row, diff) in codec.read_records(buf, with_magic=True):
+                if pos >= offset:
+                    out.append((pos, kv, row, diff))
+                pos += 1
         return out
 
     def head_offset(self, name: str) -> int:
@@ -337,14 +336,9 @@ class SegmentedJournal:
         if not segs:
             return 0
         last_start, last_path = segs[-1]
-        n = 0
         with open(last_path, "rb") as f:
-            while True:
-                try:
-                    pickle.load(f)  # noqa: S301
-                except (EOFError, pickle.UnpicklingError):
-                    break
-                n += 1
+            buf = f.read()
+        n = sum(1 for _ in codec.read_records(buf, with_magic=True))
         return last_start + n
 
     def open_segment(self, name: str, start: int):
@@ -374,13 +368,15 @@ class _SegmentWriter:
         self.start = start
         self.count = 0
         self._f = open(path, "ab")
+        if self._f.tell() == 0:
+            self._f.write(codec.MAGIC)  # format header on fresh segments
 
     @property
     def next_offset(self) -> int:
         return self.start + self.count
 
     def append(self, key_value: int, row: tuple, diff: int) -> None:
-        pickle.dump((key_value, row, diff), self._f)
+        self._f.write(codec.encode_record((key_value, row, diff)))
         self.count += 1
 
     def flush(self, sync: bool = False) -> None:
@@ -470,7 +466,9 @@ class MetadataStore:
 
 
 class OperatorSnapshotStore:
-    """Pickled per-operator state, one file per (node, epoch)."""
+    """Typed-binary per-operator state, one file per (node, epoch), with
+    a crc frame so a corrupt snapshot is detected at read time (phase 1
+    of restore falls back to journal replay)."""
 
     def __init__(self, root: str):
         self.root = os.path.join(root, "operator")
@@ -480,14 +478,19 @@ class OperatorSnapshotStore:
         return os.path.join(self.root, f"{_safe(pid)}.{epoch}.state")
 
     def write(self, pid: str, epoch: int, state: dict) -> None:
-        _fsync_write(self._path(pid, epoch), pickle.dumps(state, protocol=4))
+        _fsync_write(
+            self._path(pid, epoch), codec.encode_record(state, with_magic=True)
+        )
 
     def read(self, pid: str, epoch: int) -> dict | None:
         p = self._path(pid, epoch)
         if not os.path.exists(p):
             return None
         with open(p, "rb") as f:
-            return pickle.load(f)  # noqa: S301
+            buf = f.read()
+        for state in codec.read_records(buf, with_magic=True):
+            return state
+        raise ValueError(f"operator snapshot {p} is corrupt or torn")
 
     def compact(self, keep_epochs: set[int]) -> None:
         keep = set(keep_epochs)
@@ -514,12 +517,16 @@ def _pipeline_signature(graph: Any) -> str:
     engine/core.py shard-rescale protocol; the reference pins `-w`)."""
     from pathway_tpu.engine import native
 
+    from pathway_tpu.internals.fingerprint import fingerprint_spec
+
     parts = [f"native={native.available()}"]
     for node in graph.nodes:
-        parts.append(
-            f"{node.node_id}:{node.persist_signature()}"
-            f":{getattr(node, 'state_fingerprint', '')}"
-        )
+        fp = getattr(node, "state_fingerprint", None)
+        if fp is None:
+            spec = getattr(node, "_fingerprint_spec", None)
+            fp = fingerprint_spec(spec) if spec is not None else ""
+            node.state_fingerprint = fp  # cache for repeat signatures
+        parts.append(f"{node.node_id}:{node.persist_signature()}:{fp}")
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
 
 
