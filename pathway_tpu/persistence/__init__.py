@@ -338,8 +338,7 @@ class SegmentedJournal:
         last_start, last_path = segs[-1]
         with open(last_path, "rb") as f:
             buf = f.read()
-        n = sum(1 for _ in codec.read_records(buf, with_magic=True))
-        return last_start + n
+        return last_start + codec.count_records(buf, with_magic=True)
 
     def open_segment(self, name: str, start: int):
         return _SegmentWriter(
@@ -368,7 +367,15 @@ class _SegmentWriter:
         self.start = start
         self.count = 0
         self._f = open(path, "ab")
-        if self._f.tell() == 0:
+        pos = self._f.tell()
+        if 0 < pos < len(codec.MAGIC):
+            # crash mid-header left a partial MAGIC (such a file holds no
+            # records); appending after it would bake the corruption in —
+            # rewrite the segment from scratch
+            self._f.close()
+            self._f = open(path, "wb")
+            pos = 0
+        if pos == 0:
             self._f.write(codec.MAGIC)  # format header on fresh segments
 
     @property

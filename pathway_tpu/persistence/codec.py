@@ -117,16 +117,22 @@ def _enc(out: bytearray, v: Any) -> None:
     elif v is ERROR:
         out.append(_ERROR)
     elif isinstance(v, np.ndarray):
-        if v.dtype == object:
-            # object arrays have no raw-buffer form (tobytes() would dump
-            # pointers); they take the explicit escape like other opaque
-            # Python state
+        ds_str = str(v.dtype)
+        if (
+            v.dtype.hasobject
+            or v.dtype.names is not None
+            or v.dtype.kind not in "?biufcmMSU"
+            or len(ds_str) > 255
+        ):
+            # object/structured/exotic dtypes have no round-trippable
+            # raw-buffer form (np.dtype(str(dt)) fails for compound
+            # dtypes; object tobytes() dumps pointers) — explicit escape
             b = pickle.dumps(v, protocol=4)
             out.append(_PICKLE)
             out += struct.pack("<I", len(b))
             out += b
             return
-        ds = str(v.dtype).encode()
+        ds = ds_str.encode()
         v = np.ascontiguousarray(v)
         out.append(_NDARRAY)
         out.append(len(ds))
@@ -249,7 +255,13 @@ def _dec(r: _Reader) -> Any:
         ndim = r.u8()
         shape = struct.unpack(f"<{ndim}q", r.take(8 * ndim))
         raw = r.take(struct.unpack("<Q", r.take(8))[0])
-        return np.frombuffer(bytes(raw), dtype=np.dtype(ds)).reshape(shape)
+        # .copy(): frombuffer over bytes yields a READ-ONLY view; restored
+        # rows must stay mutable like freshly-ingested ones
+        return (
+            np.frombuffer(bytes(raw), dtype=np.dtype(ds))
+            .reshape(shape)
+            .copy()
+        )
     if tag == _DT_UTC:
         return dtt.DateTimeUtc(ns=r.i64())
     if tag == _DT_NAIVE:
@@ -339,10 +351,24 @@ def read_records(buf: bytes, *, with_magic: bool = False):
     short payload, or crc mismatch — all the shapes a crash can leave).
     With `with_magic`, a non-empty buffer must start with MAGIC or the
     read raises (unknown/legacy format, not a crash artifact)."""
+    for payload in _frames(buf, with_magic=with_magic):
+        yield decode_value(payload)
+
+
+def count_records(buf: bytes, *, with_magic: bool = False) -> int:
+    """Number of intact records, walking frames (length + crc) without
+    decoding payloads — restore-time counting must not reconstruct every
+    value (or run the pickle escape) just to count."""
+    return sum(1 for _ in _frames(buf, with_magic=with_magic))
+
+
+def _frames(buf: bytes, *, with_magic: bool):
     pos = 0
     n = len(buf)
     if with_magic and n:
-        if n < len(MAGIC) or bytes(buf[: len(MAGIC)]) != MAGIC:
+        if n < len(MAGIC):
+            return  # crash-truncated mid-header: torn, i.e. empty
+        if bytes(buf[: len(MAGIC)]) != MAGIC:
             raise ValueError(
                 "unrecognized journal/snapshot format (missing "
                 f"{MAGIC!r} header); refusing to read — the file predates "
@@ -359,5 +385,5 @@ def read_records(buf: bytes, *, with_magic: bool = False):
         payload = view[start:end]
         if zlib.crc32(payload) != crc:
             return  # torn or corrupt: stop before emitting garbage
-        yield decode_value(payload)
+        yield payload
         pos = end

@@ -203,6 +203,50 @@ def test_fingerprint_distinguishes_partial_kwargs():
     assert fingerprint_spec(Spec(2)) != fingerprint_spec(Spec(99))
 
 
+def test_structured_dtype_roundtrip():
+    """Compound dtypes can't rebuild from str(dtype): they must take the
+    escape path instead of encoding undecodably."""
+    arr = np.zeros(3, dtype=[("a", "<i4"), ("b", "<f8")])
+    arr["a"] = [1, 2, 3]
+    got = rt(arr)
+    assert got.dtype == arr.dtype and list(got["a"]) == [1, 2, 3]
+
+
+def test_decoded_arrays_are_writeable():
+    got = rt(np.arange(4, dtype=np.float64))
+    got[0] = 99.0  # replayed rows must stay mutable like fresh ones
+    assert got[0] == 99.0
+
+
+def test_partial_magic_is_torn_not_foreign(tmp_path):
+    """A crash can truncate the 6-byte header itself: that's a torn
+    (empty) segment, not a foreign format."""
+    from pathway_tpu.persistence import SegmentedJournal
+
+    j = SegmentedJournal(str(tmp_path))
+    with open(tmp_path / "src.0.seg", "wb") as f:
+        f.write(codec.MAGIC[:3])
+    assert j.load_from("src", 0) == []
+    assert j.total_events("src") == 0
+    # reopening the segment repairs the header instead of appending after it
+    w = j.open_segment("src", 0)
+    w.append(Key(1).value, ("x",), 1)
+    w.flush(sync=True)
+    w.close()
+    assert [r[2] for r in j.load_from("src", 0)] == [("x",)]
+
+
+def test_count_records_skips_decode(monkeypatch):
+    recs = [(1, ("a",), 1), (2, ("b",), 1)]
+    buf = b"".join(codec.encode_record(r) for r in recs)
+
+    def boom(*a, **k):
+        raise AssertionError("count_records must not decode payloads")
+
+    monkeypatch.setattr(codec, "decode_value", boom)
+    assert codec.count_records(buf) == 2
+
+
 def test_journal_roundtrip_typed(tmp_path):
     from pathway_tpu.persistence import SegmentedJournal
 
