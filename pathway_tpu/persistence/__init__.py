@@ -368,13 +368,19 @@ class _SegmentWriter:
         self.count = 0
         self._f = open(path, "ab")
         pos = self._f.tell()
-        if 0 < pos < len(codec.MAGIC):
-            # crash mid-header left a partial MAGIC (such a file holds no
-            # records); appending after it would bake the corruption in —
-            # rewrite the segment from scratch
-            self._f.close()
-            self._f = open(path, "wb")
-            pos = 0
+        if pos > 0:
+            # reopening after a crash: drop any torn tail (partial MAGIC
+            # or a torn trailing frame) BEFORE appending — new events
+            # written beyond the torn point would sit past where every
+            # reader stops, silently unreadable
+            with open(path, "rb") as rf:
+                good = codec.valid_prefix_len(rf.read(), with_magic=True)
+            if good < pos:
+                self._f.close()
+                with open(path, "r+b") as tf:
+                    tf.truncate(good)
+                self._f = open(path, "ab")
+                pos = good
         if pos == 0:
             self._f.write(codec.MAGIC)  # format header on fresh segments
 

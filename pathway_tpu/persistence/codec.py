@@ -362,6 +362,27 @@ def count_records(buf: bytes, *, with_magic: bool = False) -> int:
     return sum(1 for _ in _frames(buf, with_magic=with_magic))
 
 
+def valid_prefix_len(buf: bytes, *, with_magic: bool = False) -> int:
+    """Byte length of the longest intact prefix (magic + whole crc-valid
+    frames). A writer reopening a segment truncates to this before
+    appending — otherwise events written after a crash-torn frame would
+    sit beyond the point every reader stops at, silently unreadable."""
+    n = len(buf)
+    pos = 0
+    if with_magic:
+        if n < len(MAGIC) or bytes(buf[: len(MAGIC)]) != MAGIC:
+            return 0  # partial/absent header: rewrite from scratch
+        pos = len(MAGIC)
+    view = memoryview(buf)
+    while pos + _HEADER.size <= n:
+        length, crc = _HEADER.unpack_from(buf, pos)
+        end = pos + _HEADER.size + length
+        if end > n or zlib.crc32(view[pos + _HEADER.size : end]) != crc:
+            break
+        pos = end
+    return pos
+
+
 def _frames(buf: bytes, *, with_magic: bool):
     pos = 0
     n = len(buf)

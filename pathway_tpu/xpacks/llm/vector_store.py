@@ -1,26 +1,92 @@
-"""VectorStoreServer / VectorStoreClient — the legacy self-contained
+"""VectorStoreServer / VectorStoreClient — the self-contained
 embed + index + REST service.
 
 Reference parity: xpacks/llm/vector_store.py `VectorStoreServer` (:38,
-run_server :456) and `VectorStoreClient` (:629). Internally delegates to
-DocumentStore with a KNN retriever over the given embedder (the reference
-kept a parallel implementation; one code path is enough here).
+from_langchain_components :92, from_llamaindex_components :136,
+run_server :456), `SlidesVectorStoreServer` (:566) and
+`VectorStoreClient` (:629). The indexing pipeline itself delegates to
+DocumentStore (the reference kept a parallel implementation; one code
+path is enough here), while this module owns what the reference's class
+owns on top of it: plain-callable component adapters (LangChain /
+LlamaIndex interop), embedding-dimension probing, the slides variant
+with metadata redaction, and the HTTP client.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
-import threading
+import urllib.error
 import urllib.request
 from typing import Any, Callable
 
 import pathway_tpu as pw
+from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.table import Table
 from pathway_tpu.stdlib.indexing.nearest_neighbors import BruteForceKnnFactory
-from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.document_store import DocumentStore, _plain
+
+
+def _call_maybe_async(fn: Callable, *args: Any) -> Any:
+    res = fn(*args)
+    if asyncio.iscoroutine(res):
+        return asyncio.run(res)
+    return res
+
+
+class _CallableUDF(pw.UDF):
+    """Adapter: a plain (sync or async) callable used where the pipeline
+    expects a pw.UDF. The reference's VectorStoreServer accepts raw
+    callables for embedder/parser/splitter; this preserves that API over
+    the UDF-based DocumentStore."""
+
+    def __init__(self, fn: Callable, *, deterministic: bool = True):
+        super().__init__(deterministic=deterministic)
+        self._fn = fn
+        if asyncio.iscoroutinefunction(fn):
+
+            async def _w(x: Any, **kwargs: Any) -> Any:
+                return await fn(x)
+
+        else:
+
+            def _w(x: Any, **kwargs: Any) -> Any:  # type: ignore[misc]
+                return fn(x)
+
+        self.__wrapped__ = _w  # type: ignore[method-assign]
+
+
+class _CallableEmbedder(_CallableUDF):
+    def get_embedding_dimension(self, **kwargs: Any) -> int:
+        # probe like the reference: embed a sentinel and measure
+        return len(_call_maybe_async(self._fn, "."))
+
+
+def _as_embedder(embedder: Any) -> Any:
+    if embedder is None or isinstance(embedder, pw.UDF):
+        return embedder
+    return _CallableEmbedder(embedder)
+
+
+def _as_processor(fn: Any) -> Any:
+    if fn is None or isinstance(fn, pw.UDF):
+        return fn
+    if asyncio.iscoroutinefunction(fn):
+        # DocumentStore applies parsers/splitters synchronously inside
+        # the document pipeline (only the embedder rides async-apply) —
+        # failing here beats a coroutine-is-not-iterable crash at runtime
+        raise ValueError(
+            "parser/splitter callables must be synchronous; wrap async "
+            "work in an async embedder or a pw.UDF with an async executor"
+        )
+    return _CallableUDF(fn)
 
 
 class VectorStoreServer:
+    """Builds the document indexing pipeline and serves it over REST
+    (reference: vector_store.py:38). Accepts either pw.UDF components or
+    plain callables (the reference's calling convention)."""
+
     def __init__(
         self,
         *docs: Table,
@@ -34,17 +100,134 @@ class VectorStoreServer:
             from pathway_tpu.xpacks.llm.embedders import JaxEmbedder
 
             embedder = JaxEmbedder()
+        embedder = _as_embedder(embedder)
         self.embedder = embedder
         if index_factory is None:
             dim = embedder.get_embedding_dimension()
             index_factory = BruteForceKnnFactory(dimensions=dim, embedder=embedder)
-        self.document_store = DocumentStore(
+        self.document_store = self._make_store(
             list(docs),
             retriever_factory=index_factory,
-            parser=parser,
-            splitter=splitter,
+            parser=_as_processor(parser),
+            splitter=_as_processor(splitter),
             doc_post_processors=doc_post_processors,
         )
+
+    _store_cls = DocumentStore
+
+    def _make_store(self, docs: list[Table], **kwargs: Any) -> DocumentStore:
+        return self._store_cls(docs, **kwargs)
+
+    # ------------------------------------------------ component adapters
+
+    @classmethod
+    def from_langchain_components(
+        cls,
+        *docs: Table,
+        embedder: Any,
+        parser: Any = None,
+        splitter: Any = None,
+        **kwargs: Any,
+    ) -> "VectorStoreServer":
+        """Build from LangChain components (reference:
+        vector_store.py:92): `embedder` is a langchain Embeddings object
+        (`aembed_documents`), `splitter` a BaseDocumentTransformer.
+        langchain_core is only imported when a splitter is given (its
+        Document type is needed to feed transform_documents)."""
+        generic_splitter = None
+        if splitter is not None:
+            try:
+                from langchain_core.documents import Document
+            except ImportError as e:
+                raise ImportError(
+                    "a LangChain splitter needs langchain_core: "
+                    "`pip install langchain_core`"
+                ) from e
+
+            def generic_splitter(x: str) -> list[tuple[str, dict]]:
+                return [
+                    (doc.page_content, doc.metadata)
+                    for doc in splitter.transform_documents(
+                        [Document(page_content=x)]
+                    )
+                ]
+
+        async def generic_embedder(x: str) -> Any:
+            res = await embedder.aembed_documents([x])
+            import numpy as np
+
+            return np.asarray(res[0], dtype=np.float32)
+
+        return cls(
+            *docs,
+            embedder=generic_embedder,
+            parser=parser,
+            splitter=generic_splitter,
+            **kwargs,
+        )
+
+    @classmethod
+    def from_llamaindex_components(
+        cls,
+        *docs: Table,
+        transformations: list[Any],
+        parser: Any = None,
+        **kwargs: Any,
+    ) -> "VectorStoreServer":
+        """Build from LlamaIndex TransformComponents (reference:
+        vector_store.py:136): the LAST transformation must be an
+        embedding component (`aget_text_embedding`); earlier ones run as
+        the splitter. llama_index is only imported when there are node
+        transformations to run."""
+        if not transformations:
+            raise ValueError("Transformations list cannot be None or empty.")
+        transformations = list(transformations)
+        embedder = transformations.pop()
+        if not hasattr(embedder, "aget_text_embedding"):
+            raise ValueError(
+                "Last step of transformations should be an embedding "
+                f"component (aget_text_embedding), found {type(embedder)}."
+            )
+
+        async def embedding_callable(x: str) -> Any:
+            import numpy as np
+
+            return np.asarray(
+                await embedder.aget_text_embedding(x), dtype=np.float32
+            )
+
+        generic_transformer = None
+        if transformations:
+            try:
+                from llama_index.core.ingestion.pipeline import (
+                    run_transformations,
+                )
+                from llama_index.core.schema import MetadataMode, TextNode
+            except ImportError as e:
+                raise ImportError(
+                    "LlamaIndex node transformations need llama-index-core: "
+                    "`pip install llama-index-core`"
+                ) from e
+
+            def generic_transformer(x: str) -> list[tuple[str, dict]]:
+                nodes = run_transformations([TextNode(text=x)], transformations)
+                return [
+                    (
+                        node.get_content(metadata_mode=MetadataMode.NONE),
+                        node.extra_info or {},
+                    )
+                    for node in nodes
+                ]
+
+        return cls(
+            *docs,
+            embedder=embedding_callable,
+            parser=parser,
+            splitter=generic_transformer,
+            **kwargs,
+        )
+
+    # ---------------------------------------------------------- services
 
     RetrieveQuerySchema = DocumentStore.RetrieveQuerySchema
     StatisticsQuerySchema = DocumentStore.StatisticsQuerySchema
@@ -63,6 +246,12 @@ class VectorStoreServer:
     def index(self):
         return self.document_store.index
 
+    def __repr__(self) -> str:
+        return (
+            f"{type(self).__name__}(embedder={type(self.embedder).__name__}, "
+            f"store={type(self.document_store).__name__})"
+        )
+
     def run_server(
         self,
         host: str = "0.0.0.0",
@@ -74,7 +263,10 @@ class VectorStoreServer:
     ):
         from pathway_tpu.xpacks.llm.servers import DocumentStoreServer
 
-        server = DocumentStoreServer(host, port, self.document_store)
+        # serve SELF (duck-typed store), not the inner DocumentStore —
+        # subclass endpoint overrides (SlidesVectorStoreServer's redacted
+        # inputs listing) must be what REST clients reach
+        server = DocumentStoreServer(host, port, self)
         return server.run(
             threaded=threaded,
             with_cache=with_cache,
@@ -83,24 +275,90 @@ class VectorStoreServer:
         )
 
 
-class VectorStoreClient:
-    """Thin HTTP client for the vector-store endpoints
-    (reference: vector_store.py:629)."""
+class SlidesVectorStoreServer(VectorStoreServer):
+    """Vector index for the slide-search template (reference:
+    vector_store.py:566): `inputs` lists metadata AFTER parsing and
+    post-processing (one entry per parsed slide, not per input file),
+    with bulky fields (the base64 slide image) redacted."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 8000, url: str | None = None,
-                 timeout: float = 15.0):
-        self.url = url or f"http://{host}:{port}"
+    excluded_response_metadata: list[str] = ["b64_image"]
+
+    def inputs_query(self, input_queries: Table) -> Table:
+        from pathway_tpu.stdlib.indexing.filters import compile_filter
+
+        store = self.document_store
+        all_metas = store.parsed_docs.reduce(
+            metadatas=pw.reducers.tuple(store.parsed_docs.metadata)
+        )
+        queries = DocumentStore.merge_filters(input_queries)
+        excluded = list(self.excluded_response_metadata)
+
+        def fmt(metas: Any, metadata_filter: Any) -> Json:
+            out = [_plain(m) for m in (metas or ())]
+            if metadata_filter:
+                pred = compile_filter(str(metadata_filter))
+                out = [m for m in out if pred(m)]
+            # copy before redacting: _plain returns the LIVE metadata
+            # dicts — popping in place would strip the slide images from
+            # the store itself for every later consumer
+            redacted = []
+            for m in out:
+                if isinstance(m, dict):
+                    m = {k: v for k, v in m.items() if k not in excluded}
+                redacted.append(m)
+            return Json(redacted)
+
+        return queries.join_left(all_metas, id=queries.id).select(
+            result=pw.apply(fmt, pw.right.metadatas, pw.left.metadata_filter)
+        )
+
+    def parsed_documents_query(self, parse_docs_queries: Table) -> Table:
+        return self.inputs_query(parse_docs_queries)
+
+
+class VectorStoreClient:
+    """HTTP client for the vector-store endpoints (reference:
+    vector_store.py:629)."""
+
+    def __init__(
+        self,
+        host: str | None = None,
+        port: int | None = None,
+        url: str | None = None,
+        timeout: float | None = 15.0,
+        additional_headers: dict[str, str] | None = None,
+    ):
+        err = "Either (`host` and `port`) or `url` must be set, but not both."
+        if url is not None:
+            if host is not None or port is not None:
+                raise ValueError(err)
+            self.url = url
+        else:
+            if host is None:
+                raise ValueError(err)
+            port = port or 80
+            self.url = f"http://{host}:{port}"
         self.timeout = timeout
+        self.additional_headers = additional_headers or {}
 
     def _post(self, route: str, payload: dict) -> Any:
         req = urllib.request.Request(
             self.url + route,
             data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
+            headers={
+                "Content-Type": "application/json",
+                **self.additional_headers,
+            },
             method="POST",
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return json.loads(resp.read().decode())
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            raise RuntimeError(
+                f"vector store request {route} failed: HTTP {e.code} {detail}"
+            ) from e
 
     def query(
         self,

@@ -236,6 +236,36 @@ def test_partial_magic_is_torn_not_foreign(tmp_path):
     assert [r[2] for r in j.load_from("src", 0)] == [("x",)]
 
 
+def test_reopen_truncates_torn_tail(tmp_path):
+    """Crash mid-record, then reopen + append: the torn frame must be
+    dropped, not buried under new (then-unreachable) events."""
+    from pathway_tpu.persistence import SegmentedJournal
+
+    j = SegmentedJournal(str(tmp_path))
+    w = j.open_segment("src", 0)
+    w.append(Key(1).value, ("a",), 1)
+    w.append(Key(2).value, ("b",), 1)
+    w.flush(sync=True)
+    w.close()
+    p = tmp_path / "src.0.seg"
+    raw = open(p, "rb").read()
+    open(p, "wb").write(raw[:-4])  # torn tail: second record truncated
+    assert j.total_events("src") == 1
+    w = j.open_segment("src", 1)
+    w.append(Key(3).value, ("c",), 1)
+    w.flush(sync=True)
+    w.close()
+    assert [r[2] for r in j.load_from("src", 0)] == [("a",), ("c",)]
+    # torn-FIRST-record case: only MAGIC + garbage frame
+    p2 = tmp_path / "two.0.seg"
+    open(p2, "wb").write(codec.MAGIC + b"\x99\x00\x00\x00XX")
+    w = j.open_segment("two", 0)
+    w.append(Key(4).value, ("d",), 1)
+    w.flush(sync=True)
+    w.close()
+    assert [r[2] for r in j.load_from("two", 0)] == [("d",)]
+
+
 def test_count_records_skips_decode(monkeypatch):
     recs = [(1, ("a",), 1), (2, ("b",), 1)]
     buf = b"".join(codec.encode_record(r) for r in recs)
