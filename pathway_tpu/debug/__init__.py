@@ -257,9 +257,11 @@ def compute_and_print(
         max(len(header[i]), *(len(r[i]) for r in out_rows)) if out_rows else len(header[i])
         for i in range(len(header))
     ]
-    print(" | ".join(h.ljust(w) for h, w in zip(header, widths)))
+    # rstrip: no trailing pad on the last column, so doctest expected
+    # outputs don't need invisible trailing whitespace
+    print(" | ".join(h.ljust(w) for h, w in zip(header, widths)).rstrip())
     for r in out_rows:
-        print(" | ".join(c.ljust(w) for c, w in zip(r, widths)))
+        print(" | ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
 
 
 def compute_and_print_update_stream(

@@ -206,6 +206,24 @@ class Table:
     # ------------------------------------------------------------- core ops
 
     def select(self, *args: Any, **kwargs: Any) -> "Table":
+        """Project and compute columns, keeping the table's keys.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... owner | pet | age
+        ... Alice | dog | 10
+        ... Bob   | cat | 9
+        ... Alice | cat | 8
+        ... ''')
+        >>> pw.debug.compute_and_print(
+        ...     t.select(t.owner, double=t.age * 2), include_id=False)
+        owner | double
+        Bob   | 18
+        Alice | 16
+        Alice | 20
+        """
         exprs = self._resolve_exprs(args, kwargs)
         schema = self._infer_schema(exprs)
         spec = OpSpec("rowwise", [self], exprs=exprs)
@@ -222,6 +240,22 @@ class Table:
         return Table(OpSpec("rowwise", [self], exprs=exprs), schema, self._universe)
 
     def with_columns(self, *args: Any, **kwargs: Any) -> "Table":
+        """All existing columns plus the given ones (overriding by name).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown(\'\'\'
+        ... owner | age
+        ... Alice | 10
+        ... Bob   | 9
+        ... \'\'\')
+        >>> pw.debug.compute_and_print(
+        ...     t.with_columns(senior=t.age >= 10), include_id=False)
+        owner | age | senior
+        Bob   | 9   | False
+        Alice | 10  | True
+        """
         base = {n: ColumnReference(self, n) for n in self._column_names()}
         new = self._resolve_exprs(args, kwargs)
         base.update(new)
@@ -275,6 +309,22 @@ class Table:
         return self.rename_by_dict({n: n + suffix for n in self._column_names()})
 
     def filter(self, filter_expression: ColumnExpression) -> "Table":
+        """Keep the rows where the expression holds.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown(\'\'\'
+        ... owner | age
+        ... Alice | 10
+        ... Bob   | 9
+        ... Carol | 8
+        ... \'\'\')
+        >>> pw.debug.compute_and_print(t.filter(t.age >= 9), include_id=False)
+        owner | age
+        Bob   | 9
+        Alice | 10
+        """
         spec = OpSpec("filter", [self], cond=wrap_arg(filter_expression))
         out_universe = univ.Universe()
         univ.register_subset(out_universe, self._universe)
@@ -298,6 +348,27 @@ class Table:
         sort_by: Any = None,
         _skip_errors: bool = True,
     ) -> "GroupedTable":
+        """Group rows by the given expressions; reduce() aggregates.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown(\'\'\'
+        ... owner | age
+        ... Alice | 10
+        ... Bob   | 9
+        ... Alice | 8
+        ... \'\'\')
+        >>> pw.debug.compute_and_print(
+        ...     t.groupby(t.owner).reduce(
+        ...         t.owner,
+        ...         pets=pw.reducers.count(),
+        ...         oldest=pw.reducers.max(t.age)),
+        ...     include_id=False)
+        owner | pets | oldest
+        Bob   | 1    | 9
+        Alice | 2    | 10
+        """
         from pathway_tpu.internals.groupbys import GroupedTable
 
         gb_exprs: list[ColumnExpression] = []
@@ -322,6 +393,26 @@ class Table:
         persistent_id: str | None = None,
         name: str | None = None,
     ) -> "Table":
+        """Keep one accepted row per instance; acceptor(new, old) decides
+        whether a new candidate replaces the held one.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown(\'\'\'
+        ... ticker | px
+        ... AA     | 10
+        ... AA     | 12
+        ... BB     | 7
+        ... \'\'\')
+        >>> pw.debug.compute_and_print(
+        ...     t.deduplicate(value=t.px, instance=t.ticker,
+        ...                   acceptor=lambda new, old: new > old),
+        ...     include_id=False)
+        ticker | px
+        AA     | 12
+        BB     | 7
+        """
         value_e = wrap_arg(value) if value is not None else IdReference(self)
         instance_e = wrap_arg(instance) if instance is not None else None
         if acceptor is None:
@@ -337,6 +428,27 @@ class Table:
         self, other: "Table", *on: Any, id: Any = None, how: str = JoinMode.INNER,
         left_instance: Any = None, right_instance: Any = None,
     ) -> "JoinResult":
+        """Equi-join on the given conditions; how: inner/left/right/outer.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> people = pw.debug.table_from_markdown(\'\'\'
+        ... name  | city
+        ... Alice | Paris
+        ... Bob   | Lyon
+        ... \'\'\')
+        >>> cities = pw.debug.table_from_markdown(\'\'\'
+        ... city  | country
+        ... Paris | France
+        ... \'\'\')
+        >>> pw.debug.compute_and_print(
+        ...     people.join(cities, people.city == cities.city)
+        ...           .select(people.name, cities.country),
+        ...     include_id=False)
+        name  | country
+        Alice | France
+        """
         from pathway_tpu.internals.joins import JoinResult
 
         if (left_instance is None) != (right_instance is None):
@@ -535,6 +647,28 @@ class Table:
 
     def windowby(self, time_expr: Any, *, window: Any, instance: Any = None,
                  behavior: Any = None, **kwargs: Any) -> Any:
+        """Assign rows to time windows; reduce() aggregates per window.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> events = pw.debug.table_from_markdown('''
+        ... t  | v
+        ... 1  | 10
+        ... 3  | 20
+        ... 12 | 30
+        ... ''')
+        >>> pw.debug.compute_and_print(
+        ...     events.windowby(
+        ...         events.t, window=pw.temporal.tumbling(duration=10)
+        ...     ).reduce(
+        ...         start=pw.this._pw_window_start,
+        ...         total=pw.reducers.sum(pw.this.v)),
+        ...     include_id=False)
+        start | total
+        0     | 30
+        10    | 30
+        """
         from pathway_tpu.stdlib.temporal import windowby as _windowby
 
         return _windowby(self, time_expr, window=window, instance=instance,

@@ -368,6 +368,17 @@ class _SegmentWriter:
         self.count = 0
         self._f = open(path, "ab")
         pos = self._f.tell()
+        if pos >= len(codec.MAGIC):
+            with open(path, "rb") as rf:
+                head = rf.read(len(codec.MAGIC))
+            if head != codec.MAGIC:
+                # foreign/legacy layout: refuse, exactly like the reader —
+                # truncating would destroy data the read path protects
+                self._f.close()
+                raise ValueError(
+                    f"journal segment {path} is not in the typed-binary "
+                    "layout; refusing to append"
+                )
         if pos > 0:
             # reopening after a crash: drop any torn tail (partial MAGIC
             # or a torn trailing frame) BEFORE appending — new events

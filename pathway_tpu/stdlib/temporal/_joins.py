@@ -76,8 +76,10 @@ class IntervalJoinResult:
             _pw_lkey=ex.this.id,
         )
         l_exp = lt_named.flatten(ex.this._pw_buckets)
-        rt_named = right.with_columns(
-            _pw_t=self._rt,
+        # two stages: _pw_bucket reads _pw_t, which must already exist on
+        # the table (a same-select self-reference would resolve against
+        # the RAW right table and fail at lowering)
+        rt_named = right.with_columns(_pw_t=self._rt).with_columns(
             _pw_bucket=apply_with_type(lambda t: _as_int(t) // span, int, ex.this._pw_t),
             _pw_rkey=ex.this.id,
         )

@@ -30,7 +30,11 @@ from pathway_tpu.xpacks.llm.document_store import DocumentStore, _plain
 def _call_maybe_async(fn: Callable, *args: Any) -> Any:
     res = fn(*args)
     if asyncio.iscoroutine(res):
-        return asyncio.run(res)
+        # run on the engine's dedicated loop thread: asyncio.run would
+        # raise under an already-running loop (Jupyter, async apps)
+        from pathway_tpu.engine.runtime import _get_async_loop
+
+        return asyncio.run_coroutine_threadsafe(res, _get_async_loop()).result()
     return res
 
 
@@ -38,9 +42,11 @@ class _CallableUDF(pw.UDF):
     """Adapter: a plain (sync or async) callable used where the pipeline
     expects a pw.UDF. The reference's VectorStoreServer accepts raw
     callables for embedder/parser/splitter; this preserves that API over
-    the UDF-based DocumentStore."""
+    the UDF-based DocumentStore. deterministic defaults False (memoize
+    results) — an API-backed embedder is not bit-stable across calls, and
+    recompute-on-retraction would retract values never inserted."""
 
-    def __init__(self, fn: Callable, *, deterministic: bool = True):
+    def __init__(self, fn: Callable, *, deterministic: bool = False):
         super().__init__(deterministic=deterministic)
         self._fn = fn
         if asyncio.iscoroutinefunction(fn):
@@ -105,18 +111,13 @@ class VectorStoreServer:
         if index_factory is None:
             dim = embedder.get_embedding_dimension()
             index_factory = BruteForceKnnFactory(dimensions=dim, embedder=embedder)
-        self.document_store = self._make_store(
+        self.document_store = DocumentStore(
             list(docs),
             retriever_factory=index_factory,
             parser=_as_processor(parser),
             splitter=_as_processor(splitter),
             doc_post_processors=doc_post_processors,
         )
-
-    _store_cls = DocumentStore
-
-    def _make_store(self, docs: list[Table], **kwargs: Any) -> DocumentStore:
-        return self._store_cls(docs, **kwargs)
 
     # ------------------------------------------------ component adapters
 
@@ -336,7 +337,9 @@ class VectorStoreClient:
         else:
             if host is None:
                 raise ValueError(err)
-            port = port or 80
+            # default matches run_server's port=8000 — a silent :80
+            # fallback would point at the wrong service
+            port = port or 8000
             self.url = f"http://{host}:{port}"
         self.timeout = timeout
         self.additional_headers = additional_headers or {}

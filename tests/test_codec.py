@@ -182,6 +182,9 @@ def test_legacy_format_fails_loudly(tmp_path):
         j.load_from("src", 0)
     with pytest.raises(ValueError, match="unrecognized"):
         j.total_events("src")
+    # the WRITER must refuse too — appending would bury the legacy data
+    with pytest.raises(ValueError, match="refusing to append"):
+        j.open_segment("src", 0)
 
 
 def test_fingerprint_distinguishes_partial_kwargs():

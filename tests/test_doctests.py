@@ -1,0 +1,44 @@
+"""Run the doctest examples embedded in public API docstrings — the
+reference documents its API contract with runnable examples throughout
+(e.g. python/pathway/internals/table.py); these keep ours honest.
+
+Each example resets the sequential-key counter so its printed row order
+is what a fresh interpreter would produce, independent of other examples
+(auto-keys hash a process-wide sequence number)."""
+
+import doctest
+import itertools
+
+import pathway_tpu as pw
+from pathway_tpu.internals import keys
+
+
+def _run_module_doctests(module) -> None:
+    finder = doctest.DocTestFinder(exclude_empty=True)
+    runner = doctest.DocTestRunner(optionflags=doctest.NORMALIZE_WHITESPACE)
+    tests = [t for t in finder.find(module) if t.examples]
+    assert tests, f"no doctest examples found in {module.__name__}"
+    failures = []
+    for test in tests:
+        keys._seq_counter = itertools.count()  # fresh-interpreter key order
+        result = runner.run(test)
+        if result.failed:
+            failures.append(test.name)
+    assert not failures, f"doctest failures in: {failures}"
+
+
+def test_table_api_doctests():
+    from pathway_tpu.internals import table
+
+    _run_module_doctests(table)
+
+
+def test_doctest_example_count():
+    """The API contract must keep a minimum breadth of runnable examples."""
+    from pathway_tpu.internals import table
+
+    finder = doctest.DocTestFinder(exclude_empty=True)
+    n = sum(
+        len(t.examples) > 0 for t in finder.find(table)
+    )
+    assert n >= 6, f"only {n} documented examples in table.py"

@@ -1,0 +1,191 @@
+"""Metadata-filter edge cases: the JMESPath-subset evaluator behind
+index queries (stdlib/indexing/filters.py; reference compiles jmespath +
+globset — src/external_integration/mod.rs:373). Covers grammar corners,
+missing-field and type-mismatch semantics, glob boundary rules, parse
+errors, and the DocumentStore filter-merging path end to end."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing.filters import (
+    FilterParseError,
+    compile_filter,
+    glob_match,
+)
+
+
+def test_nested_paths():
+    f = compile_filter("owner.name == 'alice'")
+    assert f({"owner": {"name": "alice"}})
+    assert not f({"owner": {"name": "bob"}})
+    assert not f({"owner": "alice"})  # non-dict midway -> None
+    assert not f({})
+
+
+def test_missing_field_comparisons_are_false_not_errors():
+    assert not compile_filter("size > `10`")({})
+    assert not compile_filter("size < `10`")({})
+    assert not compile_filter("size == `10`")({"other": 1})
+    # != of a missing field: None != 10 holds (JMESPath null semantics)
+    assert compile_filter("size != `10`")({})
+
+
+def test_type_mismatch_comparisons_do_not_crash():
+    f = compile_filter("size > `10`")
+    assert f({"size": 11})
+    assert not f({"size": "big"})  # str vs int: False, no TypeError
+    assert not f({"size": None})
+    assert not f({"size": [1, 2]})
+
+
+def test_backtick_json_literals():
+    assert compile_filter("flag == `true`")({"flag": True})
+    assert compile_filter("flag == `null`")({})
+    assert compile_filter("name == `\"x\"`")({"name": "x"})
+    assert compile_filter("pi > `3.13`")({"pi": 3.14159})
+
+
+def test_double_and_single_quoted_strings():
+    assert compile_filter("owner == \"alice\"")({"owner": "alice"})
+    assert compile_filter("owner == 'ali ce'")({"owner": "ali ce"})
+
+
+def test_boolean_precedence_and_parens():
+    # && binds tighter than ||
+    f = compile_filter("a == `1` || b == `1` && c == `1`")
+    assert f({"a": 1, "b": 0, "c": 0})
+    assert f({"a": 0, "b": 1, "c": 1})
+    assert not f({"a": 0, "b": 1, "c": 0})
+    g = compile_filter("(a == `1` || b == `1`) && c == `1`")
+    assert not g({"a": 1, "b": 0, "c": 0})
+    assert g({"b": 1, "c": 1})
+
+
+def test_negation_forms():
+    f = compile_filter("!(owner == 'a') && owner != 'b'")
+    assert f({"owner": "c"})
+    assert not f({"owner": "a"})
+    assert not f({"owner": "b"})
+
+
+def test_contains():
+    f = compile_filter("contains(path, 'foo')")
+    assert f({"path": "a/foo/b"})
+    assert not f({"path": "a/bar"})
+    assert not f({})  # missing field
+
+
+def test_parse_errors():
+    for bad in (
+        "owner ==",  # dangling comparison
+        "owner == 'a' &&",  # dangling conjunction
+        "(owner == 'a'",  # unclosed paren
+        "owner == 'a' extra",  # trailing garbage
+        "@@bad@@",  # untokenizable
+    ):
+        with pytest.raises(FilterParseError):
+            compile_filter(bad)
+
+
+def test_glob_star_does_not_cross_separators():
+    assert glob_match("docs/*.txt", "docs/a.txt")
+    assert not glob_match("docs/*.txt", "docs/sub/a.txt")
+    assert glob_match("docs/**/*.txt", "docs/sub/deep/a.txt")
+    # globset semantics: **/ also matches zero directories
+    assert glob_match("**/*.txt", "a.txt")
+    assert glob_match("**/*.txt", "x/y/a.txt")
+
+
+def test_glob_question_and_charclass():
+    assert glob_match("f?o.txt", "foo.txt")
+    assert not glob_match("f?o.txt", "f/o.txt")  # ? never matches /
+    assert glob_match("report[0-9].pdf", "report7.pdf")
+    assert not glob_match("report[0-9].pdf", "reportX.pdf")
+
+
+def test_glob_non_string_path():
+    assert not glob_match("*", None)
+    assert not glob_match("*", 42)
+
+
+def test_filter_with_index_end_to_end():
+    """Filters flow through DataIndex.query metadata_filter with nested
+    paths and numeric backticks."""
+    from pathway_tpu.stdlib.indexing import BruteForceKnn, DataIndex
+
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(vec=list, meta=object),
+        [
+            ([1.0, 0.0], {"path": "docs/a.txt", "info": {"lang": "en"}, "size": 5}),
+            ([0.9, 0.1], {"path": "img/b.png", "info": {"lang": "de"}, "size": 50}),
+        ],
+    )
+    docs = docs.select(
+        vec=pw.apply(lambda v: __import__("numpy").array(v), docs.vec),
+        _metadata=docs.meta,
+    )
+    index = DataIndex(
+        docs,
+        BruteForceKnn(
+            data_column=docs.vec, metadata_column=docs._metadata, dimensions=2
+        ),
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qvec=object, flt=str),
+        [
+            ([1.0, 0.0], "info.lang == 'de' && size >= `10`"),
+        ],
+    )
+    queries = queries.select(
+        qvec=pw.apply(lambda v: __import__("numpy").array(v), queries.qvec),
+        flt=queries.flt,
+    )
+    res = index.query(
+        queries.qvec, number_of_matches=2, metadata_filter=queries.flt,
+        collapse_rows=False,
+    )
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from utils import run_capture
+
+    cap = run_capture(res)
+    metas = [r for r in cap.state.rows.values()]
+    assert len(metas) == 1  # only the b.png doc passes the filter
+
+
+def test_merge_filters_combines_glob_and_filter():
+    from pathway_tpu.xpacks.llm.document_store import DocumentStore
+
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(
+            query=str, k=int, metadata_filter=(str | None),
+            filepath_globpattern=(str | None),
+        ),
+        [
+            ("q", 1, "owner == 'a'", "docs/*.txt"),
+            ("q", 1, None, None),
+            ("q", 1, None, "*.md"),
+        ],
+    )
+    merged = DocumentStore.merge_filters(queries)
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from utils import run_capture
+
+    cap = run_capture(merged)
+    flts = sorted(
+        (r[-1] or "") for r in cap.state.rows.values()
+    )
+    assert flts == [
+        "",
+        "(owner == 'a') && globmatch('docs/*.txt', path)",
+        "globmatch('*.md', path)",
+    ]
+    # and the merged strings actually compile + evaluate
+    pred = compile_filter("(owner == 'a') && globmatch('docs/*.txt', path)")
+    assert pred({"owner": "a", "path": "docs/x.txt"})
+    assert not pred({"owner": "a", "path": "docs/sub/x.txt"})

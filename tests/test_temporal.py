@@ -1,0 +1,504 @@
+"""Temporal matrix: window kinds x reducers, behaviors x windows
+(streamed via __time__ scripts), and the interval/window/asof/asof_now
+join mode matrix. Reference test model:
+python/pathway/tests/temporal/ (test_windows.py, test_interval_join.py,
+test_window_join.py, test_asof_join.py, test_asof_now_join.py,
+test_behaviors.py)."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib import temporal
+
+sys.path.insert(0, str(Path(__file__).parent))
+from utils import T, run_capture, stream_of  # noqa: E402
+
+
+def _vals(table, *cols):
+    cap = run_capture(table)
+    rows = [tuple(r[i] for i in range(len(cols))) for r in cap.state.rows.values()]
+    # None sorts last within its column (outer-join pads mix None & str)
+    return sorted(rows, key=lambda r: tuple((v is None, v) for v in r))
+
+
+EVENTS = """
+    k | t  | v
+    a | 1  | 1
+    b | 5  | 2
+    c | 12 | 3
+    d | 15 | 4
+    e | 21 | 5
+    """
+
+
+# ------------------------------------------------------------- windows
+
+
+def test_tumbling_window_counts_and_bounds():
+    t = T(EVENTS)
+    res = t.windowby(t.t, window=temporal.tumbling(duration=10)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        n=pw.reducers.count(),
+        s=pw.reducers.sum(pw.this.v),
+    )
+    assert _vals(res, "start", "end", "n", "s") == [
+        (0, 10, 2, 3),
+        (10, 20, 2, 7),
+        (20, 30, 1, 5),
+    ]
+
+
+def test_tumbling_window_origin_offset():
+    t = T(EVENTS)
+    res = t.windowby(
+        t.t, window=temporal.tumbling(duration=10, origin=5)
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    # windows [5,15): {5,12}, [15,25): {15,21}, [-5,5): {1}
+    assert _vals(res, "start", "n") == [(-5, 1), (5, 2), (15, 2)]
+
+
+def test_sliding_window_multi_membership():
+    t = T(
+        """
+        k | t
+        a | 12
+        """
+    )
+    res = t.windowby(
+        t.t, window=temporal.sliding(hop=5, duration=10)
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    # t=12 belongs to [5,15) and [10,20)
+    assert _vals(res, "start", "n") == [(5, 1), (10, 1)]
+
+
+def test_session_window_max_gap():
+    t = T(
+        """
+        k | t
+        a | 1
+        b | 3
+        c | 10
+        d | 12
+        e | 30
+        """
+    )
+    res = t.windowby(
+        t.t, window=temporal.session(max_gap=5)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        n=pw.reducers.count(),
+    )
+    assert _vals(res, "start", "end", "n") == [(1, 3, 2), (10, 12, 2), (30, 30, 1)]
+
+
+def test_session_window_predicate():
+    t = T(
+        """
+        k | t
+        a | 1
+        b | 2
+        c | 40
+        """
+    )
+    res = t.windowby(
+        t.t,
+        window=temporal.session(predicate=lambda a, b: (b - a) <= 10),
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    assert _vals(res, "start", "n") == [(1, 2), (40, 1)]
+
+
+def test_windowby_instance_isolates_keys():
+    t = T(
+        """
+        k | t | grp
+        a | 1 | x
+        b | 2 | x
+        c | 3 | y
+        """
+    )
+    res = t.windowby(
+        t.t, window=temporal.tumbling(duration=10), instance=t.grp
+    ).reduce(
+        grp=pw.this._pw_instance,
+        n=pw.reducers.count(),
+    )
+    assert _vals(res, "grp", "n") == [("x", 2), ("y", 1)]
+
+
+def test_intervals_over():
+    t = T(EVENTS)
+    probes = T(
+        """
+        p
+        10
+        20
+        """
+    )
+    res = t.windowby(
+        t.t,
+        window=temporal.intervals_over(
+            at=probes.p, lower_bound=-10, upper_bound=0, is_outer=False
+        ),
+    ).reduce(
+        at=pw.this._pw_window_start + 10,
+        n=pw.reducers.count(),
+    )
+    # at=10 covers t in [0,10]: {1,5}; at=20 covers [10,20]: {12,15}
+    assert _vals(res, "at", "n") == [(10, 2), (20, 2)]
+
+
+# -------------------------------------------------- behaviors x windows
+
+
+def test_common_behavior_delay_buffers_emission():
+    """delay=4: the [0,10) window must not emit before engine time
+    start+delay — early wave outputs would flap on every row."""
+    t = T(
+        """
+        k | t | __time__
+        a | 1 | 2
+        b | 2 | 4
+        c | 6 | 10
+        """
+    )
+    res = t.windowby(
+        t.t,
+        window=temporal.tumbling(duration=10),
+        behavior=temporal.common_behavior(delay=4),
+    ).reduce(n=pw.reducers.count())
+    events = stream_of(res)
+    assert [(row, d) for (_t, _k, row, d) in events] == [((3,), 1)]
+
+
+def test_common_behavior_cutoff_freezes_results():
+    """cutoff: a row arriving after window end + cutoff is IGNORED but
+    the window's result is kept (keep_results=True default)."""
+    t = T(
+        """
+        k | t  | __time__
+        a | 1  | 2
+        b | 2  | 2
+        c | 50 | 4
+        d | 3  | 6
+        """
+    )
+    # by engine time 4, the watermark (max t seen = 50) is far past the
+    # [0,10) window end + cutoff=5 -> the late t=3 row at engine time 6
+    # must not change the frozen count of 2
+    res = t.windowby(
+        t.t,
+        window=temporal.tumbling(duration=10),
+        behavior=temporal.common_behavior(cutoff=5),
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    assert (0, 2) in _vals(res, "start", "n")
+
+
+def test_common_behavior_cutoff_drops_results():
+    """keep_results=False additionally removes the window output once the
+    cutoff passes."""
+    t = T(
+        """
+        k | t  | __time__
+        a | 1  | 2
+        b | 50 | 4
+        """
+    )
+    res = t.windowby(
+        t.t,
+        window=temporal.tumbling(duration=10),
+        behavior=temporal.common_behavior(cutoff=5, keep_results=False),
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    finals = _vals(res, "start", "n")
+    assert (0, 1) not in finals  # the [0,10) window was dropped
+    assert (50, 1) in finals
+
+
+def test_exactly_once_behavior_single_emission():
+    """Each window emits exactly once (no retract/re-emit chatter), when
+    the watermark passes its end."""
+    t = T(
+        """
+        k | t  | __time__
+        a | 1  | 2
+        b | 2  | 4
+        c | 11 | 6
+        d | 25 | 8
+        """
+    )
+    res = t.windowby(
+        t.t,
+        window=temporal.tumbling(duration=10),
+        behavior=temporal.exactly_once_behavior(),
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    events = stream_of(res)
+    insertions = [(row, d) for (_t, _k, row, d) in events if d > 0]
+    retractions = [e for e in events if e[3] < 0]
+    assert retractions == [], f"exactly-once must never retract: {events}"
+    # [0,10) emitted once with BOTH rows; [10,20) emitted once after t=25
+    assert ((0, 2), 1) in insertions
+    assert ((10, 1), 1) in insertions
+
+
+# ------------------------------------------------------- interval joins
+
+
+LEFT = """
+    lk | lt | lval
+    a  | 2  | 10
+    b  | 6  | 20
+    c  | 30 | 30
+    """
+RIGHT = """
+    rk | rt | rval
+    x  | 1  | 100
+    y  | 5  | 200
+    z  | 50 | 300
+    """
+
+
+def _ij(how):
+    lt, rt = T(LEFT), T(RIGHT)
+    res = temporal.interval_join(
+        lt, rt, lt.lt, rt.rt, temporal.interval(-2, 1), how=how
+    ).select(lt.lk, rt.rk)
+    return _vals(res, "lk", "rk")
+
+
+def test_interval_join_inner():
+    # pairs with rt - lt in [-2, 1]: (a,x): -1 ok; (a,y): 3 no;
+    # (b,y): -1 ok; (b,x): -5 no; c matches nothing
+    assert _ij("inner") == [("a", "x"), ("b", "y")]
+
+
+def test_interval_join_left():
+    assert _ij("left") == [("a", "x"), ("b", "y"), ("c", None)]
+
+
+def test_interval_join_right():
+    assert _ij("right") == [("a", "x"), ("b", "y"), (None, "z")]
+
+
+def test_interval_join_outer():
+    assert _ij("outer") == [("a", "x"), ("b", "y"), ("c", None), (None, "z")]
+
+
+def test_interval_join_bounds_inclusive():
+    lt = T("""
+        lk | lt
+        a  | 10
+        """)
+    rt = T("""
+        rk | rt
+        p  | 8
+        q  | 12
+        r  | 7
+        s  | 13
+        """)
+    res = temporal.interval_join(
+        lt, rt, lt.lt, rt.rt, temporal.interval(-2, 2)
+    ).select(lt.lk, rt.rk)
+    assert _vals(res, "lk", "rk") == [("a", "p"), ("a", "q")]
+
+
+def test_interval_join_with_on_equality():
+    lt = T("""
+        lk | lt | sym
+        a  | 2  | AA
+        b  | 2  | BB
+        """)
+    rt = T("""
+        rk | rt | sym
+        x  | 2  | AA
+        y  | 2  | CC
+        """)
+    res = temporal.interval_join(
+        lt, rt, lt.lt, rt.rt, temporal.interval(-1, 1), lt.sym == rt.sym
+    ).select(lt.lk, rt.rk)
+    assert _vals(res, "lk", "rk") == [("a", "x")]
+
+
+# --------------------------------------------------------- window joins
+
+
+def _wj(how):
+    lt, rt = T(LEFT), T(RIGHT)
+    res = temporal.window_join(
+        lt, rt, lt.lt, rt.rt, temporal.tumbling(duration=10), how=how
+    ).select(lt.lk, rt.rk)
+    return _vals(res, "lk", "rk")
+
+
+def test_window_join_inner():
+    # windows: [0,10): l{a,b} r{x,y}; [30,40): l{c}; [50,60): r{z}
+    assert _wj("inner") == [("a", "x"), ("a", "y"), ("b", "x"), ("b", "y")]
+
+
+def test_window_join_left():
+    assert _wj("left") == [
+        ("a", "x"), ("a", "y"), ("b", "x"), ("b", "y"), ("c", None)
+    ]
+
+
+def test_window_join_right():
+    assert _wj("right") == [
+        ("a", "x"), ("a", "y"), ("b", "x"), ("b", "y"), (None, "z")
+    ]
+
+
+def test_window_join_outer():
+    assert _wj("outer") == [
+        ("a", "x"), ("a", "y"), ("b", "x"), ("b", "y"), ("c", None), (None, "z")
+    ]
+
+
+# ----------------------------------------------------------- asof joins
+
+
+TRADES = """
+    tk | tt
+    a  | 3
+    b  | 7
+    c  | 100
+    """
+QUOTES = """
+    qk | qt | px
+    p  | 1  | 10
+    q  | 5  | 20
+    r  | 90 | 30
+    """
+
+
+def _asof(direction):
+    lt, rt = T(TRADES), T(QUOTES)
+    res = temporal.asof_join(
+        lt, rt, lt.tt, rt.qt, direction=direction
+    ).select(lt.tk, rt.px)
+    return _vals(res, "tk", "px")
+
+
+def test_asof_join_backward():
+    assert _asof(temporal.Direction.BACKWARD) == [
+        ("a", 10), ("b", 20), ("c", 30)
+    ]
+
+
+def test_asof_join_forward():
+    assert _asof(temporal.Direction.FORWARD) == [
+        ("a", 20), ("b", 30), ("c", None)
+    ]
+
+
+def test_asof_join_nearest():
+    # a(3): dist 2 to qt=1, 2 to qt=5 -> implementation tie-break; use
+    # unambiguous probes instead
+    lt = T("""
+        tk | tt
+        a  | 2
+        b  | 80
+        """)
+    rt = T(QUOTES)
+    res = temporal.asof_join(
+        lt, rt, lt.tt, rt.qt, direction=temporal.Direction.NEAREST
+    ).select(lt.tk, rt.px)
+    assert _vals(res, "tk", "px") == [("a", 10), ("b", 30)]
+
+
+def test_asof_join_with_on_partitions():
+    lt = T("""
+        tk | tt | sym
+        a  | 4  | AA
+        b  | 4  | BB
+        """)
+    rt = T("""
+        qk | qt | sym | px
+        p  | 1  | AA  | 10
+        q  | 2  | BB  | 20
+        r  | 3  | BB  | 30
+        """)
+    res = temporal.asof_join(
+        lt, rt, lt.tt, rt.qt, lt.sym == rt.sym
+    ).select(lt.tk, rt.px)
+    assert _vals(res, "tk", "px") == [("a", 10), ("b", 30)]
+
+
+def test_asof_join_right():
+    lt, rt = T(TRADES), T(QUOTES)
+    res = temporal.asof_join_right(
+        rt, lt, rt.qt, lt.tt
+    ).select(rt.qk, lt.tk)
+    # right-asof flips sides: each TRADE picks its backward quote
+    assert ("p", "a") in _vals(res, "qk", "tk")
+
+
+# ------------------------------------------------------- asof_now join
+
+
+def test_asof_now_join_results_frozen():
+    """Left insertions join the right state AS OF arrival; later right
+    updates must NOT retro-update delivered results."""
+    queries = T(
+        """
+        qk | sym | __time__
+        q1 | AA  | 4
+        """
+    )
+    prices = T(
+        """
+        sym | px | __time__ | __diff__
+        AA  | 10 | 2        | 1
+        AA  | 10 | 6        | -1
+        AA  | 99 | 6        | 1
+        """
+    )
+    res = temporal.asof_now_join(
+        queries, prices, queries.sym == prices.sym
+    ).select(queries.qk, prices.px)
+    events = stream_of(res)
+    assert [(row, d) for (_t, _k, row, d) in events] == [(("q1", 10), 1)], (
+        f"asof_now must freeze at query time: {events}"
+    )
+
+
+def test_asof_now_join_left_pads():
+    queries = T(
+        """
+        qk | sym
+        q1 | ZZ
+        """
+    )
+    prices = T(
+        """
+        sym | px
+        AA  | 10
+        """
+    )
+    res = temporal.asof_now_join_left(
+        queries, prices, queries.sym == prices.sym
+    ).select(queries.qk, prices.px)
+    assert _vals(res, "qk", "px") == [("q1", None)]
+
+
+# ------------------------------------------------- streaming re-windowing
+
+
+def test_tumbling_window_retracts_on_update():
+    """An upstream retraction moves a row across windows; the old window
+    must shrink and the new one grow (delta-correctness of windowby)."""
+    t = T(
+        """
+        k | t  | __time__ | __diff__
+        a | 1  | 2        | 1
+        b | 2  | 2        | 1
+        a | 1  | 4        | -1
+        a | 12 | 4        | 1
+        """
+    ).with_id_from(pw.this.k)
+    res = t.windowby(t.t, window=temporal.tumbling(duration=10)).reduce(
+        start=pw.this._pw_window_start, n=pw.reducers.count()
+    )
+    assert _vals(res, "start", "n") == [(0, 1), (10, 1)]

@@ -209,4 +209,5 @@ def test_client_arg_validation():
     c = VectorStoreClient(url="http://example:123", additional_headers={"X-K": "v"})
     assert c.url == "http://example:123"
     assert c.additional_headers == {"X-K": "v"}
-    assert VectorStoreClient(host="h").url == "http://h:80"
+    # default port matches run_server's 8000
+    assert VectorStoreClient(host="h").url == "http://h:8000"
