@@ -503,25 +503,9 @@ class InputNode(Node):
             return
         out, self.pending = self.pending, []
         nb_t = _nb_type()
-        if nb_t is not None and any(type(s) is nb_t for s in out):
-            batches = [s for s in out if type(s) is nb_t]
-            entries = [s for s in out if type(s) is not nb_t]
-            if entries:
-                # mixed wave (native ingest + per-row fallbacks): the
-                # distinct-insert guarantee can span both parts, so take
-                # the safe object-plane consolidation
-                flat: list[Entry] = []
-                for b in batches:
-                    flat.extend(b.materialize())
-                flat.extend(entries)
-                self.emit(time, consolidate(flat))
-                return
-            nb = batches[0] if len(batches) == 1 else nb_t.concat(batches)
-            if not nb.is_distinct_insert():
-                nb = nb.consolidate()
-            self.emit(time, nb)
-            return
-        self.emit(time, consolidate(out))
+        batches = [s for s in out if type(s) is nb_t] if nb_t is not None else []
+        entries = [s for s in out if type(s) is not nb_t]
+        _emit_merged(self, time, batches, entries)
 
 
 class StatelessNode(Node):
@@ -766,17 +750,52 @@ class FilterNode(Node):
         self._filter_entries(time, entries)
 
 
-class ReindexNode(Node):
-    """Assign new keys via fn(key, row) -> new_key (reindex / with_id_from)."""
+def _emit_merged(node: Node, time: int, batches: list, entries: list[Entry]) -> None:
+    """Shared wave emission for nodes that re-key or merge streams: keeps
+    token-resident batches native when the whole wave is native, and
+    consolidates (re-keying can collide keys; inputs can carry retract
+    pairs). Mirrors InputNode.finish_time's merging rules."""
+    nb_t = _nb_type()
+    if batches and not entries:
+        nb = batches[0] if len(batches) == 1 else nb_t.concat(batches)
+        if not nb.is_distinct_insert():
+            nb = nb.consolidate()
+        node.emit(time, nb)
+        return
+    if batches:
+        flat: list[Entry] = []
+        for b in batches:
+            flat.extend(b.materialize())
+        flat.extend(entries)
+        node.emit(time, consolidate(flat))
+        return
+    if entries:
+        node.emit(time, consolidate(entries))
 
-    def __init__(self, graph: Graph, inp: Node, key_fn: Callable[[Key, tuple], Key]):
+
+class ReindexNode(Node):
+    """Assign new keys via fn(key, row) -> new_key (reindex / with_id_from).
+
+    `native_cols` (lowering-gated: PointerExpression over plain
+    stably-typed columns of a native-plane input, no instance) keeps the
+    wave token-resident: new keys are blake2b-128 of the projected column
+    pieces in C (dataplane.cpp dp_rekey — byte-identical to
+    key_for_values), so with_id_from no longer forces the object plane.
+    Rows whose key columns hold ERROR take the per-row path (the planes'
+    ERROR serializations differ by design)."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        key_fn: Callable[[Key, tuple], Key],
+        native_cols: list[int] | None = None,
+    ):
         super().__init__(graph, [inp])
         self.key_fn = key_fn
+        self.native_cols = native_cols
 
-    def finish_time(self, time: int) -> None:
-        entries = self.take_input()
-        if not entries:
-            return
+    def _rekey_object(self, entries: list[Entry]) -> list[Entry]:
         out: list[Entry] = []
         for key, row, diff in entries:
             try:
@@ -785,7 +804,35 @@ class ReindexNode(Node):
                 self.log_error(f"reindex: {type(e).__name__}: {e}")
                 continue
             out.append((nk, row, diff))
-        self.emit(time, consolidate(out))
+        return out
+
+    def finish_time(self, time: int) -> None:
+        if self.native_cols is None or _nb_type() is None:
+            entries = self.take_input()
+            if entries:
+                self.emit(time, consolidate(self._rekey_object(entries)))
+            return
+        from pathway_tpu.engine.native import dataplane as dp
+
+        batches, entries = self.take_segments()
+        out_entries = self._rekey_object(entries) if entries else []
+        out_batches = []
+        for b in batches:
+            res = dp.rekey(b.tab, b.token, self.native_cols)
+            if res is None:
+                out_entries.extend(self._rekey_object(b.materialize()))
+                continue
+            lo, hi = res
+            bad = (lo == 0) & (hi == 0)
+            if bad.any():
+                out_entries.extend(self._rekey_object(b.select(bad).materialize()))
+                good = ~bad
+                b = b.select(good)
+                lo, hi = lo[good], hi[good]
+            out_batches.append(
+                dp.NativeBatch(b.tab, lo, hi, b.token, b.diff)
+            )
+        _emit_merged(self, time, out_batches, out_entries)
 
 
 class ConcatNode(Node):
@@ -793,11 +840,14 @@ class ConcatNode(Node):
         super().__init__(graph, inputs)
 
     def finish_time(self, time: int) -> None:
-        out: list[Entry] = []
+        batches: list = []
+        entries: list[Entry] = []
         for i in range(len(self.inputs)):
-            out.extend(self.take_input(i))
-        if out:
-            self.emit(time, consolidate(out))
+            b, e = self.take_segments(i)
+            batches.extend(b)
+            entries.extend(e)
+        if batches or entries:
+            _emit_merged(self, time, batches, entries)
 
 
 class FlattenNode(Node):

@@ -1109,6 +1109,48 @@ int64_t dp_project_group(void* h, int64_t n, const uint64_t* tokens,
     return 0;
 }
 
+// ---------------------------------------------------------------- rekey
+
+// New record keys from column content: key128 = blake2b-128 of the
+// concatenated projected pieces — byte-identical to Python
+// key_for_values(*cols) / ref_scalar (with_id_from semantics). Rows whose
+// key columns contain forbid_tag (ERROR) get out_lo = out_hi = 0 and the
+// caller falls back / drops them like the object plane's key_fn failure.
+// Returns 0, or -1-i on malformed row i.
+int64_t dp_rekey(void* h, int64_t n, const uint64_t* tokens,
+                 const int64_t* col_idx, int64_t n_cols, uint8_t forbid_tag,
+                 uint64_t* out_lo, uint64_t* out_hi) {
+    auto* tab = static_cast<InternTable*>(h);
+    std::vector<const char*> starts(static_cast<size_t>(n_cols));
+    std::vector<const char*> ends(static_cast<size_t>(n_cols));
+    std::string kb;
+    kb.reserve(64);
+    std::shared_lock<std::shared_mutex> g(tab->mu);
+    for (int64_t i = 0; i < n; ++i) {
+        const char* row;
+        int64_t rlen;
+        if (!tab->get(tokens[i], &row, &rlen) ||
+            !find_cols(row, rlen, col_idx, n_cols, starts.data(), ends.data()))
+            return -1 - i;
+        kb.clear();
+        bool forbidden = false;
+        for (int64_t j = 0; j < n_cols; ++j) {
+            if (forbid_tag != 0 &&
+                static_cast<uint8_t>(*starts[j]) == forbid_tag)
+                forbidden = true;
+            kb.append(starts[j], static_cast<size_t>(ends[j] - starts[j]));
+        }
+        if (forbidden) {
+            out_lo[i] = 0;
+            out_hi[i] = 0;
+            continue;
+        }
+        blake2b_128(reinterpret_cast<const uint8_t*>(kb.data()), kb.size(),
+                    &out_lo[i], &out_hi[i]);
+    }
+    return 0;
+}
+
 // Shard by record key: key128 % n (identical to Python `key.value % n`).
 void dp_route_key(int64_t n, const uint64_t* key_lo, const uint64_t* key_hi,
                   int64_t n_shards, int64_t* out_shard) {

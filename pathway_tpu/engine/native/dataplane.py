@@ -122,6 +122,11 @@ def _load() -> ctypes.CDLL | None:
             i64p, c.c_uint8,
         ]
         lib.dp_route_key.argtypes = [c.c_int64, u64p, u64p, c.c_int64, i64p]
+        lib.dp_rekey.restype = c.c_int64
+        lib.dp_rekey.argtypes = [
+            c.c_void_p, c.c_int64, u64p, i64p, c.c_int64, c.c_uint8,
+            u64p, u64p,
+        ]
         lib.dp_build_rows.restype = c.c_int64
         lib.dp_build_rows.argtypes = [
             c.c_void_p, c.c_int64, u64p, c.c_int64, i64p, i64p,
@@ -684,6 +689,25 @@ def project_group(
     if rc != 0:
         return None
     return gt, (sh if n_shards > 0 else None)
+
+
+def rekey(tab: InternTable, tokens: np.ndarray, col_idx: list[int]):
+    """New 128-bit record keys = blake2b of the projected column pieces —
+    byte-identical to `key_for_values(*cols)` (with_id_from / reindex).
+    Returns (lo, hi) with 0/0 marking rows whose key columns hold ERROR
+    (those must take the object-plane key path: the planes' ERROR
+    serializations differ); None on malformed rows."""
+    lib = _load()
+    n = len(tokens)
+    lo = np.empty(n, np.uint64)
+    hi = np.empty(n, np.uint64)
+    rc = lib.dp_rekey(
+        tab._h, n, np.ascontiguousarray(tokens),
+        np.asarray(col_idx, np.int64), len(col_idx), 0x0E, lo, hi,
+    )
+    if rc != 0:
+        return None
+    return lo, hi
 
 
 def route_key(key_lo: np.ndarray, key_hi: np.ndarray, n_shards: int) -> np.ndarray:

@@ -487,6 +487,9 @@ class Session:
                     )
                     for i, n in enumerate(nodes)
                 ]
+            elif all(t._spec.id in self._native_specs for t in spec.inputs):
+                # token batches flow through concat untouched
+                self._native_specs.add(spec.id)
             return eng.ConcatNode(g, nodes)
 
         if kind == "update_rows":
@@ -543,7 +546,35 @@ class Session:
                     v = key_for_values(v)
                 return v
 
-            return eng.ReindexNode(g, self.node_of(main), key_fn)
+            main_node = self.node_of(main)
+            # with_id_from over plain stably-typed columns of a native
+            # table: blake the projected pieces in C (dp_rekey) and stay
+            # on the token plane
+            native_cols = None
+            if main._spec.id in self._native_specs and isinstance(
+                key_expr, ex.PointerExpression
+            ) and key_expr._instance is None and not key_expr._optional:
+                from pathway_tpu.internals import dtype as dt
+
+                names = main._column_names()
+                cols: list[int] | None = []
+                for a in key_expr._args:
+                    if (
+                        isinstance(a, ex.ColumnReference)
+                        and not isinstance(a, ex.IdReference)
+                        and a.name in names
+                        and main._dtype_of(a.name) in (dt.INT, dt.STR, dt.BOOL)
+                    ):
+                        cols.append(names.index(a.name))
+                    else:
+                        cols = None
+                        break
+                if cols:
+                    native_cols = cols
+                    self._native_specs.add(spec.id)
+            return eng.ReindexNode(
+                g, main_node, key_fn, native_cols=native_cols
+            )
 
         if kind == "flatten":
             main = spec.inputs[0]

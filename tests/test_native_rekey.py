@@ -1,0 +1,148 @@
+"""Token-resident reindex (with_id_from) + concat: dp_rekey computes
+blake2b-128 keys from projected column pieces byte-identically to
+key_for_values, so re-keyed pipelines stay on the native plane through
+downstream group-bys; concat passes token batches through untouched."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.core import ConcatNode, GroupByNode, ReindexNode
+from pathway_tpu.internals.keys import Key, key_for_values
+from pathway_tpu.internals.lowering import Session
+
+
+def _native_or_skip():
+    from pathway_tpu.engine import native
+
+    if not native.available():
+        pytest.skip("native kernel unavailable")
+    from pathway_tpu.engine.native import dataplane as dp
+
+    if not dp.available():
+        pytest.skip("dataplane unavailable")
+    return dp
+
+
+def test_dp_rekey_parity_with_key_for_values():
+    dp = _native_or_skip()
+    tab = dp.InternTable()
+    rows = [(1, "alice", True), (2, "bob", False), (-7, "", True)]
+    toks = np.array([tab.intern_row(r) for r in rows], np.uint64)
+    for cols, pick in (([1], lambda r: (r[1],)), ([0, 2], lambda r: (r[0], r[2]))):
+        lo, hi = dp.rekey(tab, toks, cols)
+        for i, r in enumerate(rows):
+            got = (int(hi[i]) << 64) | int(lo[i])
+            assert got == key_for_values(*pick(r)).value
+
+
+def test_dp_rekey_marks_error_rows():
+    dp = _native_or_skip()
+    from pathway_tpu.internals.errors import ERROR
+
+    tab = dp.InternTable()
+    tok = tab.intern_row((ERROR, "x"))
+    lo, hi = dp.rekey(tab, np.array([tok], np.uint64), [0])
+    assert int(lo[0]) == 0 and int(hi[0]) == 0
+
+
+def _jsonl(tmp_path, name, rows):
+    p = os.path.join(str(tmp_path), name)
+    with open(p, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return p
+
+
+class S(pw.Schema):
+    word: str
+    n: int
+
+
+def test_with_id_from_stays_native(tmp_path):
+    _native_or_skip()
+    p = _jsonl(
+        tmp_path, "in.jsonl",
+        [{"word": f"w{i % 5}", "n": i} for i in range(200)],
+    )
+    t = pw.io.fs.read(p, format="json", schema=S, mode="static")
+    t2 = t.with_id_from(t.word, t.n)
+    agg = t2.groupby(t2.word).reduce(
+        t2.word, c=pw.reducers.count(), s=pw.reducers.sum(t2.n)
+    )
+    s = Session()
+    cap = s.capture(agg)
+    reindex = [n for n in s.graph.nodes if isinstance(n, ReindexNode)]
+    assert reindex and reindex[0].native_cols == [0, 1]
+    gb = [
+        inner
+        for n in s.graph.nodes
+        for inner in [getattr(n, "replicas", [n])[0]]
+        if isinstance(inner, GroupByNode)
+    ]
+    assert gb and gb[0]._plan is not None, (
+        "downstream groupby must keep its token plan after with_id_from"
+    )
+    s.execute()
+    res = sorted(tuple(r) for r in cap.state.rows.values())
+    expect = sorted(
+        (
+            f"w{k}",
+            len([i for i in range(200) if i % 5 == k]),
+            sum(i for i in range(200) if i % 5 == k),
+        )
+        for k in range(5)
+    )
+    assert res == expect
+
+
+def test_with_id_from_keys_match_object_plane(tmp_path):
+    """The content-addressed keys themselves must equal the object
+    plane's (snapshot compatibility and cross-plane joins depend on it)."""
+    _native_or_skip()
+    p = _jsonl(tmp_path, "k.jsonl", [{"word": "hello", "n": 42}])
+    t = pw.io.fs.read(p, format="json", schema=S, mode="static")
+    t2 = t.with_id_from(t.word)
+    s = Session()
+    cap = s.capture(t2)
+    s.execute()
+    (key,) = cap.state.rows
+    assert key == key_for_values("hello")
+
+
+def test_native_concat_passthrough(tmp_path):
+    _native_or_skip()
+    p1 = _jsonl(tmp_path, "a.jsonl", [{"word": "x", "n": 1}])
+    p2 = _jsonl(tmp_path, "b.jsonl", [{"word": "y", "n": 2}])
+    a = pw.io.fs.read(p1, format="json", schema=S, mode="static")
+    b = pw.io.fs.read(p2, format="json", schema=S, mode="static")
+    both = a.concat_reindex(b)
+    agg = both.groupby(both.word).reduce(both.word, s=pw.reducers.sum(both.n))
+    s = Session()
+    cap = s.capture(agg)
+    s.execute()
+    assert sorted(tuple(r) for r in cap.state.rows.values()) == [
+        ("x", 1), ("y", 2)
+    ]
+
+
+def test_reindex_duplicate_keys_consolidate(tmp_path):
+    """Two rows with identical key columns collapse to ONE key after
+    with_id_from; retract/insert pairs must consolidate on the plane."""
+    _native_or_skip()
+    p = _jsonl(
+        tmp_path, "dup.jsonl",
+        [{"word": "same", "n": 1}, {"word": "same", "n": 2}],
+    )
+    t = pw.io.fs.read(p, format="json", schema=S, mode="static")
+    t2 = t.with_id_from(t.word)
+    s = Session()
+    cap = s.capture(t2)
+    s.execute()
+    # both rows land on ONE key; the multiset holds the surviving row
+    assert len(cap.state.rows) == 1
+    (key,) = cap.state.rows
+    assert key == key_for_values("same")
