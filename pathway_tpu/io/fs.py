@@ -247,6 +247,27 @@ def _parse_body(info: dict, tab, body: bytes, seq_start: int):
     return None, entries
 
 
+def _file_metadata(path: str, st) -> dict:
+    """Per-file metadata record (reference: src/connectors/metadata/
+    file_like.rs FileLikeMetadata — created_at, modified_at, owner, path,
+    size, seen_at)."""
+    owner = None
+    try:
+        import pwd
+
+        owner = pwd.getpwuid(st.st_uid).pw_name
+    except (ImportError, KeyError, OSError):
+        pass
+    return {
+        "path": path,
+        "size": st.st_size,
+        "modified_at": int(st.st_mtime),
+        "created_at": int(st.st_ctime),
+        "seen_at": int(_time.time()),
+        "owner": owner,
+    }
+
+
 def _file_head_sig(path: str, size: int) -> list:
     """Identity of a file's head: [n, blake2b(first n bytes)] with
     n = min(4096, size at record time). Frontier positions are only valid
@@ -436,10 +457,7 @@ def _parse_file(
     meta = None
     if with_metadata:
         st = os.stat(path)
-        meta = Json({
-            "path": path, "size": st.st_size, "modified_at": int(st.st_mtime),
-            "created_at": int(st.st_ctime), "seen_at": int(_time.time()),
-        })
+        meta = Json(_file_metadata(path, st))
     if format in ("plaintext", "plaintext_by_file"):
         if format == "plaintext_by_file":
             with open(path, "r", errors="replace") as f:
@@ -636,13 +654,25 @@ def read(
 
     def factory(session: InputSession) -> ThreadConnector:
         def run_fn(sess: InputSession) -> None:
-            seen: dict[str, float] = {}
             # persistence offset frontier (reference: OffsetAntichain,
             # src/persistence/frontier.rs): ['done', mtime, size] marks a
             # fully-consumed file; ['pos', p] a record-aligned byte
             # position inside one — the source SEEKS on resume instead of
             # the journal count-skipping replayed events
             resume = dict(sess.resume_frontier or {})
+            # in-run per-file progress, the same record shape: lets a
+            # grown file continue from its consumed end mid-run, and a
+            # MODIFIED or DELETED file replace/remove its rows — retract
+            # everything previously delivered, then re-read (reference:
+            # src/connectors/metadata/ file change tracking, posix
+            # scanner delete+insert on modified files)
+            progress: dict[str, list] = {}
+            # what to retract on replacement: native batches keep only
+            # array refs (rows stay interned in the process-wide table);
+            # object-plane rows keep (key, row) copies — the price of
+            # replacement semantics on the object plane, dropped when the
+            # file is deleted
+            delivered: dict[str, list] = {}
             # token-resident chunked reads need plain insert sessions
             # (upsert bookkeeping is per-row)
             use_native = native_info is not None and not sess.upsert_mode
@@ -650,28 +680,55 @@ def read(
                 from pathway_tpu.engine.native import dataplane as dp
 
                 tab = dp.default_table()
+
+            def retract(f: str) -> None:
+                for chunk in delivered.pop(f, []):
+                    if chunk[0] == "nb":
+                        import numpy as _np
+
+                        _lo, _hi, _tok = chunk[1], chunk[2], chunk[3]
+                        sess.insert_batch(
+                            dp.NativeBatch(
+                                tab, _lo, _hi, _tok,
+                                _np.full(len(_tok), -1, _np.int64),
+                            )
+                        )
+                    else:
+                        sess.remove(chunk[1], chunk[2])
+
             while True:
+                listed = set()
                 for f in _list_files(path):
+                    listed.add(f)
                     try:
                         st = os.stat(f)
                         mtime = st.st_mtime
                     except OSError:
                         continue
-                    if seen.get(f) == mtime:
-                        continue
+                    ent = resume.pop(f, None)
+                    if ent is None:
+                        ent = progress.get(f)
+                        if (
+                            ent is not None
+                            and ent[0] == "done"
+                            and ent[1] == mtime
+                            and ent[2] == st.st_size
+                        ):
+                            continue  # unchanged since last delivery
                     sig = _file_head_sig(f, st.st_size)
                     start_pos = 0
-                    ent = resume.pop(f, None)
+                    replaced = False
                     if ent is not None:
                         # frontier entries carry a head signature: a
                         # rotated/replaced file must never resume at a
                         # byte offset of unrelated content — mismatch
-                        # falls back to a full re-read (duplicates are
-                        # recoverable; silent loss/garbage is not)
+                        # falls back to a full re-read (in-run: with the
+                        # old rows retracted first; across restarts the
+                        # journal/state already holds them)
                         sig_ok = _head_sig_matches(f, st, ent[-1])
                         if ent[0] == "done" and sig_ok:
                             if ent[1] == mtime and ent[2] == st.st_size:
-                                seen[f] = mtime
+                                progress[f] = ent
                                 continue
                             if st.st_size > ent[2]:
                                 # appended tail: resume at the consumed
@@ -679,21 +736,39 @@ def read(
                                 start_pos = int(ent[2])
                         elif ent[0] == "pos" and sig_ok and st.st_size >= int(ent[1]):
                             start_pos = int(ent[1])
-                    seen[f] = mtime
+                        if start_pos == 0:
+                            replaced = True
+                    if replaced and not sess.upsert_mode:
+                        retract(f)  # file content changed: replace rows
                     # last consumed position: exact even when the file
                     # grows during the read (the 'done' stat is taken
                     # BEFORE parsing, so growth re-delivers, never loses)
                     last_pos = st.st_size
+                    # upsert sessions replace by key; retention would be
+                    # dead memory (retract() is never called for them)
+                    record = (
+                        delivered.setdefault(f, [])
+                        if not sess.upsert_mode
+                        else []
+                    )
                     if use_native:
                         def prog(pos: int, _f=f, _sig=sig) -> None:
                             nonlocal last_pos
                             last_pos = pos
                             sess.mark_frontier({_f: ["pos", pos, _sig]})
 
+                        def ins_batch(nb, _rec=record) -> None:
+                            _rec.append(("nb", nb.key_lo, nb.key_hi, nb.token))
+                            sess.insert_batch(nb)
+
+                        def ins_row(kr, _rec=record) -> None:
+                            _rec.append(("row", kr[0], kr[1]))
+                            sess.insert(kr[0], kr[1])
+
                         _native_parse_file(
                             f, native_info, tab,
-                            sess.insert_batch,
-                            lambda kr: sess.insert(kr[0], kr[1]),
+                            ins_batch,
+                            ins_row,
                             start_pos=start_pos,
                             on_progress=prog,
                         )
@@ -701,6 +776,8 @@ def read(
                         for key, row in _py_resume_rows(
                             f, format, schema, csv_settings, start_pos, pk
                         ):
+                            if not sess.upsert_mode:
+                                record.append(("row", key, row))
                             sess.insert(key, row)
                     else:
                         for rec in _parse_file(f, format, schema, csv_settings, with_metadata):
@@ -710,8 +787,20 @@ def read(
                                 if pk
                                 else sequential_key()
                             )
+                            if not sess.upsert_mode:
+                                record.append(("row", key, row))
                             sess.insert(key, row)
-                    sess.mark_frontier({f: ["done", mtime, last_pos, sig]})
+                    done = ["done", mtime, last_pos, sig]
+                    progress[f] = done
+                    sess.mark_frontier({f: done})
+                # deleted files: retract their rows and free the tracking
+                # (reference: the scanner's file-removal deletions)
+                for gone in [f for f in progress if f not in listed]:
+                    progress.pop(gone, None)
+                    if not sess.upsert_mode:
+                        retract(gone)
+                    else:
+                        delivered.pop(gone, None)
                 if single_pass:
                     return
                 _time.sleep((autocommit_duration_ms or 1500) / 1000.0)

@@ -98,8 +98,64 @@ class Backend:
         return b
 
     @classmethod
-    def azure(cls, *args: Any, **kwargs: Any) -> "Backend":
-        raise NotImplementedError("azure persistence backend unavailable")
+    def azure(
+        cls,
+        root_path: str,
+        account_settings: Any = None,
+        *,
+        container: str | None = None,
+        connection_string: str | None = None,
+        client: Any = None,
+    ) -> "Backend":
+        """Azure Blob persistence (reference: src/persistence/backends/
+        — object-store family). Same staged-sync design as Backend.s3:
+        checkpoints upload changed files with metadata.json LAST, attach
+        pulls the container state. `client` may be an injected
+        S3-shaped client (put/get/list/delete — tests, custom auth) or an
+        azure.storage.blob ContainerClient (adapted); PATHWAY_AZURE_FAKE_DIR
+        routes to the directory-backed fake on dev machines."""
+        b = cls(root_path.strip("/"))
+        b.kind = "s3"  # the staged-sync path is object-store-generic
+        fake_dir = os.environ.get("PATHWAY_AZURE_FAKE_DIR")
+        if client is None and fake_dir:
+            client = _DirS3Client(fake_dir)
+            container = container or fake_dir
+        if client is None:
+            if connection_string is None and account_settings is None:
+                raise ValueError(
+                    "Backend.azure needs a connection_string, "
+                    "account_settings, or an injected client"
+                )
+            if not container:
+                # guard BEFORE client construction: the SDK's own error
+                # for a missing container name is opaque
+                raise ValueError("Backend.azure needs container=...")
+            try:
+                from azure.storage.blob import ContainerClient
+            except ImportError as e:
+                raise ImportError(
+                    "Backend.azure needs azure-storage-blob: "
+                    "`pip install azure-storage-blob`"
+                ) from e
+            if connection_string is not None:
+                cc = ContainerClient.from_connection_string(
+                    connection_string, container_name=container
+                )
+            else:
+                cc = account_settings.container_client(container)
+            client = _AzureContainerAdapter(cc)
+        elif not hasattr(client, "put_object") and hasattr(
+            client, "upload_blob"
+        ):
+            client = _AzureContainerAdapter(client)
+            container = container or "azure"
+        if not container:
+            raise ValueError(
+                "Backend.azure with an injected client needs container=..."
+            )
+        b.s3_client = client
+        b.s3_bucket = container
+        return b
 
     @classmethod
     def mock(cls, events: Any = None) -> "Backend":
@@ -187,6 +243,42 @@ class _DirS3Client:
             key = fn.replace("\x01", "/")
             if key.startswith(Prefix):
                 out.append({"Key": key, "Size": os.path.getsize(os.path.join(self.root, fn))})
+        return {"Contents": out} if out else {}
+
+
+class _AzureContainerAdapter:
+    """azure.storage.blob ContainerClient -> the S3-shaped client surface
+    the staged sync uses (put/get/list/delete). The Bucket parameter is
+    ignored: a ContainerClient is already bound to its container."""
+
+    def __init__(self, container_client: Any):
+        self._cc = container_client
+
+    def put_object(self, Bucket: str, Key: str, Body: bytes) -> None:  # noqa: N803
+        self._cc.upload_blob(Key, Body, overwrite=True)
+
+    def get_object(self, Bucket: str, Key: str) -> dict:  # noqa: N803
+        import io as _io
+
+        data = self._cc.download_blob(Key).readall()
+        return {"Body": _io.BytesIO(data)}
+
+    def delete_object(self, Bucket: str, Key: str) -> None:  # noqa: N803
+        try:
+            self._cc.delete_blob(Key)
+        except Exception as e:  # noqa: BLE001
+            # only blob-not-found is ignorable (idempotent deletes);
+            # auth/network failures must surface, else compaction
+            # silently stops freeing the container. Name-matched so the
+            # azure sdk stays an optional dependency.
+            if type(e).__name__ not in ("ResourceNotFoundError", "KeyError"):
+                raise
+
+    def list_objects_v2(self, Bucket: str, Prefix: str = "", **kw: Any) -> dict:  # noqa: N803
+        out = [
+            {"Key": b.name, "Size": getattr(b, "size", 0)}
+            for b in self._cc.list_blobs(name_starts_with=Prefix)
+        ]
         return {"Contents": out} if out else {}
 
 

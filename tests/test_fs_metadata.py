@@ -1,0 +1,126 @@
+"""File-metadata tracking + live file-change semantics for fs sources
+(reference: src/connectors/metadata/file_like.rs FileLikeMetadata and the
+posix scanner's modified-file replacement). A MODIFIED file's old rows
+retract and the new content replaces them; an APPENDED file delivers
+only its tail (no head duplication); metadata carries the reference's
+field set including owner."""
+
+import getpass
+import json
+import time
+
+import pathway_tpu as pw
+
+
+class S(pw.Schema):
+    v: int
+
+
+def _write(path, values):
+    with open(path, "w") as f:
+        for v in values:
+            f.write(json.dumps({"v": v}) + "\n")
+
+
+def _wait(lt, pred, deadline=20.0):
+    end = time.monotonic() + deadline
+    while time.monotonic() < end:
+        snap = lt.snapshot()
+        if pred(snap):
+            return snap
+        time.sleep(0.05)
+    return lt.snapshot()
+
+
+def test_modified_file_replaces_rows(tmp_path):
+    d = tmp_path / "stream"
+    d.mkdir()
+    _write(d / "a.jsonl", [1, 2])
+    t = pw.io.fs.read(
+        str(d), format="json", schema=S, mode="streaming",
+        autocommit_duration_ms=30,
+    )
+    lt = t.live()
+    snap = _wait(lt, lambda s: {r["v"] for r in s} == {1, 2})
+    assert {r["v"] for r in snap} == {1, 2}
+    # REWRITE the file (different content, not an append): the old rows
+    # must retract and only the new content remain
+    _write(d / "a.jsonl", [7])
+    snap = _wait(lt, lambda s: {r["v"] for r in s} == {7})
+    lt.stop()
+    lt.wait(timeout=20)
+    assert {r["v"] for r in lt.snapshot()} == {7}
+
+
+def test_appended_file_delivers_only_tail(tmp_path):
+    d = tmp_path / "stream"
+    d.mkdir()
+    _write(d / "a.jsonl", [1, 2])
+    t = pw.io.fs.read(
+        str(d), format="json", schema=S, mode="streaming",
+        autocommit_duration_ms=30,
+    )
+    lt = t.live()
+    _wait(lt, lambda s: {r["v"] for r in s} == {1, 2})
+    with open(d / "a.jsonl", "a") as f:
+        f.write(json.dumps({"v": 3}) + "\n")
+    snap = _wait(lt, lambda s: {r["v"] for r in s} == {1, 2, 3})
+    lt.stop()
+    lt.wait(timeout=20)
+    rows = [r["v"] for r in lt.snapshot()]
+    # no duplicated head rows: exactly three entries
+    assert sorted(rows) == [1, 2, 3]
+
+
+def test_shrunk_file_replaces_rows(tmp_path):
+    d = tmp_path / "stream"
+    d.mkdir()
+    _write(d / "a.jsonl", [1, 2, 3])
+    t = pw.io.fs.read(
+        str(d), format="json", schema=S, mode="streaming",
+        autocommit_duration_ms=30,
+    )
+    lt = t.live()
+    _wait(lt, lambda s: {r["v"] for r in s} == {1, 2, 3})
+    _write(d / "a.jsonl", [1])  # same head, shorter: replacement
+    snap = _wait(lt, lambda s: {r["v"] for r in s} == {1})
+    lt.stop()
+    lt.wait(timeout=20)
+    assert [r["v"] for r in lt.snapshot()] == [1]
+
+
+def test_deleted_file_retracts_rows(tmp_path):
+    import os
+
+    d = tmp_path / "stream"
+    d.mkdir()
+    _write(d / "a.jsonl", [1, 2])
+    _write(d / "b.jsonl", [9])
+    t = pw.io.fs.read(
+        str(d), format="json", schema=S, mode="streaming",
+        autocommit_duration_ms=30,
+    )
+    lt = t.live()
+    _wait(lt, lambda s: {r["v"] for r in s} == {1, 2, 9})
+    os.unlink(d / "a.jsonl")
+    snap = _wait(lt, lambda s: {r["v"] for r in s} == {9})
+    lt.stop()
+    lt.wait(timeout=20)
+    assert {r["v"] for r in lt.snapshot()} == {9}
+
+
+def test_metadata_fields(tmp_path):
+    p = tmp_path / "doc.txt"
+    p.write_text("hello world\n")
+    t = pw.io.fs.read(
+        str(p), format="plaintext_by_file", mode="static", with_metadata=True
+    )
+    df = pw.debug.table_to_pandas(t, include_id=False)
+    (meta,) = [
+        m.value if hasattr(m, "value") else m for m in df["_metadata"]
+    ]
+    assert meta["path"].endswith("doc.txt")
+    assert meta["size"] == len("hello world\n")
+    for field in ("modified_at", "created_at", "seen_at"):
+        assert isinstance(meta[field], int) and meta[field] > 0
+    assert meta["owner"] == getpass.getuser()

@@ -114,6 +114,37 @@ def test_with_id_from_keys_match_object_plane(tmp_path):
 
 
 def test_native_concat_passthrough(tmp_path):
+    """PLAIN concat (disjointness promised) of two native tables: token
+    batches must pass through untouched and the downstream groupby must
+    keep its token plan — concat_reindex would interpose object-plane
+    ReindexNodes and miss the path."""
+    _native_or_skip()
+    p1 = _jsonl(tmp_path, "a.jsonl", [{"word": "x", "n": 1}])
+    p2 = _jsonl(tmp_path, "b.jsonl", [{"word": "y", "n": 2}])
+    a = pw.io.fs.read(p1, format="json", schema=S, mode="static")
+    b = pw.io.fs.read(p2, format="json", schema=S, mode="static")
+    pw.universes.promise_are_pairwise_disjoint(a, b)
+    both = a.concat(b)
+    agg = both.groupby(both.word).reduce(both.word, s=pw.reducers.sum(both.n))
+    s = Session()
+    cap = s.capture(agg)
+    assert both._spec.id in s._native_specs
+    gb = [
+        inner
+        for n in s.graph.nodes
+        for inner in [getattr(n, "replicas", [n])[0]]
+        if isinstance(inner, GroupByNode)
+    ]
+    assert gb and gb[0]._plan is not None, (
+        "groupby downstream of native concat must keep its token plan"
+    )
+    s.execute()
+    assert sorted(tuple(r) for r in cap.state.rows.values()) == [
+        ("x", 1), ("y", 2)
+    ]
+
+
+def test_concat_reindex_still_correct(tmp_path):
     _native_or_skip()
     p1 = _jsonl(tmp_path, "a.jsonl", [{"word": "x", "n": 1}])
     p2 = _jsonl(tmp_path, "b.jsonl", [{"word": "y", "n": 2}])
