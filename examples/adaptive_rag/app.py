@@ -1,0 +1,72 @@
+"""Live Adaptive RAG service over a watched document directory.
+
+Documents dropped into the directory are parsed, split, embedded
+(on-chip via JaxEmbedder when a TPU is present) and indexed; the REST
+endpoint answers questions against the CURRENT corpus with geometric
+context expansion (start with a few documents, double until the answer
+is supported). Reference analog: the adaptive-rag template
+(xpacks/llm/question_answering.py AdaptiveRAGQuestionAnswerer).
+
+Run:
+    python app.py ./corpus --port 8000
+Ask:
+    curl -X POST localhost:8000/v1/pw_ai_answer \
+         -H 'Content-Type: application/json' \
+         -d '{"prompt": "What is the refund policy?"}'
+
+--mock swaps the embedder/LLM for deterministic fakes (no model
+weights needed — plumbing demo and test mode).
+"""
+
+import argparse
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.question_answering import (
+    AdaptiveRAGQuestionAnswerer,
+)
+from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("corpus", help="directory of documents to index")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--mock", action="store_true", help="fake embedder+LLM")
+    args = ap.parse_args()
+
+    docs = pw.io.fs.read(
+        args.corpus,
+        format="binary",
+        with_metadata=True,
+        mode="streaming",
+        autocommit_duration_ms=200,
+    )
+
+    if args.mock:
+        from pathway_tpu.xpacks.llm.mocks import FakeChatModel, FakeEmbedder
+
+        embedder: pw.UDF = FakeEmbedder(dim=32)
+        llm: pw.UDF = FakeChatModel()
+    else:
+        from pathway_tpu.xpacks.llm.embedders import JaxEmbedder
+        from pathway_tpu.xpacks.llm.llms import JaxLMChat
+
+        embedder = JaxEmbedder()
+        llm = JaxLMChat()
+
+    store = DocumentStore(
+        docs,
+        retriever_factory=BruteForceKnnFactory(
+            dimensions=embedder.get_embedding_dimension(), embedder=embedder
+        ),
+        splitter=TokenCountSplitter(min_tokens=50, max_tokens=250),
+    )
+    answerer = AdaptiveRAGQuestionAnswerer(llm, store)
+    answerer.run_server(host=args.host, port=args.port, with_cache=False)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Realtime linear regression over a streaming source.
+
+(x, y) events stream in; the least-squares coefficients a, b of
+y = a + b*x stream out, updating incrementally with every commit wave —
+the reference's kafka-linear-regression project
+(examples/projects/kafka-linear-regression/realtime_regression.py), with
+the kafka source swapped for a watched directory so it runs anywhere.
+
+Run:
+    python app.py ./inbox ./regression.csv
+Feed it:
+    python -c "import json,random
+for i in range(100):
+    x = random.uniform(0, 10)
+    print(json.dumps({'x': x, 'y': 2*x - 1 + random.gauss(0, .1)}))" \
+        >> ./inbox/points.jsonl
+"""
+
+import argparse
+
+import pathway_tpu as pw
+
+
+class PointSchema(pw.Schema):
+    x: float
+    y: float
+
+
+def build(points: pw.Table) -> pw.Table:
+    t = points.select(
+        *pw.this, x_square=points.x * points.x, x_y=points.x * points.y
+    )
+    stats = t.reduce(
+        count=pw.reducers.count(),
+        sum_x=pw.reducers.sum(t.x),
+        sum_y=pw.reducers.sum(t.y),
+        sum_x_y=pw.reducers.sum(t.x_y),
+        sum_x_square=pw.reducers.sum(t.x_square),
+    )
+
+    def compute_a(sum_x, sum_y, sum_x_square, sum_x_y, count):
+        d = count * sum_x_square - sum_x * sum_x
+        return 0.0 if d == 0 else (sum_y * sum_x_square - sum_x * sum_x_y) / d
+
+    def compute_b(sum_x, sum_y, sum_x_square, sum_x_y, count):
+        d = count * sum_x_square - sum_x * sum_x
+        return 0.0 if d == 0 else (count * sum_x_y - sum_x * sum_y) / d
+
+    return stats.select(
+        a=pw.apply(compute_a, **stats), b=pw.apply(compute_b, **stats)
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("inbox")
+    ap.add_argument("output")
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args()
+
+    points = pw.io.fs.read(
+        args.inbox,
+        format="json",
+        schema=PointSchema,
+        mode="streaming",
+        autocommit_duration_ms=100,
+        _single_pass=args.once,
+    )
+    pw.io.csv.write(build(points), args.output)
+    pw.run()
+
+
+if __name__ == "__main__":
+    main()

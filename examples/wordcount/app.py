@@ -1,0 +1,52 @@
+"""Streaming wordcount with persistence.
+
+Run:
+    python app.py ./inbox ./counts.csv ./state
+Feed it:
+    echo '{"word": "hello"}' >> ./inbox/stream.jsonl
+Kill and restart it: counts resume exactly (no recount, no loss).
+
+Reference analog: integration_tests/wordcount/pw_wordcount.py.
+"""
+
+import argparse
+
+import pathway_tpu as pw
+
+
+class WordSchema(pw.Schema):
+    word: str
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("inbox", help="directory of jsonl files with a 'word' field")
+    ap.add_argument("output", help="csv output path")
+    ap.add_argument("state", nargs="?", default=None, help="persistence dir")
+    ap.add_argument("--once", action="store_true", help="process current data and exit")
+    args = ap.parse_args()
+
+    words = pw.io.fs.read(
+        args.inbox,
+        format="json",
+        schema=WordSchema,
+        mode="streaming",
+        autocommit_duration_ms=100,
+        _single_pass=args.once,
+    )
+    counts = words.groupby(words.word).reduce(
+        words.word, count=pw.reducers.count()
+    )
+    pw.io.csv.write(counts, args.output)
+
+    persistence = None
+    if args.state:
+        persistence = pw.persistence.Config(
+            pw.persistence.Backend.filesystem(args.state),
+            snapshot_interval_ms=500,
+        )
+    pw.run(persistence_config=persistence)
+
+
+if __name__ == "__main__":
+    main()
