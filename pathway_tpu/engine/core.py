@@ -654,7 +654,8 @@ class MapNode(Node):
             self.emit(
                 time,
                 self._dp.NativeBatch(
-                    batch.tab, batch.key_lo, batch.key_hi, out_tok, batch.diff
+                    batch.tab, batch.key_lo, batch.key_hi, out_tok, batch.diff,
+                    distinct_hint=batch.distinct_hint,  # keys pass through
                 ),
             )
             return
@@ -665,6 +666,7 @@ class MapNode(Node):
                 self._dp.NativeBatch(
                     batch.tab, nb.key_lo, nb.key_hi,
                     np.ascontiguousarray(out_tok[ok]), nb.diff,
+                    distinct_hint=nb.distinct_hint,
                 ),
             )
         # BAD rows: exact per-row Python semantics

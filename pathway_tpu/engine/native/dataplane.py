@@ -323,9 +323,16 @@ def default_table() -> InternTable:
 
 
 class NativeBatch:
-    """A token-resident z-set batch: (key, token, diff) flat arrays."""
+    """A token-resident z-set batch: (key, token, diff) flat arrays.
 
-    __slots__ = ("tab", "key_lo", "key_hi", "token", "diff")
+    `distinct_hint`: the PRODUCER vouches that all diffs are +1 with
+    pairwise-distinct keys (fresh sequential-key ingest). Propagated by
+    select (a subset of distinct keys stays distinct) and by concat when
+    every input carries it (sequential key ranges never collide), letting
+    `is_distinct_insert` skip its O(n) hash-set scan on the ingest path.
+    """
+
+    __slots__ = ("tab", "key_lo", "key_hi", "token", "diff", "distinct_hint")
 
     def __init__(
         self,
@@ -334,12 +341,14 @@ class NativeBatch:
         key_hi: np.ndarray,
         token: np.ndarray,
         diff: np.ndarray,
+        distinct_hint: bool = False,
     ):
         self.tab = tab
         self.key_lo = key_lo
         self.key_hi = key_hi
         self.token = token
         self.diff = diff
+        self.distinct_hint = distinct_hint
 
     def __len__(self) -> int:
         return len(self.token)
@@ -362,12 +371,19 @@ class NativeBatch:
 
     def select(self, idx: np.ndarray) -> "NativeBatch":
         """Row subset/permutation by integer or boolean index array."""
+        # a PERMUTED batch keeps distinctness; only a boolean mask or a
+        # strictly-increasing index is guaranteed duplicate-free, so the
+        # hint survives boolean masks and is dropped for integer arrays
+        keep_hint = self.distinct_hint and (
+            getattr(idx, "dtype", None) is not None and idx.dtype == np.bool_
+        )
         return NativeBatch(
             self.tab,
             np.ascontiguousarray(self.key_lo[idx]),
             np.ascontiguousarray(self.key_hi[idx]),
             np.ascontiguousarray(self.token[idx]),
             np.ascontiguousarray(self.diff[idx]),
+            distinct_hint=keep_hint,
         )
 
     def with_diff(self, diff: np.ndarray) -> "NativeBatch":
@@ -390,11 +406,15 @@ class NativeBatch:
             np.concatenate([b.key_hi for b in batches]),
             np.concatenate([b.token for b in batches]),
             np.concatenate([b.diff for b in batches]),
+            # sequential-key ranges from one table never collide
+            distinct_hint=all(b.distinct_hint for b in batches),
         )
 
     def is_distinct_insert(self) -> bool:
         """True when all diffs are +1 with pairwise-distinct keys (already
         consolidated — the shape every fresh ingest produces)."""
+        if self.distinct_hint:
+            return True
         lib = _load()
         return bool(
             lib.dp_distinct_check(len(self), self.key_lo, self.key_hi, self.diff)

@@ -226,6 +226,8 @@ def _parse_body(info: dict, tab, body: bytes, seq_start: int):
                 np.ascontiguousarray(hi[ok]),
                 np.ascontiguousarray(tok[ok]),
                 np.ones(int(ok.sum()), np.int64),
+                # sequential keys are globally unique; pk keys can repeat
+                distinct_hint=not pk_idx,
             )
         return batch, []
     entries = []
