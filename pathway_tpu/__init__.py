@@ -112,6 +112,71 @@ class __module_shortcuts__:
 # reference exposes reducers also at pw.reducers; xpacks lazily
 from pathway_tpu import xpacks  # noqa: E402
 
+# ---- reference top-level surface parity ----
+from pathway_tpu.internals.table_slice import TableSlice  # noqa: E402
+from pathway_tpu.internals.wrappers import PyObjectWrapper, wrap_py_object  # noqa: E402
+from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
+from pathway_tpu.internals.joins import JoinResult  # noqa: E402
+from pathway_tpu.internals.groupbys import GroupedTable  # noqa: E402
+from pathway_tpu.stdlib.temporal import (  # noqa: E402
+    AsofJoinResult,
+    IntervalJoinResult,
+    WindowJoinResult,
+)
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
+
+
+class PersistenceMode:
+    """Persistence-mode names (reference: engine PersistenceMode enum);
+    pass as `persistence_mode=` on pw.persistence.Config."""
+
+    BATCH = "BATCH"
+    PERSISTING = "PERSISTING"
+    SELECTIVE_PERSISTING = "SELECTIVE_PERSISTING"
+    UDF_CACHING = "UDF_CACHING"
+    OPERATOR_PERSISTING = "OPERATOR_PERSISTING"
+
+
+# legacy aliases the reference keeps exporting
+Joinable = Table
+TableLike = Table
+UDFSync = UDF
+UDFAsync = UDF
+
+
+def join(left_table: Table, other: Table, *on, **kwargs):  # noqa: A002
+    """Free-function form of Table.join (reference exports both)."""
+    return left_table.join(other, *on, **kwargs)
+
+
+def join_inner(left_table: Table, other: Table, *on, **kwargs):
+    return left_table.join_inner(other, *on, **kwargs)
+
+
+def join_left(left_table: Table, other: Table, *on, **kwargs):
+    return left_table.join_left(other, *on, **kwargs)
+
+
+def join_right(left_table: Table, other: Table, *on, **kwargs):
+    return left_table.join_right(other, *on, **kwargs)
+
+
+def join_outer(left_table: Table, other: Table, *on, **kwargs):
+    return left_table.join_outer(other, *on, **kwargs)
+
+
+def groupby(table: Table, *args, **kwargs):
+    return table.groupby(*args, **kwargs)
+
+
+# module aliases (reference: pw.csv is pw.io.csv, etc.)
+csv = io.csv
+jsonlines = io.jsonlines
+http = io.http
+kafka = io.kafka
+debezium = io.debezium
+elasticsearch = io.elasticsearch
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -131,4 +196,11 @@ __all__ = [
     "sql", "load_yaml", "BaseCustomAccumulator", "xpacks",
     "get_config", "PathwayConfig", "set_license_key", "set_monitoring_config",
     "global_error_log",
+    # reference top-level surface parity
+    "TableSlice", "PyObjectWrapper", "wrap_py_object", "MonitoringLevel",
+    "PersistenceMode", "JoinResult", "GroupedTable", "AsofJoinResult",
+    "IntervalJoinResult", "WindowJoinResult", "AsyncTransformer",
+    "Joinable", "TableLike", "UDFSync", "UDFAsync",
+    "join", "join_inner", "join_left", "join_right", "join_outer", "groupby",
+    "csv", "jsonlines", "http", "kafka", "debezium", "elasticsearch",
 ]

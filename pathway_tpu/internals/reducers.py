@@ -300,6 +300,31 @@ def avg(expr: Any) -> ReducerExpression:
     return ReducerExpression(AvgReducer(), expr)
 
 
+def int_sum(expr: Any) -> ReducerExpression:
+    """Deprecated alias of sum (reference: reducers.py:611)."""
+    import warnings
+
+    warnings.warn(
+        "reducers.int_sum is deprecated, use reducers.sum instead",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    return sum(expr)
+
+
+def npsum(expr: Any) -> ReducerExpression:
+    """Deprecated alias of sum for ndarray columns (reference:
+    reducers.py:547)."""
+    import warnings
+
+    warnings.warn(
+        "reducers.npsum is deprecated, use reducers.sum instead",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    return sum(expr)
+
+
 def min(expr: Any) -> ReducerExpression:  # noqa: A001
     return ReducerExpression(MinReducer(), expr)
 

@@ -116,6 +116,119 @@ class Table:
     def keys(self) -> list[str]:
         return self._column_names()
 
+    @property
+    def slice(self):
+        """A reorderable/renamable view of this table's columns
+        (reference: table.py:468 + table_slice.py).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... age | owner
+        ... 10  | Alice
+        ... ''')
+        >>> pw.debug.compute_and_print(
+        ...     t.select(*t.slice.without("age").with_suffix("_x")),
+        ...     include_id=False)
+        owner_x
+        Alice
+        """
+        from pathway_tpu.internals.table_slice import TableSlice
+
+        return TableSlice(
+            {n: ColumnReference(self, n) for n in self._column_names()}, self
+        )
+
+    @staticmethod
+    def from_columns(*args: ColumnReference, **kwargs: ColumnReference) -> "Table":
+        """Build a table from same-universe columns, positionally (keeping
+        their names) or renamed via kwargs (reference: table.py:265)."""
+        cols: dict[str, ColumnReference] = {}
+        for a in args:
+            if not isinstance(a, ColumnReference):
+                raise TypeError("from_columns takes column references")
+            cols[a.name] = a
+        for name, a in kwargs.items():
+            if not isinstance(a, ColumnReference):
+                raise TypeError("from_columns takes column references")
+            cols[name] = a
+        if not cols:
+            raise ValueError("from_columns needs at least one column")
+        first = next(iter(cols.values()))
+        base = first.table
+        if not isinstance(base, Table):
+            raise TypeError("from_columns needs concrete table columns")
+        return base.select(**cols)
+
+    # ------------------------------------------------ type-level updates
+
+    def update_types(self, **kwargs: Any) -> "Table":
+        """Overrides column dtypes in the schema; no runtime effect
+        (reference: table.py:1980). Other column properties (primary key,
+        defaults, append-only) are preserved."""
+        for name in kwargs:
+            if name not in self._schema.__columns__:
+                raise ValueError(
+                    "Table.update_types() argument name has to be an "
+                    f"existing table column name; got {name!r}"
+                )
+        schema = self._schema.with_types(**kwargs)
+        return Table(
+            OpSpec("rowwise", [self], exprs={
+                n: ColumnReference(self, n) for n in schema.__columns__
+            }),
+            schema,
+            self._universe,
+        )
+
+    def update_id_type(self, id_type: Any, *, id_append_only: bool | None = None) -> "Table":
+        """Declares the id column's pointer type; observable through
+        eval_type(table.id). `id_append_only` is accepted for signature
+        parity and recorded, but append-only ids carry no engine-level
+        meaning here."""
+        out = self.copy()
+        out._id_dtype = dt.wrap(id_type)
+        out._id_append_only = id_append_only
+        return out
+
+    def cast_to_types(self, **kwargs: Any) -> "Table":
+        """Casts columns to the given types AT RUNTIME (reference:
+        table.py:2011)."""
+        from pathway_tpu.internals.common import cast
+
+        for name in kwargs:
+            if name not in self._schema.__columns__:
+                raise ValueError(
+                    "Table.cast_to_types() argument name has to be an "
+                    f"existing table column name; got {name!r}"
+                )
+        return self.with_columns(
+            **{k: cast(v, self[k]) for k, v in kwargs.items()}
+        )
+
+    def typehints(self) -> Mapping[str, Any]:
+        """Column name -> Python type hint (reference: table.py:2530)."""
+        return {
+            n: c.dtype.typehint() for n, c in self._schema.__columns__.items()
+        }
+
+    def eval_type(self, expression: Any) -> Any:
+        """The Python type hint an expression would have on this table."""
+        e = wrap_arg(expression)
+
+        def ref_dtype(ref: ColumnReference) -> dt.DType:
+            tab = ref.table
+            if isinstance(tab, _TableAsMarker):
+                tab = tab.table  # splat marker wraps a concrete table
+            elif isinstance(tab, ThisMarker):
+                tab = self
+            if isinstance(ref, IdReference) or ref.name == "id":
+                return getattr(tab, "_id_dtype", dt.ANY_POINTER)
+            return tab._dtype_of(ref.name)
+
+        return infer_dtype(e, ref_dtype).typehint()
+
     def __getattr__(self, name: str) -> ColumnReference:
         if name.startswith("__"):
             raise AttributeError(name)
@@ -156,9 +269,13 @@ class Table:
         self, args: tuple, kwargs: Mapping[str, Any], allow_id: bool = True
     ) -> dict[str, ColumnExpression]:
         """Expand *args / **kwargs of select into an ordered name->expr map."""
+        from pathway_tpu.internals.table_slice import TableSlice
+
         out: dict[str, ColumnExpression] = {}
         for arg in args:
-            if isinstance(arg, ThisSplat):
+            if isinstance(arg, TableSlice):
+                out.update(arg.items())  # slice names override ref names
+            elif isinstance(arg, ThisSplat):
                 target = arg.marker
                 table = target if isinstance(target, Table) else self
                 if isinstance(target, _TableAsMarker):
@@ -167,7 +284,8 @@ class Table:
                     if name not in arg.excluded:
                         out[name] = ColumnReference(table, name)
             elif isinstance(arg, ColumnReference):
-                out[arg.name] = arg
+                # _out_name: rename carried by a TableSlice entry
+                out[getattr(arg, "_out_name", arg.name)] = arg
             elif isinstance(arg, str):
                 out[arg] = ColumnReference(self, arg)
             else:

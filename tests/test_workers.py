@@ -255,8 +255,10 @@ def test_async_udf_memo_and_invariance():
         assert len(calls) == n_calls_1, "udf re-ran under sharding"
 
 
-def test_groupby_throughput_parallel_shards():
-    """Sharded native aggregation stays correct under a bigger stream."""
+def test_groupby_invariance_parallel_shards_large_stream():
+    """Sharded native aggregation stays correct under a bigger stream
+    (worker-count INVARIANCE at volume — engine throughput itself is
+    measured by bench.py's wordcount/join configs, not asserted here)."""
     import random
 
     rng = random.Random(7)
