@@ -210,6 +210,26 @@ def table_to_dicts(table: Table):
     return keys, columns
 
 
+def table_from_parquet(
+    path: str, *, id_from: list[str] | None = None,
+    unsafe_trusted_ids: bool = False,
+) -> Table:
+    """Read a parquet file into a static table (reference: debug
+    table_from_parquet; pandas/pyarrow-backed)."""
+    import pandas as pd
+
+    return table_from_pandas(
+        pd.read_parquet(path), id_from=id_from,
+        unsafe_trusted_ids=unsafe_trusted_ids,
+    )
+
+
+def table_to_parquet(table: Table, filename: str) -> None:
+    """Compute a table and write it to parquet (reference: debug
+    table_to_parquet)."""
+    table_to_pandas(table, include_id=False).to_parquet(filename)
+
+
 def table_to_pandas(table: Table, include_id: bool = True):
     import pandas as pd
 

@@ -142,6 +142,120 @@ class SchemaMetaclass(type):
         columns = {n: c for n, c in cls.__columns__.items() if n not in drop}
         return schema_from_columns(columns, name=cls.__name__)
 
+    def get_dtype(cls, name: str) -> dt.DType:
+        """Dtype of one column (reference: schema.py get_dtype)."""
+        return cls.__columns__[name].dtype
+
+    def has_default_value(cls, name: str) -> bool:
+        return cls.__columns__[name].has_default_value
+
+    def column_properties(cls, name: str) -> Any:
+        """(dtype, append_only) of one column, reference-shaped."""
+        from collections import namedtuple
+
+        ColumnProperties = namedtuple("ColumnProperties", "dtype append_only")
+        c = cls.__columns__[name]
+        return ColumnProperties(dtype=c.dtype, append_only=c.append_only)
+
+    @property
+    def id_type(cls) -> Any:
+        """Python type hint of the id column."""
+        return getattr(cls, "__id_dtype__", dt.ANY_POINTER).typehint()
+
+    def with_id_type(cls, id_type: Any, *, append_only: bool | None = None) -> "SchemaMetaclass":
+        out = schema_from_columns(dict(cls.__columns__), name=cls.__name__)
+        out.__id_dtype__ = dt.wrap(id_type)
+        return out
+
+    def assert_matches_schema(
+        cls,
+        other: "SchemaMetaclass",
+        *,
+        allow_superset: bool = True,
+        ignore_primary_keys: bool = True,
+        allow_subtype: bool = True,
+    ) -> None:
+        """Raises AssertionError unless this schema's columns match
+        `other`'s (reference: schema.py:562). `allow_superset`: self may
+        have extra columns; `allow_subtype`: dtypes may narrow."""
+        mine = {n: c.dtype for n, c in cls.__columns__.items()}
+        theirs = {n: c.dtype for n, c in other.__columns__.items()}
+        missing = set(theirs) - set(mine)
+        assert not missing, f"columns missing from schema: {sorted(missing)}"
+        if not allow_superset:
+            extra = set(mine) - set(theirs)
+            assert not extra, f"unexpected extra columns: {sorted(extra)}"
+        for n, want in theirs.items():
+            got = mine[n]
+            # dtype-level narrowing: got is a subtype of want when their
+            # least common ancestor IS want (INT narrows FLOAT, T narrows
+            # Optional[T], anything narrows ANY)
+            ok = got == want
+            if not ok and allow_subtype:
+                try:
+                    ok = dt.types_lca(got, want) == want
+                except Exception:  # noqa: BLE001 — incomparable dtypes
+                    ok = False
+            assert ok, f"column {n!r}: dtype {got!r} does not match {want!r}"
+        if not ignore_primary_keys:
+            assert (cls.primary_key_columns() or []) == (
+                other.primary_key_columns() or []
+            ), "primary keys differ"
+
+    def generate_class(
+        cls, class_name: str | None = None, generate_imports: bool = False
+    ) -> str:
+        """Python source for an equivalent schema class (reference:
+        schema.py:459) — persists inferred schemas as code."""
+        name = class_name or (cls.__name__ if cls.__name__.isidentifier() else "MySchema")
+
+        modules: set[str] = set()
+
+        def hint_src(hint: Any) -> str:
+            # plain classes qualify by module (numpy.ndarray etc.);
+            # parameterized hints (Optional[int], list[str]) keep their
+            # repr, which names the typing module when it needs it
+            if isinstance(hint, type):
+                if hint.__module__ in ("builtins", None):
+                    return hint.__name__
+                modules.add(hint.__module__.split(".")[0])
+                return f"{hint.__module__}.{hint.__qualname__}"
+            r = repr(hint)
+            if r.startswith("typing."):
+                modules.add("typing")
+            return r
+
+        body = []
+        for n, c in cls.__columns__.items():
+            hint_s = hint_src(c.dtype.typehint())
+            opts = []
+            if c.primary_key:
+                opts.append("primary_key=True")
+            if c.has_default_value:
+                opts.append(f"default_value={c.default_value!r}")
+            if opts:
+                body.append(
+                    f"    {n}: {hint_s} = pw.column_definition({', '.join(opts)})"
+                )
+            else:
+                body.append(f"    {n}: {hint_s}")
+        if not body:
+            body = ["    pass"]
+        lines = []
+        if generate_imports:
+            lines.append("import pathway_tpu as pw")
+            lines.extend(f"import {m}" for m in sorted(modules))
+            lines.append("")
+        lines.append(f"class {name}(pw.Schema):")
+        lines.extend(body)
+        return "\n".join(lines) + "\n"
+
+    def generate_class_to_file(
+        cls, path: str, class_name: str | None = None, generate_imports: bool = True
+    ) -> None:
+        with open(path, "w") as f:
+            f.write(cls.generate_class(class_name, generate_imports))
+
     def update_properties(cls, **kwargs: Any) -> "SchemaMetaclass":
         return cls
 

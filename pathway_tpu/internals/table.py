@@ -159,6 +159,19 @@ class Table:
         base = first.table
         if not isinstance(base, Table):
             raise TypeError("from_columns needs concrete table columns")
+        solver = univ.get_solver()
+        for ref in cols.values():
+            tab = ref.table
+            if not isinstance(tab, Table):
+                raise TypeError("from_columns needs concrete table columns")
+            if tab._universe is not base._universe and not solver.are_equal(
+                tab._universe, base._universe
+            ):
+                raise ValueError(
+                    "from_columns requires all columns to share one "
+                    "universe (same row id set); got columns from "
+                    "unrelated tables"
+                )
         return base.select(**cols)
 
     # ------------------------------------------------ type-level updates

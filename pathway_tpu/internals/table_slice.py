@@ -107,5 +107,14 @@ class TableSlice:
     def slice(self) -> "TableSlice":
         return self
 
-    def ix_ref(self, *args: Any, **kwargs: Any):
-        return self._table.ix_ref(*args, **kwargs).slice[list(self.keys())]
+    def ix_ref(self, *args: Any, **kwargs: Any) -> "TableSlice":
+        # look up through ORIGINAL column names; keep this slice's
+        # (possibly renamed) output names
+        target = self._table.ix_ref(*args, **kwargs)
+        return TableSlice(
+            {
+                name: ColumnReference(target, ref.name)
+                for name, ref in self._mapping.items()
+            },
+            target,
+        )

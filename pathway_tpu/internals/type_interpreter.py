@@ -27,7 +27,12 @@ def infer_dtype(
         if isinstance(e, ex.ColumnConstExpression):
             return dt.dtype_of_value(e._value)
         if isinstance(e, ex.IdReference):
-            return dt.ANY_POINTER
+            # resolvers may declare a specific id pointer type
+            # (Table.update_id_type); default to the generic pointer
+            try:
+                return ref_dtype(e)
+            except Exception:  # noqa: BLE001
+                return dt.ANY_POINTER
         if isinstance(e, ex.ColumnReference):
             try:
                 return ref_dtype(e)

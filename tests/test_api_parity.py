@@ -77,10 +77,65 @@ def test_update_types_preserves_primary_key():
 
 def test_update_id_type_observable():
     t = _t()
-    generic = t.eval_type(t.id)
-    t2 = t.update_id_type(pw.Pointer)
-    assert t2.eval_type(t2.id) is not None
-    _ = generic
+    t2 = t.update_id_type(int)
+    assert t2.eval_type(t2.id) is int  # declared id type is visible
+    assert t.eval_type(t.id) is not int  # original keeps the generic type
+
+
+def test_from_columns_requires_shared_universe():
+    t1 = _t()
+    t2 = pw.debug.table_from_markdown(
+        """
+        city
+        Paris
+        """
+    )
+    with pytest.raises(ValueError, match="universe"):
+        pw.Table.from_columns(t1.owner, t2.city)
+
+
+def test_assert_matches_schema_subtype():
+    S = pw.schema_from_types(v=int)
+    S.assert_matches_schema(pw.schema_from_types(v=float))  # INT narrows FLOAT
+    with pytest.raises(AssertionError):
+        S.assert_matches_schema(
+            pw.schema_from_types(v=float), allow_subtype=False
+        )
+    with pytest.raises(AssertionError):
+        pw.schema_from_types(v=str).assert_matches_schema(
+            pw.schema_from_types(v=float)
+        )
+
+
+def test_generate_class_parameterized_hints(tmp_path):
+    import numpy as np
+
+    S = pw.schema_from_types(a=(int | None), arr=np.ndarray)
+    src = S.generate_class(class_name="Gen2", generate_imports=True)
+    ns: dict = {}
+    exec(src, ns)  # noqa: S102 — generated source must be importable
+    assert ns["Gen2"].column_names() == ["a", "arr"]
+
+
+def test_slice_ix_ref_keeps_renames():
+    best = pw.debug.table_from_markdown(
+        """
+        owner | age
+        Alice | 10
+        """
+    ).with_id_from(pw.this.owner)
+    queries = pw.debug.table_from_markdown(
+        """
+        who
+        Alice
+        """
+    )
+    s = best.slice.rename({"age": "years"}).ix_ref(
+        queries.who, context=queries
+    )
+    assert list(s.keys()) == ["owner", "years"]
+    res = queries.select(*s[["years"]])
+    assert _vals(res) == [(10,)]
 
 
 def test_from_columns_rejects_non_refs():
@@ -150,6 +205,43 @@ def test_namespaces_and_aliases():
         pw.IntervalJoinResult, pw.WindowJoinResult, pw.TableSlice,
     ):
         assert isinstance(cls, type)
+
+
+def test_schema_surface():
+    class S(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int = pw.column_definition(default_value=7)
+        w: float
+
+    assert S.get_dtype("v").typehint() is int
+    assert S.has_default_value("v") and not S.has_default_value("w")
+    cp = S.column_properties("k")
+    assert cp.dtype.typehint() is str and cp.append_only is False
+    assert S.id_type is not None
+    src = S.generate_class(class_name="Gen", generate_imports=True)
+    assert "class Gen(pw.Schema):" in src
+    assert "primary_key=True" in src and "default_value=7" in src
+    # the generated class round-trips through exec
+    ns: dict = {}
+    exec(src, ns)  # noqa: S102
+    assert ns["Gen"].column_names() == ["k", "v", "w"]
+    # matching
+    S.assert_matches_schema(pw.schema_from_types(k=str, v=int))
+    with pytest.raises(AssertionError):
+        S.assert_matches_schema(pw.schema_from_types(missing=int))
+    with pytest.raises(AssertionError):
+        S.assert_matches_schema(
+            pw.schema_from_types(k=str), allow_superset=False
+        )
+
+
+def test_parquet_roundtrip(tmp_path):
+    t = _t()
+    p = str(tmp_path / "t.parquet")
+    pw.debug.table_to_parquet(t, p)
+    t2 = pw.debug.table_from_parquet(p)
+    assert sorted(t2.column_names()) == ["age", "owner", "pet"]
+    assert sorted(r[0] for r in _vals(t2.select(t2.age))) == [9, 10]
 
 
 def test_deprecated_reducer_aliases():
