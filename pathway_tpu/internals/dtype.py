@@ -407,9 +407,14 @@ def wrap(input_type: Any) -> DType:
         if issubclass(input_type, Schema):
             return Pointer(input_type)
 
+    import types as _types
+
     origin = typing.get_origin(input_type)
     args = typing.get_args(input_type)
-    if origin is typing.Union:
+    # PEP 604 unions (`str | None`) have origin types.UnionType, not
+    # typing.Union — both must wrap to Optional/union dtypes, or every
+    # modern-syntax schema silently degrades to ANY
+    if origin is typing.Union or origin is _types.UnionType:
         non_none = [a for a in args if a is not type(None)]
         if len(non_none) == len(args):
             return ANY

@@ -743,6 +743,14 @@ class Table:
         ref = to_flatten[0]
         if isinstance(ref.table, ThisMarker):
             ref = ColumnReference(self, ref.name)
+        if origin_id is not None:
+            # append the source row's id as a column, then flatten the
+            # widened table (each flattened row carries its origin)
+            widened = self.select(
+                *[ColumnReference(self, n) for n in self._column_names()],
+                **{origin_id: IdReference(self)},
+            )
+            return widened.flatten(widened[ref.name])
         inner = self._dtype_of(ref.name)
         if isinstance(inner, dt.List):
             flat_dt: dt.DType = inner.wrapped

@@ -1,0 +1,207 @@
+"""stdlib.utils.col (whole-table applies, json unpacking, majority,
+flatten-with-origin) and stdlib.viz (notebook views). Reference:
+stdlib/utils/col.py, stdlib/viz/."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+import pathway_tpu as pw
+
+sys.path.insert(0, str(Path(__file__).parent))
+from utils import run_capture  # noqa: E402
+
+
+def _vals(table):
+    cap = run_capture(table)
+    return sorted(tuple(r) for r in cap.state.rows.values())
+
+
+def _nums():
+    return pw.debug.table_from_markdown(
+        """
+        colA | colB
+        1    | 10
+        2    | 20
+        3    | 30
+        """
+    )
+
+
+def test_apply_all_rows():
+    t = _nums()
+
+    def add_total_sum(col1, col2):
+        s = sum(col1) + sum(col2)
+        return [x + s for x in col1]
+
+    res = pw.utils.col.apply_all_rows(
+        t.colA, t.colB, fun=add_total_sum, result_col_name="res"
+    )
+    assert _vals(res) == [(67,), (68,), (69,)]
+    # output keeps the ORIGINAL row ids (reference contract)
+    joined = t.join(res, t.id == res.id).select(t.colA, res.res)
+    assert _vals(joined) == [(1, 67), (2, 68), (3, 69)]
+
+
+def test_multiapply_all_rows():
+    t = _nums()
+
+    def add2(col1, col2):
+        s = sum(col1) + sum(col2)
+        return [x + s for x in col1], [x + s for x in col2]
+
+    res = pw.utils.col.multiapply_all_rows(
+        t.colA, t.colB, fun=add2, result_col_names=["r1", "r2"]
+    )
+    assert _vals(res) == [(67, 76), (68, 86), (69, 96)]
+
+
+def test_unpack_col_dict():
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(data=pw.Json),
+        rows=[
+            ({"field_a": 13, "field_b": "foo", "field_c": False},),
+            ({"field_a": 17, "field_c": True, "field_d": 3.4},),
+        ],
+    )
+
+    class DS(pw.Schema):
+        field_a: int
+        field_b: str | None
+        field_c: bool
+        field_d: float | None
+
+    res = pw.utils.col.unpack_col_dict(t.data, schema=DS)
+    assert res.column_names() == ["field_a", "field_b", "field_c", "field_d"]
+    assert _vals(res) == [(13, "foo", False, None), (17, None, True, 3.4)]
+
+
+def test_groupby_reduce_majority():
+    g = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | x
+        a | x
+        a | y
+        b | z
+        """
+    )
+    res = pw.utils.col.groupby_reduce_majority(g.g, g.v)
+    assert _vals(res) == [("a", "x"), ("b", "z")]
+
+
+def test_flatten_column_keeps_origin():
+    fl = pw.debug.table_from_rows(
+        pw.schema_from_types(items=tuple), [((1, 2),), ((3,),)]
+    )
+    flat = pw.utils.col.flatten_column(fl.items)
+    assert flat.column_names() == ["items", "origin_id"]
+    cap = run_capture(flat)
+    items = sorted(r[0] for r in cap.state.rows.values())
+    assert items == [1, 2, 3]
+    origins = {r[1] for r in cap.state.rows.values()}
+    assert len(origins) == 2  # two source rows
+
+
+def test_unpack_col():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(pair=tuple), [((1, "a"),), ((2, "b"),)]
+    )
+    res = pw.utils.col.unpack_col(t.pair, "num", "tag")
+    assert res.column_names() == ["num", "tag"]
+    assert _vals(res) == [(1, "a"), (2, "b")]
+
+
+# ------------------------------------------------------------------- viz
+
+
+def test_show_static_html():
+    t = _nums()
+    view = t.show()
+    h = view._repr_html_()
+    assert "<table>" in h and "colA" in h and "30" in h
+    assert "TableView(3 rows" in repr(view)
+    # pw.Table grows a notebook repr
+    assert "<table>" in t._repr_html_()
+
+
+def test_show_live_view():
+    import time
+
+    t = pw.demo.range_stream(nb_rows=5, input_rate=200)
+    view = t.show(snapshot=False)
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if "live view" in view._repr_html_() and view._snapshot()[1]:
+                break
+            time.sleep(0.1)
+        assert view._snapshot()[1], "live view never saw data"
+    finally:
+        view.stop()
+
+
+def test_plot_requires_bokeh():
+    t = _nums()
+    with pytest.raises(ImportError, match="bokeh"):
+        t.plot(lambda src: src)
+
+
+def test_streaming_table_show_never_blocks():
+    """show()/._repr_html_ on a connector-backed table must not compute
+    synchronously (an unbounded stream would block forever)."""
+    t = pw.demo.range_stream(nb_rows=3, input_rate=100)
+    assert "streaming table" in t._repr_html_()  # placeholder, no run
+    view = t.show()  # snapshot=True STILL routes to the live view
+    try:
+        assert view._static is None
+    finally:
+        view.stop()
+
+
+def test_multiapply_rejects_misaligned_output():
+    t = _nums()
+    res = pw.utils.col.apply_all_rows(
+        t.colA, fun=lambda col: [1], result_col_name="r"
+    )
+    from pathway_tpu.internals.lowering import Session
+
+    before = len(pw.global_error_log().entries)
+    s = Session()
+    s.capture(res)
+    s.execute()
+    errs = pw.global_error_log().entries[before:]
+    assert any("one-to-one" in str(e) for e in errs), errs
+
+
+def test_unpack_col_dict_missing_required_field_poisons():
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(data=pw.Json),
+        rows=[({"b": "x"},)],
+    )
+    schema = pw.schema_from_types(a=int)
+    res = pw.utils.col.unpack_col_dict(t.data, schema=schema)
+    before = len(pw.global_error_log().entries)
+    cap = run_capture(res)
+    from pathway_tpu.internals.errors import ERROR
+
+    (row,) = cap.state.rows.values()
+    assert row[0] is ERROR
+    assert any(
+        "required field" in str(e)
+        for e in pw.global_error_log().entries[before:]
+    )
+
+
+def test_flatten_origin_id_on_table():
+    fl = pw.debug.table_from_rows(
+        pw.schema_from_types(items=tuple, tag=str), [((1, 2), "t1")]
+    )
+    flat = fl.flatten(fl.items, origin_id="src")
+    assert sorted(flat.column_names()) == ["items", "src", "tag"]
+    cap = run_capture(flat)
+    rows = list(cap.state.rows.values())
+    assert sorted(r[0] for r in rows) == [1, 2]
+    assert all(r[2] is not None for r in rows)
