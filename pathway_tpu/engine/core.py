@@ -775,6 +775,176 @@ def _emit_merged(node: Node, time: int, batches: list, entries: list[Entry]) -> 
         node.emit(time, consolidate(entries))
 
 
+# ---------------------------------------------- token-plane stateful tail
+#
+# The stateful operator tail (set ops, update_rows/cells, ix, dedup,
+# buffer/forget/freeze, gradual_broadcast, flatten) runs token-resident:
+# state lives in int-keyed dicts {key128 -> intern token}, waves stay as
+# flat (kv, tok, diff) triples, and output re-emits as NativeBatch —
+# matching the reference's typed-record operators
+# (/root/reference/src/engine/dataflow.rs:1555-2224,
+# src/engine/dataflow/operators/time_column.rs:380) instead of decoding
+# every row to Python objects per wave.
+#
+# Plane discipline: a node starts in token mode when the plane is up and
+# DEMOTES (one-time state decode, permanent) when a wave carries a row
+# the plane can't represent (tuples/ndarrays/Json) — correctness never
+# depends on the gate. Operator snapshots always export the OBJECT form,
+# so persistence, rescale, and cross-plane restore compose unchanged.
+# One visible difference from the object plane: token equality is
+# byte-equality, so an update changing 1 to 1.0 re-emits where the
+# object plane (Python ==) suppressed it — this matches the reference's
+# typed Value semantics (Value::Int(1) != Value::Float(1.0)).
+
+_MASK64 = (1 << 64) - 1
+
+
+def _tok_plane():
+    """The dataplane module when the token plane is on, else None."""
+    if _nb_type() is None:
+        return None
+    from pathway_tpu.engine.native import dataplane
+
+    return dataplane
+
+
+def _wave_triples(tab, batches, entries) -> list | None:
+    """One wave as [(kv, tok, diff)] triples; None when an object entry
+    is not plane-representable (caller demotes)."""
+    out: list = []
+    for b in batches:
+        out.extend(
+            zip(
+                ((h << 64) | l for h, l in zip(b.key_hi.tolist(), b.key_lo.tolist())),
+                b.token.tolist(),
+                b.diff.tolist(),
+            )
+        )
+    for key, row, d in entries:
+        t = tab.intern_row(row)
+        if t is None:
+            return None
+        out.append((key.value, t, d))
+    return out
+
+
+def _flatten_segments(batches, entries) -> list[Entry]:
+    """Object-plane form of a drained wave (demotion fallback)."""
+    flat: list[Entry] = []
+    for b in batches:
+        flat.extend(b.materialize())
+    flat.extend(entries)
+    return flat
+
+
+class _TokTailNode(Node):
+    """Shared machinery for token-resident stateful-tail nodes."""
+
+    def __init__(self, graph: Graph, inputs: Sequence[Node]):
+        super().__init__(graph, inputs)
+        dp = _tok_plane()
+        self._dp = dp
+        self._tok = dp is not None
+        if self._tok:
+            self._tab = dp.default_table()
+
+    # Subclasses define: _demoted_state() -> dict of object-form state
+    # attrs, and _encode_state(st) -> bool (install object-form state into
+    # token form; False = not representable, stay demoted).
+
+    def _demote(self) -> None:
+        """One-way switch to the object plane: decode token state."""
+        if not self._tok:
+            return
+        for attr, value in self._demoted_state().items():
+            setattr(self, attr, value)
+        self._tok = False
+
+    def _drain_waves(self, time: int):
+        """Drain all inputs. Returns (triples_per_input | None,
+        entries_per_input). triples None => demoted mid-drain; the object
+        entries (2nd element) are the full wave either way."""
+        raws = [self.take_segments(i) for i in range(len(self.inputs))]
+        if not self._tok:
+            return None, [_flatten_segments(b, e) for b, e in raws]
+        waves = []
+        for b, e in raws:
+            w = _wave_triples(self._tab, b, e)
+            if w is None:
+                self._demote()
+                return None, [_flatten_segments(bb, ee) for bb, ee in raws]
+            waves.append(w)
+        return waves, None
+
+    def _emit_tok(self, time: int, kvs: list, toks: list, diffs: list,
+                  consolidate_out: bool = False) -> None:
+        n = len(kvs)
+        if n == 0:
+            return
+        dp = self._dp
+        nb = dp.NativeBatch(
+            self._tab,
+            np.fromiter((kv & _MASK64 for kv in kvs), np.uint64, n),
+            np.fromiter((kv >> 64 for kv in kvs), np.uint64, n),
+            np.fromiter(toks, np.uint64, n),
+            np.fromiter(diffs, np.int64, n),
+        )
+        if consolidate_out:
+            nb = nb.consolidate()
+            if not len(nb):
+                return
+        self.emit(time, nb)
+
+    def _requeue(self, raws: list) -> None:
+        """Put drained segments back so the object path re-drains them."""
+        for i, (batches, entries) in enumerate(raws):
+            for b in batches:
+                self.accept(i, b)
+            if entries:
+                self.accept(i, entries)
+            self.rows_in -= len(entries) + sum(len(b) for b in batches)
+
+    # ------------------------------------------------ snapshot (object form)
+
+    def persist_state(self) -> dict | None:
+        if not self._persist_attrs:
+            return None
+        if not self._tok:
+            return super().persist_state()
+        return self._demoted_state()
+
+    def restore_state(self, state: dict) -> None:
+        if self._tok and not self._encode_state(state):
+            self._demote()
+            super().restore_state(state)
+            return
+        if not self._tok:
+            super().restore_state(state)
+
+    # Object-form decode helpers.
+
+    def _rowdict_obj(self, d: dict) -> dict:
+        tab = self._tab
+        return {Key(kv): tab.row(t) for kv, t in d.items()}
+
+    def _rowdict_tok(self, d: dict) -> dict | None:
+        tab = self._tab
+        out = {}
+        items = d.rows.items() if isinstance(d, KeyedState) else d.items()
+        for k, row in items:
+            t = tab.intern_row(row)
+            if t is None:
+                return None
+            out[k.value] = t
+        return out
+
+
+def _keyed_state_of(rows: dict) -> KeyedState:
+    st = KeyedState()
+    st.rows = rows
+    return st
+
+
 class ReindexNode(Node):
     """Assign new keys via fn(key, row) -> new_key (reindex / with_id_from).
 
@@ -853,14 +1023,41 @@ class ConcatNode(Node):
 
 
 class FlattenNode(Node):
+    """Expand a sequence column into child rows, key = hash(parent, i).
+
+    Stateless, so no plane demotion: native batches expand in C
+    (dp_flatten, str/bytes columns — the only sequence types the plane
+    represents); rows the kernel can't judge take the object path."""
+
     def __init__(self, graph: Graph, inp: Node, flatten_idx: int):
         super().__init__(graph, [inp])
         self.flatten_idx = flatten_idx
 
     def finish_time(self, time: int) -> None:
-        entries = self.take_input()
-        if not entries:
+        if _nb_type() is not None:
+            from pathway_tpu.engine.native import dataplane as dp
+
+            batches, entries = self.take_segments()
+            out_batches = []
+            obj: list[Entry] = list(entries)
+            for b in batches:
+                res = dp.flatten_batch(b.tab, b, self.flatten_idx)
+                if res is None:
+                    obj.extend(b.materialize())
+                    continue
+                child, fb = res
+                if len(child):
+                    out_batches.append(child)
+                if fb.any():
+                    obj.extend(b.select(fb).materialize())
+            out_obj = self._flatten_entries(obj) if obj else []
+            _emit_merged(self, time, out_batches, out_obj)
             return
+        entries = self.take_input()
+        if entries:
+            self.emit(time, consolidate(self._flatten_entries(entries)))
+
+    def _flatten_entries(self, entries: list[Entry]) -> list[Entry]:
         out: list[Entry] = []
         for key, row, diff in entries:
             seq = row[self.flatten_idx]
@@ -881,14 +1078,41 @@ class FlattenNode(Node):
                 new_row = row[: self.flatten_idx] + (item,) + row[self.flatten_idx + 1 :]
                 nk = Key(hash_values(key, i))
                 out.append((nk, new_row, diff))
-        self.emit(time, consolidate(out))
+        return out
 
 
-class SetOpNode(Node):
+def _tok_update_keyed(state: dict, wave: list) -> None:
+    """KeyedState.update, token form: +1 sets, -1 deletes when the stored
+    token matches (byte-equality stands in for rows_equal)."""
+    for kv, tok, d in wave:
+        if d > 0:
+            state[kv] = tok
+        elif d < 0 and state.get(kv) == tok:
+            del state[kv]
+
+
+def _tok_delta_emit(emitted: dict, kvs, toks, diffs, kv: int, new) -> None:
+    old = emitted.get(kv)
+    if old is not None and old != new:
+        kvs.append(kv)
+        toks.append(old)
+        diffs.append(-1)
+        del emitted[kv]
+    if new is not None and old != new:
+        kvs.append(kv)
+        toks.append(new)
+        diffs.append(1)
+        emitted[kv] = new
+
+
+class SetOpNode(_TokTailNode):
     """intersect / difference / restrict on key sets.
 
     Output rows come from input 0; inputs 1..n contribute key presence.
     mode: 'intersect' | 'difference' | 'restrict'
+    Token mode: pure key-level — state is {key128 -> token} / count dicts,
+    no row ever decodes (reference: dataflow.rs:1671-1760 runs these on
+    arranged keys the same way).
     """
 
     _persist_attrs = ("main", "others", "emitted")
@@ -900,11 +1124,37 @@ class SetOpNode(Node):
     def __init__(self, graph: Graph, inputs: Sequence[Node], mode: str):
         super().__init__(graph, inputs)
         self.mode = mode
-        self.main = KeyedState()
-        self.others: list[dict[Key, int]] = [defaultdict(int) for _ in range(len(inputs) - 1)]
-        self.emitted: dict[Key, tuple] = {}
+        if self._tok:
+            self.main: Any = {}
+            self.others: list[dict] = [{} for _ in range(len(inputs) - 1)]
+        else:
+            self.main = KeyedState()
+            self.others = [defaultdict(int) for _ in range(len(inputs) - 1)]
+        self.emitted: dict = {}
 
-    def _present(self, key: Key) -> bool:
+    def _demoted_state(self) -> dict:
+        return {
+            "main": _keyed_state_of(self._rowdict_obj(self.main)),
+            "others": [
+                defaultdict(int, {Key(kv): c for kv, c in o.items()})
+                for o in self.others
+            ],
+            "emitted": self._rowdict_obj(self.emitted),
+        }
+
+    def _encode_state(self, st: dict) -> bool:
+        main = self._rowdict_tok(st["main"])
+        emitted = self._rowdict_tok(st["emitted"])
+        if main is None or emitted is None:
+            return False
+        self.main = main
+        self.emitted = emitted
+        self.others = [
+            {k.value: c for k, c in o.items()} for o in st["others"]
+        ]
+        return True
+
+    def _present(self, key) -> bool:
         if self.mode == "intersect" or self.mode == "restrict":
             return all(o.get(key, 0) > 0 for o in self.others)
         if self.mode == "difference":
@@ -912,44 +1162,105 @@ class SetOpNode(Node):
         raise AssertionError(self.mode)
 
     def finish_time(self, time: int) -> None:
-        main_batch = self.take_input(0)
-        affected: dict[Key, None] = {k: None for k, _, _ in main_batch}
+        waves, obj = self._drain_waves(time)
+        if waves is not None:
+            affected = dict.fromkeys(kv for kv, _t, _d in waves[0])
+            for i, w in enumerate(waves[1:]):
+                o = self.others[i]
+                for kv, _t, d in w:
+                    c = o.get(kv, 0) + d
+                    if c == 0:
+                        o.pop(kv, None)
+                    else:
+                        o[kv] = c
+                    affected[kv] = None
+            _tok_update_keyed(self.main, waves[0])
+            kvs: list = []
+            toks: list = []
+            diffs: list = []
+            for kv in affected:
+                tok = self.main.get(kv)
+                new = tok if tok is not None and self._present(kv) else None
+                _tok_delta_emit(self.emitted, kvs, toks, diffs, kv, new)
+            self._emit_tok(time, kvs, toks, diffs)
+            return
+        main_batch = obj[0]
+        affected_o: dict[Key, None] = {k: None for k, _, _ in main_batch}
         for i in range(1, len(self.inputs)):
-            for key, _row, diff in self.take_input(i):
+            for key, _row, diff in obj[i]:
                 self.others[i - 1][key] += diff
-                affected[key] = None
+                affected_o[key] = None
         self.main.update(main_batch)
         out: list[Entry] = []
-        for key in affected:
+        for key in affected_o:
             row = self.main.get(key)
             present = row is not None and self._present(key)
             delta_emit(self.emitted, out, key, row if present else None)
         self.emit(time, out)
 
 
-class UpdateRowsNode(Node):
-    """union with right-priority (reference: update_rows dataflow.rs)."""
+class UpdateRowsNode(_TokTailNode):
+    """union with right-priority (reference: update_rows dataflow.rs).
+    Token mode: key-level only; row tokens pass through undecoded."""
 
     _persist_attrs = ("left", "right", "emitted")
     _state_routing = {"left": "key", "right": "key", "emitted": "key"}
 
     def __init__(self, graph: Graph, left: Node, right: Node):
         super().__init__(graph, [left, right])
-        self.left = KeyedState()
-        self.right = KeyedState()
-        self.emitted: dict[Key, tuple] = {}
+        if self._tok:
+            self.left: Any = {}
+            self.right: Any = {}
+        else:
+            self.left = KeyedState()
+            self.right = KeyedState()
+        self.emitted: dict = {}
+
+    def _demoted_state(self) -> dict:
+        return {
+            "left": _keyed_state_of(self._rowdict_obj(self.left)),
+            "right": _keyed_state_of(self._rowdict_obj(self.right)),
+            "emitted": self._rowdict_obj(self.emitted),
+        }
+
+    def _encode_state(self, st: dict) -> bool:
+        left = self._rowdict_tok(st["left"])
+        right = self._rowdict_tok(st["right"])
+        emitted = self._rowdict_tok(st["emitted"])
+        if left is None or right is None or emitted is None:
+            return False
+        self.left, self.right, self.emitted = left, right, emitted
+        return True
 
     def finish_time(self, time: int) -> None:
-        lb = self.take_input(0)
-        rb = self.take_input(1)
+        waves, obj = self._drain_waves(time)
+        if waves is not None:
+            lw, rw = waves
+            if not lw and not rw:
+                return
+            affected = dict.fromkeys(kv for kv, _t, _d in lw)
+            affected.update(dict.fromkeys(kv for kv, _t, _d in rw))
+            _tok_update_keyed(self.left, lw)
+            _tok_update_keyed(self.right, rw)
+            kvs: list = []
+            toks: list = []
+            diffs: list = []
+            for kv in affected:
+                new = self.right.get(kv)
+                if new is None:
+                    new = self.left.get(kv)
+                _tok_delta_emit(self.emitted, kvs, toks, diffs, kv, new)
+            self._emit_tok(time, kvs, toks, diffs)
+            return
+        lb, rb = obj
         if not lb and not rb:
             return
-        affected = {k: None for k, _, _ in lb}
-        affected.update({k: None for k, _, _ in rb})
+        affected_o = {k: None for k, _, _ in lb}
+        affected_o.update({k: None for k, _, _ in rb})
         self.left.update(lb)
         self.right.update(rb)
         out: list[Entry] = []
-        for key in affected:
+        for key in affected_o:
             new = self.right.get(key)
             if new is None:
                 new = self.left.get(key)
@@ -957,8 +1268,10 @@ class UpdateRowsNode(Node):
         self.emit(time, out)
 
 
-class UpdateCellsNode(Node):
-    """Override selected columns where the right table has the key."""
+class UpdateCellsNode(_TokTailNode):
+    """Override selected columns where the right table has the key.
+    Token mode: merged rows splice in C (dp_splice_cols), batched per
+    wave over the affected keys."""
 
     _persist_attrs = ("left", "right", "emitted")
     _state_routing = {"left": "key", "right": "key", "emitted": "key"}
@@ -970,19 +1283,77 @@ class UpdateCellsNode(Node):
         # col_map[i] = index into right row overriding left col i, or None
         super().__init__(graph, [left, right])
         self.col_map = col_map
-        self.left = KeyedState()
-        self.right = KeyedState()
-        self.emitted: dict[Key, tuple] = {}
+        self._splice_specs = [
+            (0, i) if m is None else (1, m) for i, m in enumerate(col_map)
+        ]
+        if self._tok:
+            self.left: Any = {}
+            self.right: Any = {}
+        else:
+            self.left = KeyedState()
+            self.right = KeyedState()
+        self.emitted: dict = {}
+
+    _demoted_state = UpdateRowsNode._demoted_state
+    _encode_state = UpdateRowsNode._encode_state
 
     def finish_time(self, time: int) -> None:
-        lb = self.take_input(0)
-        rb = self.take_input(1)
+        waves, obj = self._drain_waves(time)
+        if waves is not None:
+            lw, rw = waves
+            if not lw and not rw:
+                return
+            affected = dict.fromkeys(kv for kv, _t, _d in lw)
+            affected.update(dict.fromkeys(kv for kv, _t, _d in rw))
+            _tok_update_keyed(self.left, lw)
+            _tok_update_keyed(self.right, rw)
+            # pass 1: plan — gone (0) / passthrough tok (1) / splice slot (2)
+            plan: list[tuple[int, int, int]] = []
+            sl: list[int] = []
+            sr: list[int] = []
+            for kv in affected:
+                ltok = self.left.get(kv)
+                if ltok is None:
+                    plan.append((kv, 0, 0))
+                    continue
+                rtok = self.right.get(kv)
+                if rtok is None:
+                    plan.append((kv, 1, ltok))
+                else:
+                    plan.append((kv, 2, len(sl)))
+                    sl.append(ltok)
+                    sr.append(rtok)
+            merged: list = []
+            if sl:
+                res = self._dp.splice_cols(
+                    self._tab,
+                    np.fromiter(sl, np.uint64, len(sl)),
+                    np.fromiter(sr, np.uint64, len(sr)),
+                    self._splice_specs,
+                )
+                if res is None:  # malformed token — cannot happen for
+                    self._demote()  # plane-built rows; object fallback
+                    self._emit_cells_object(time, [Key(kv) for kv in affected])
+                    return
+                merged = res.tolist()
+            kvs: list = []
+            toks: list = []
+            diffs: list = []
+            for kv, kind, v in plan:
+                new = None if kind == 0 else (v if kind == 1 else merged[v])
+                _tok_delta_emit(self.emitted, kvs, toks, diffs, kv, new)
+            self._emit_tok(time, kvs, toks, diffs)
+            return
+        lb, rb = obj
         if not lb and not rb:
             return
-        affected = {k: None for k, _, _ in lb}
-        affected.update({k: None for k, _, _ in rb})
+        affected_o = {k: None for k, _, _ in lb}
+        affected_o.update({k: None for k, _, _ in rb})
         self.left.update(lb)
         self.right.update(rb)
+        self._emit_cells_object(time, affected_o)
+
+    def _emit_cells_object(self, time: int, affected) -> None:
         out: list[Entry] = []
         for key in affected:
             lrow = self.left.get(key)
@@ -2010,9 +2381,25 @@ class GroupByNode(Node):
         self.emit(time, out)
 
 
-class DeduplicateNode(Node):
+def _canon_scalar(v: Any) -> Any:
+    """Shard-token canonicalization (bool -> int, integral float -> int)
+    matching workers._canon + dataplane canon_piece for scalars."""
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, float) and v.is_integer():
+        return int(v)
+    return v
+
+
+class DeduplicateNode(_TokTailNode):
     """Keep one accepted row per instance via acceptor(new, old) -> bool
-    (reference: deduplicate dataflow.rs:3101)."""
+    (reference: deduplicate dataflow.rs:3101).
+
+    Token mode (lowering-gated on plain instance/value columns): instance
+    grouping and output keys compute in C (dp_project_group / dp_rekey),
+    the value column bulk-decodes once per wave, and only the acceptor
+    itself runs per candidate row — accepted rows pass through as tokens.
+    """
 
     _persist_attrs = ("accepted", "ikeys")
     _state_routing = {"accepted": "token", "ikeys": "token"}
@@ -2025,18 +2412,169 @@ class DeduplicateNode(Node):
         value_fn: Callable[[Key, tuple], Any],
         acceptor: Callable[[Any, Any], bool],
         keep_key: bool = False,
+        native_cfg: dict | None = None,
     ):
         super().__init__(graph, [inp])
         self.instance_fn = instance_fn
         self.value_fn = value_fn
         self.acceptor = acceptor
-        self.accepted: dict[Any, tuple[Key, tuple]] = {}
-        self.ikeys: dict[Any, Key] = {}
+        # native_cfg: {"inst_cols": [i] | None, "value_col": j,
+        #              "value_kind": "num" | "str"}
+        self._cfg = native_cfg
+        self._tok = self._tok and native_cfg is not None
+        if self._tok:
+            # gtok -> (kv, row_tok, value, ikey_kv); const-instance gtok=0
+            self.accepted: Any = {}
+            self.ikeys: Any = {}  # unused in token mode (ikv in accepted)
+            self._const_ikv = (
+                key_for_values(0).value if not native_cfg["inst_cols"] else None
+            )
+        else:
+            self.accepted = {}
+            self.ikeys = {}
+
+    # ---------------------------------------------------------- snapshots
+
+    def _inst_value(self, gtok: int) -> Any:
+        if not self._cfg["inst_cols"]:
+            return 0
+        vals = self._dp.decode_row(self._tab.get_bytes(gtok))
+        return vals[0] if len(vals) == 1 else vals
+
+    def _demoted_state(self) -> dict:
+        tab = self._tab
+        accepted: dict = {}
+        ikeys: dict = {}
+        for gtok, (kv, tok, _val, ikv) in self.accepted.items():
+            inst = freeze_value(self._inst_value(gtok))
+            accepted[inst] = (Key(kv), tab.row(tok))
+            ikeys[inst] = Key(ikv)
+        return {"accepted": accepted, "ikeys": ikeys}
+
+    def _encode_state(self, st: dict) -> bool:
+        tab = self._tab
+        cfg = self._cfg
+        accepted: dict = {}
+        for inst, (key, row) in st["accepted"].items():
+            tok = tab.intern_row(row)
+            ikey = st["ikeys"].get(inst)
+            if tok is None or ikey is None:
+                return False
+            if not cfg["inst_cols"]:
+                gtok = 0
+            else:
+                vals = inst if isinstance(inst, tuple) else (inst,)
+                pieces = []
+                for v in vals:
+                    p = self._dp.encode_scalar(_canon_scalar(v))
+                    if p is None:
+                        return False
+                    pieces.append(p)
+                gtok = tab.intern(b"".join(pieces))
+            accepted[gtok] = (key.value, tok, row[cfg["value_col"]], ikey.value)
+        self.accepted = accepted
+        self.ikeys = {}
+        return True
+
+    # --------------------------------------------------------------- wave
+
+    def _decode_values(self, toks: np.ndarray):
+        """Value column as Python scalars, or None (demote)."""
+        cfg = self._cfg
+        if cfg["value_kind"] == "str":
+            cols = self._dp.decode_str_cols(self._tab, toks, [cfg["value_col"]])
+            return None if cols is None else cols[0]
+        dec = self._dp.decode_num_cols(self._tab, toks, [cfg["value_col"]])
+        if dec is None:
+            return None
+        vi, vf, tg = dec
+        tg0 = tg[0]
+        if ((tg0 != 0) & (tg0 != 1) & (tg0 != 3)).any():
+            return None
+        vi0 = vi[0].tolist()
+        vf0 = vf[0].tolist()
+        return [
+            vf0[i] if t == 1 else (bool(vi0[i]) if t == 3 else vi0[i])
+            for i, t in enumerate(tg0.tolist())
+        ]
+
+    def _finish_tok(self, time: int) -> bool:
+        raw = self.take_segments()
+        w = _wave_triples(self._tab, *raw)
+        if w is None:
+            self._requeue([raw])
+            self._demote()
+            return False
+        if not w:
+            return True
+        w.sort(key=lambda t: t[0])  # canonical within-wave order
+        ins = [(kv, tok) for kv, tok, d in w if d > 0]
+        if not ins:
+            return True
+        toks = np.fromiter((t for _kv, t in ins), np.uint64, len(ins))
+        cfg = self._cfg
+        vals = self._decode_values(toks)
+        rk = res = None
+        if vals is not None and cfg["inst_cols"]:
+            res = self._dp.project_group(self._tab, toks, cfg["inst_cols"])
+            rk = self._dp.rekey(self._tab, toks, cfg["inst_cols"])
+        if vals is None or (
+            cfg["inst_cols"]
+            and (res is None or rk is None or ((rk[0] == 0) & (rk[1] == 0)).any())
+        ):
+            # value/instance not expressible in the token plane (None or
+            # ERROR values, unexpected types): object plane from here on
+            tab = self._tab
+            entries = [(Key(kv), tab.row(tok), d) for kv, tok, d in w]
+            self._demote()
+            self._finish_object(time, entries)
+            return True
+        if cfg["inst_cols"]:
+            gts = res[0].tolist()
+            ilo = rk[0].tolist()
+            ihi = rk[1].tolist()
+        else:
+            gts = None
+        accepted = self.accepted
+        acceptor = self.acceptor
+        kvs: list = []
+        toks_o: list = []
+        diffs: list = []
+        for i, (kv, tok) in enumerate(ins):
+            g = gts[i] if gts is not None else 0
+            prev = accepted.get(g)
+            try:
+                ok = acceptor(vals[i], prev[2]) if prev is not None else True
+            except Exception as e:  # noqa: BLE001
+                self.log_error(f"deduplicate acceptor: {e}")
+                ok = False
+            if ok:
+                ikv = (
+                    ((ihi[i] << 64) | ilo[i])
+                    if gts is not None
+                    else self._const_ikv
+                )
+                if prev is not None:
+                    kvs.append(ikv)
+                    toks_o.append(prev[1])
+                    diffs.append(-1)
+                kvs.append(ikv)
+                toks_o.append(tok)
+                diffs.append(1)
+                accepted[g] = (kv, tok, vals[i], ikv)
+        self._emit_tok(time, kvs, toks_o, diffs, consolidate_out=True)
+        return True
 
     def finish_time(self, time: int) -> None:
+        if self._tok:
+            if self._finish_tok(time):
+                return
         entries = self.take_input()
         if not entries:
             return
+        self._finish_object(time, entries)
+
+    def _finish_object(self, time: int, entries: list[Entry]) -> None:
         # canonical within-wave order: batches arrive shard-concatenated
         # under multi-worker execution, so order-sensitive acceptance must
         # not depend on arrival order inside one timestamp (worker-count
@@ -2072,9 +2610,13 @@ class DeduplicateNode(Node):
         self.emit(time, consolidate(out))
 
 
-class IxNode(Node):
+class IxNode(_TokTailNode):
     """Pointer lookup: for each source row, fetch the target row at
-    pointer_fn(key, row) (reference: ix_table dataflow.rs:2133)."""
+    pointer_fn(key, row) (reference: ix_table dataflow.rs:2133).
+
+    Token mode (lowering-gated on a plain pointer column): pointers
+    extract in C (dp_decode_key_col) and the lookup is int-dict key
+    chasing — target row tokens pass through to the output undecoded."""
 
     _persist_attrs = ("source_by_ptr", "target_state", "emitted")
 
@@ -2111,17 +2653,152 @@ class IxNode(Node):
         optional: bool = False,
         strict: bool = True,
         target_width: int = 0,
+        ptr_col: int | None = None,
     ):
         super().__init__(graph, [source, target])
         self.pointer_fn = pointer_fn
         self.optional = optional
         self.strict = strict
         self.target_width = target_width
-        self.source_by_ptr = MultisetState()  # ptr -> {(skey, srow)}
-        self.target_state = KeyedState()
-        self.emitted: dict[Key, tuple] = {}
+        self.ptr_col = ptr_col
+        self._tok = self._tok and ptr_col is not None
+        if self._tok:
+            # ptrkv|None -> {(skv, stok): count}; {kv: tok}; {skv: tok}
+            self.source_by_ptr: Any = {}
+            self.target_state: Any = {}
+            self.emitted: Any = {}
+            self._pad_tok: int | None = None
+        else:
+            self.source_by_ptr = MultisetState()  # ptr -> {(skey, srow)}
+            self.target_state = KeyedState()
+            self.emitted = {}
+
+    def _pad(self) -> int:
+        if self._pad_tok is None:
+            self._pad_tok = self._tab.intern_row((None,) * self.target_width)
+        return self._pad_tok
+
+    def _demoted_state(self) -> dict:
+        tab = self._tab
+        ms = MultisetState()
+        for ptrkv, grp in self.source_by_ptr.items():
+            g: dict = {}
+            for (skv, stok), c in grp.items():
+                ptr = Key(ptrkv) if ptrkv is not None else None
+                payload = (Key(skv), tab.row(stok), ptr)
+                g[freeze_value(payload)] = (payload, c)
+            ms.groups[ptrkv] = g
+        return {
+            "source_by_ptr": ms,
+            "target_state": _keyed_state_of(self._rowdict_obj(self.target_state)),
+            "emitted": self._rowdict_obj(self.emitted),
+        }
+
+    def _encode_state(self, st: dict) -> bool:
+        tab = self._tab
+        sbp: dict = {}
+        for ptrkv, grp in st["source_by_ptr"].groups.items():
+            if not (ptrkv is None or isinstance(ptrkv, int)):
+                return False  # non-Key pointer: stay on the object plane
+            g: dict = {}
+            for (skey, srow, _ptr), c in grp.values():
+                stok = tab.intern_row(srow)
+                if stok is None:
+                    return False
+                g[(skey.value, stok)] = c
+            sbp[ptrkv] = g
+        target = self._rowdict_tok(st["target_state"])
+        emitted = self._rowdict_tok(st["emitted"])
+        if target is None or emitted is None:
+            return False
+        self.source_by_ptr, self.target_state, self.emitted = sbp, target, emitted
+        return True
+
+    def _finish_tok(self, time: int) -> bool:
+        """Token-plane wave; False => demoted, caller reruns object-side
+        (inputs are re-buffered before demotion consumes anything)."""
+        raws = [self.take_segments(0), self.take_segments(1)]
+        sw = _wave_triples(self._tab, *raws[0])
+        tw = _wave_triples(self._tab, *raws[1])
+        ptrs: Any = None
+        if sw:
+            toks = np.fromiter((t for _kv, t, _d in sw), np.uint64, len(sw))
+            ptrs = self._dp.decode_key_col(self._tab, toks, self.ptr_col)
+        if (
+            sw is None
+            or tw is None
+            or (sw and (ptrs is None or (ptrs[2] > 1).any()))
+        ):
+            # unrepresentable row or non-Key pointer value: object plane
+            self._requeue(raws)
+            self._demote()
+            return False
+        return self._apply_tok(time, sw, tw, ptrs)
+
+    def _apply_tok(self, time: int, sw, tw, ptrs) -> bool:
+        affected: dict = {}
+        if sw:
+            plo, phi, pst = ptrs
+            plo = plo.tolist()
+            phi = phi.tolist()
+            pst = pst.tolist()
+            for (kv, tok, d), lo, hi, st_ in zip(sw, plo, phi, pst):
+                ptrkv = None if st_ else (hi << 64) | lo
+                grp = self.source_by_ptr.get(ptrkv)
+                if grp is None:
+                    grp = self.source_by_ptr[ptrkv] = {}
+                ent = (kv, tok)
+                c = grp.get(ent, 0) + d
+                if c == 0:
+                    grp.pop(ent, None)
+                    if not grp:
+                        del self.source_by_ptr[ptrkv]
+                else:
+                    grp[ent] = c
+                affected[ptrkv] = None
+        for kv, _tok, _d in tw:
+            affected[kv] = None
+        _tok_update_keyed(self.target_state, tw)
+        kvs: list = []
+        toks_o: list = []
+        diffs: list = []
+        emitted = self.emitted
+        for ptrkv in affected:
+            grp = self.source_by_ptr.get(ptrkv)
+            if not grp:
+                continue
+            trow = self.target_state.get(ptrkv) if ptrkv is not None else None
+            if ptrkv is None and self.optional:
+                new0 = self._pad()
+            elif trow is None:
+                new0 = self._pad() if self.optional else None
+            else:
+                new0 = trow
+            for (skv, _stok), c in list(grp.items()):
+                new = new0
+                old = emitted.get(skv)
+                if old is not None and (new is None or old != new):
+                    kvs.append(skv)
+                    toks_o.append(old)
+                    diffs.append(-1)
+                    del emitted[skv]
+                    old = None
+                if new is not None and c > 0 and old != new:
+                    kvs.append(skv)
+                    toks_o.append(new)
+                    diffs.append(1)
+                    emitted[skv] = new
+                if c <= 0 and emitted.get(skv) is not None:
+                    kvs.append(skv)
+                    toks_o.append(emitted.pop(skv))
+                    diffs.append(-1)
+        self._emit_tok(time, kvs, toks_o, diffs)
+        return True
 
     def finish_time(self, time: int) -> None:
+        if self._tok:
+            if self._finish_tok(time):
+                return
         sb = self.take_input(0)
         tb = self.take_input(1)
         if not sb and not tb:
@@ -2163,9 +2840,8 @@ class IxNode(Node):
                 if new is not None and c > 0 and (old is None or not rows_equal(old, new)):
                     out.append((skey, new, 1))
                     self.emitted[skey] = new
-                if c <= 0 and old is not None:
-                    out.append((skey, old, -1))
-                    del self.emitted[skey]
+                if c <= 0 and skey in self.emitted:
+                    out.append((skey, self.emitted.pop(skey), -1))
         self.emit(time, out)
 
 
@@ -2387,7 +3063,77 @@ class SubscribeNode(Node):
             self.on_end_cb()
 
 
-class BufferNode(Node):
+class _TimeColNode(_TokTailNode):
+    """Shared token-plane machinery for the temporal trio (buffer/forget/
+    freeze — reference: operators/time_column.rs). Lowering passes numpy
+    plans for the threshold/current expressions; a wave bulk-decodes the
+    needed columns once, evaluates both plans vectorized, and the
+    watermark logic runs over (kv, tok, diff, thr, cur) without touching
+    Python rows."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        threshold_fn: Callable[[Key, tuple], Any],
+        current_fn: Callable[[Key, tuple], Any],
+        native_plans: tuple | None = None,
+    ):
+        super().__init__(graph, [inp])
+        self.threshold_fn = threshold_fn  # row's release threshold
+        self.current_fn = current_fn  # row's event-time contribution to "now"
+        self.now: Any = None
+        self._plans = native_plans
+        self._tok = self._tok and native_plans is not None
+        if self._tok:
+            self._needed_cols = sorted(
+                native_plans[0].needed_cols | native_plans[1].needed_cols
+            )
+
+    @staticmethod
+    def _plan_scalars(plan, decoded, n):
+        """Plan results as Python scalars, or None (demote)."""
+        vi, vf, tg = plan.eval_map(decoded, n)
+        tgl = tg.tolist()
+        vil = vi.tolist()
+        vfl = vf.tolist()
+        out = []
+        for i, t in enumerate(tgl):
+            if t == 0:
+                out.append(vil[i])
+            elif t == 1:
+                out.append(vfl[i])
+            else:  # None / bool / error / fallback: object semantics
+                return None
+        return out
+
+    def _tok_wave(self, time: int):
+        """Drain + decode one wave: [(kv, tok, d)], thr[], cur[] — or None
+        after demotion (object path re-drains; nothing consumed)."""
+        raw = self.take_segments()
+        w = _wave_triples(self._tab, *raw)
+        thr = cur = None
+        if w:
+            toks = np.fromiter((t for _kv, t, _d in w), np.uint64, len(w))
+            decoded = decode_cols_dict(self._dp, self._tab, toks, self._needed_cols)
+            if decoded is not None:
+                thr = self._plan_scalars(self._plans[0], decoded, len(w))
+                cur = self._plan_scalars(self._plans[1], decoded, len(w))
+        if w is None or (w and (thr is None or cur is None)):
+            self._requeue([raw])
+            self._demote()
+            return None
+        return w, thr or [], cur or []
+
+    def _demote(self) -> None:
+        if not self._tok:
+            return
+        for attr, value in self._demoted_state().items():
+            setattr(self, attr, value)
+        self._tok = False
+
+
+class BufferNode(_TimeColNode):
     """Postpone rows until the stream's max threshold passes their release
     time (reference: operators/time_column.rs postpone_core:380)."""
 
@@ -2400,17 +3146,82 @@ class BufferNode(Node):
         threshold_fn: Callable[[Key, tuple], Any],
         current_fn: Callable[[Key, tuple], Any],
         flush_on_end: bool = True,
+        native_plans: tuple | None = None,
     ):
-        super().__init__(graph, [inp])
-        self.threshold_fn = threshold_fn  # row's release threshold
-        self.current_fn = current_fn  # row's event-time contribution to "now"
-        self.now: Any = None
-        self.pending: dict[Key, tuple[tuple, int, Any]] = {}
+        super().__init__(graph, inp, threshold_fn, current_fn, native_plans)
+        # token mode: {kv -> (tok, diff, thr)}; object: {Key -> (row, diff, thr)}
+        self.pending: dict = {}
         self.released: set[int] = set()
         self.flush_on_end = flush_on_end
         self._virtual_end = False
 
+    def _demoted_state(self) -> dict:
+        tab = self._tab
+        return {
+            "now": self.now,
+            "pending": {
+                Key(kv): (tab.row(t), d, thr)
+                for kv, (t, d, thr) in self.pending.items()
+            },
+            "released": set(self.released),
+        }
+
+    def _encode_state(self, st: dict) -> bool:
+        tab = self._tab
+        pending = {}
+        for key, (row, d, thr) in st["pending"].items():
+            t = tab.intern_row(row)
+            if t is None:
+                return False
+            pending[key.value] = (t, d, thr)
+        self.now = st["now"]
+        self.pending = pending
+        self.released = set(st["released"])
+        return True
+
+    def _finish_tok(self, time: int) -> bool:
+        res = self._tok_wave(time)
+        if res is None:
+            return False
+        w, thr, cur = res
+        if not w:
+            return True
+        now = self.now
+        for c in cur:
+            if now is None or c > now:
+                now = c
+        self.now = now
+        released = self.released
+        pending = self.pending
+        kvs: list = []
+        toks: list = []
+        diffs: list = []
+        for (kv, tok, d), th in zip(w, thr):
+            if kv in released or (now is not None and th <= now):
+                released.add(kv)
+                kvs.append(kv)
+                toks.append(tok)
+                diffs.append(d)
+                pending.pop(kv, None)
+            elif d > 0:
+                pending[kv] = (tok, d, th)
+            else:
+                pending.pop(kv, None)
+        if now is not None and pending:
+            ready = [kv for kv, (_t, _d, th) in pending.items() if th <= now]
+            for kv in ready:
+                tok, d, _th = pending.pop(kv)
+                released.add(kv)
+                kvs.append(kv)
+                toks.append(tok)
+                diffs.append(d)
+        self._emit_tok(time, kvs, toks, diffs, consolidate_out=True)
+        return True
+
     def finish_time(self, time: int) -> None:
+        if self._tok:
+            if self._finish_tok(time):
+                return
         entries = self.take_input()
         if not entries:
             return
@@ -2444,15 +3255,24 @@ class BufferNode(Node):
         self.emit(time, consolidate(out))
 
     def on_end(self, time: int) -> None:
-        if self.flush_on_end and self.pending:
-            out = [(k, row, diff) for k, (row, diff, _t) in self.pending.items()]
+        if not (self.flush_on_end and self.pending):
+            return
+        if self._tok:
+            kvs = list(self.pending)
+            toks = [t for t, _d, _th in self.pending.values()]
+            diffs = [d for _t, d, _th in self.pending.values()]
             self.pending.clear()
-            for k, _r, _d in out:
-                self.released.add(k.value)
-            self.emit(time, consolidate(out))
+            self.released.update(kvs)
+            self._emit_tok(time, kvs, toks, diffs, consolidate_out=True)
+            return
+        out = [(k, row, diff) for k, (row, diff, _t) in self.pending.items()]
+        self.pending.clear()
+        for k, _r, _d in out:
+            self.released.add(k.value)
+        self.emit(time, consolidate(out))
 
 
-class ForgetNode(Node):
+class ForgetNode(_TimeColNode):
     """Retract rows older than the moving threshold; drop late arrivals
     (reference: time_column.rs forget:566 + ignore_late:677)."""
 
@@ -2465,14 +3285,74 @@ class ForgetNode(Node):
         threshold_fn: Callable[[Key, tuple], Any],
         current_fn: Callable[[Key, tuple], Any],
         mark_forgetting_records: bool = False,
+        native_plans: tuple | None = None,
     ):
-        super().__init__(graph, [inp])
-        self.threshold_fn = threshold_fn
-        self.current_fn = current_fn
-        self.now: Any = None
-        self.live: dict[Key, tuple[tuple, Any]] = {}
+        super().__init__(graph, inp, threshold_fn, current_fn, native_plans)
+        # token mode: {kv -> (tok, thr)}; object: {Key -> (row, thr)}
+        self.live: dict = {}
+
+    def _demoted_state(self) -> dict:
+        tab = self._tab
+        return {
+            "now": self.now,
+            "live": {
+                Key(kv): (tab.row(t), thr) for kv, (t, thr) in self.live.items()
+            },
+        }
+
+    def _encode_state(self, st: dict) -> bool:
+        tab = self._tab
+        live = {}
+        for key, (row, thr) in st["live"].items():
+            t = tab.intern_row(row)
+            if t is None:
+                return False
+            live[key.value] = (t, thr)
+        self.now = st["now"]
+        self.live = live
+        return True
+
+    def _finish_tok(self, time: int) -> bool:
+        res = self._tok_wave(time)
+        if res is None:
+            return False
+        w, thr, cur = res
+        if not w:
+            return True
+        now0 = self.now
+        live = self.live
+        kvs: list = []
+        toks: list = []
+        diffs: list = []
+        for (kv, tok, d), th in zip(w, thr):
+            if now0 is not None and th <= now0 and d > 0:
+                continue  # late row: ignore
+            kvs.append(kv)
+            toks.append(tok)
+            diffs.append(d)
+            if d > 0:
+                live[kv] = (tok, th)
+            else:
+                live.pop(kv, None)
+        now = now0
+        for c in cur:
+            if now is None or c > now:
+                now = c
+        self.now = now
+        if now is not None and live:
+            expired = [kv for kv, (_t, th) in live.items() if th <= now]
+            for kv in expired:
+                tok, _th = live.pop(kv)
+                kvs.append(kv)
+                toks.append(tok)
+                diffs.append(-1)
+        self._emit_tok(time, kvs, toks, diffs, consolidate_out=True)
+        return True
 
     def finish_time(self, time: int) -> None:
+        if self._tok:
+            if self._finish_tok(time):
+                return
         entries = self.take_input()
         if not entries:
             return
@@ -2505,7 +3385,7 @@ class ForgetNode(Node):
         self.emit(time, consolidate(out))
 
 
-class FreezeNode(Node):
+class FreezeNode(_TimeColNode):
     """Ignore updates/retractions to rows past the freeze threshold
     (reference: time_column.rs freeze via dataflow.rs:1555)."""
 
@@ -2517,13 +3397,45 @@ class FreezeNode(Node):
         inp: Node,
         threshold_fn: Callable[[Key, tuple], Any],
         current_fn: Callable[[Key, tuple], Any],
+        native_plans: tuple | None = None,
     ):
-        super().__init__(graph, [inp])
-        self.threshold_fn = threshold_fn
-        self.current_fn = current_fn
-        self.now: Any = None
+        super().__init__(graph, inp, threshold_fn, current_fn, native_plans)
+
+    def _demoted_state(self) -> dict:
+        return {"now": self.now}
+
+    def _encode_state(self, st: dict) -> bool:
+        self.now = st["now"]
+        return True
+
+    def _finish_tok(self, time: int) -> bool:
+        res = self._tok_wave(time)
+        if res is None:
+            return False
+        w, thr, cur = res
+        if not w:
+            return True
+        now0 = self.now
+        now = now0
+        kvs: list = []
+        toks: list = []
+        diffs: list = []
+        for (kv, tok, d), th, c in zip(w, thr, cur):
+            if now0 is not None and th <= now0:
+                continue  # frozen region: drop the change
+            kvs.append(kv)
+            toks.append(tok)
+            diffs.append(d)
+            if now is None or c > now:  # only accepted rows advance the clock
+                now = c
+        self.now = now
+        self._emit_tok(time, kvs, toks, diffs, consolidate_out=True)
+        return True
 
     def finish_time(self, time: int) -> None:
+        if self._tok:
+            if self._finish_tok(time):
+                return
         entries = self.take_input()
         if not entries:
             return
@@ -2543,9 +3455,13 @@ class FreezeNode(Node):
         self.emit(time, consolidate(out))
 
 
-class GradualBroadcastNode(Node):
+class GradualBroadcastNode(_TokTailNode):
     """Broadcast (lower, value, upper) from a small table onto every row of a
-    big table with hysteresis (reference: operators/gradual_broadcast.rs:65)."""
+    big table with hysteresis (reference: operators/gradual_broadcast.rs:65).
+
+    Token mode: the big side stays key-level ({kv -> tok}, rows never
+    decode); only the small hysteresis table (a handful of rows) takes
+    the object path for its lvu expressions."""
 
     _persist_attrs = ("current", "big_state", "emitted")
 
@@ -2559,14 +3475,115 @@ class GradualBroadcastNode(Node):
         super().__init__(graph, [big, small])
         self.lvu_fn = lvu_fn
         self.current: Any = None  # (lower, value, upper)
-        self.big_state = KeyedState()
-        self.emitted: dict[Key, Any] = {}
+        if self._tok:
+            self.big_state: Any = {}
+            self.emitted: Any = {}  # kv -> broadcast value
+        else:
+            self.big_state = KeyedState()
+            self.emitted = {}
+
+    def _demoted_state(self) -> dict:
+        return {
+            "current": self.current,
+            "big_state": _keyed_state_of(self._rowdict_obj(self.big_state)),
+            "emitted": {Key(kv): v for kv, v in self.emitted.items()},
+        }
+
+    def _encode_state(self, st: dict) -> bool:
+        big = self._rowdict_tok(st["big_state"])
+        if big is None:
+            return False
+        self.current = st["current"]
+        self.big_state = big
+        self.emitted = {k.value: v for k, v in st["emitted"].items()}
+        return True
+
+    def _finish_tok(self, time: int) -> bool:
+        raw_b = self.take_segments(0)
+        raw_s = self.take_segments(1)
+        bw = _wave_triples(self._tab, *raw_b)
+        if bw is None:
+            self._requeue([raw_b, raw_s])
+            self._demote()
+            return False
+        sb = _flatten_segments(*raw_s)
+        if not bw and not sb:
+            return True
+        new_value = self.current[1] if self.current else None
+        sb = sorted(sb, key=lambda e: e[0].value)
+        for key, row, diff in sb:
+            if diff > 0:
+                lower, value, upper = self.lvu_fn(key, row)
+                if (
+                    self.current is None
+                    or value < self.current[0]
+                    or value > self.current[2]
+                ):
+                    self.current = (lower, value, upper)
+                    new_value = value
+        _tok_update_keyed(self.big_state, bw)
+        big = self.big_state
+        emitted = self.emitted
+        changed_all = new_value is not None and (
+            not emitted or any(v != new_value for v in emitted.values())
+        )
+        val_tok = None
+        if new_value is not None:
+            val_tok = self._tab.intern_row((new_value,))
+            if val_tok is None:  # non-scalar broadcast value
+                self._demote()
+                bb = [(Key(kv), self._tab.row(t), d) for kv, t, d in bw]
+                self._finish_object(time, bb, sb, resorted=True)
+                return True
+        old_toks: dict = {}
+        kvs: list = []
+        toks: list = []
+        diffs: list = []
+
+        def old_tok_of(v):
+            t = old_toks.get(v)
+            if t is None:
+                t = old_toks[v] = self._tab.intern_row((v,))
+            return t
+
+        targets = (
+            big.keys()
+            if changed_all
+            else [kv for kv, _t, d in bw if d > 0 and kv in big]
+        )
+        for kv in list(targets):
+            old = emitted.get(kv)
+            if old is not None and old != new_value:
+                kvs.append(kv)
+                toks.append(old_tok_of(old))
+                diffs.append(-1)
+            if new_value is not None and old != new_value:
+                kvs.append(kv)
+                toks.append(val_tok)
+                diffs.append(1)
+                emitted[kv] = new_value
+        # retractions of removed big rows
+        for kv, _t, d in bw:
+            if d < 0 and kv in emitted and kv not in big:
+                kvs.append(kv)
+                toks.append(old_tok_of(emitted.pop(kv)))
+                diffs.append(-1)
+        self._emit_tok(time, kvs, toks, diffs, consolidate_out=True)
+        return True
 
     def finish_time(self, time: int) -> None:
+        if self._tok:
+            if self._finish_tok(time):
+                return
         bb = self.take_input(0)
         sb = self.take_input(1)
         if not bb and not sb:
             return
+        self._finish_object(time, bb, sb)
+
+    def _finish_object(
+        self, time: int, bb: list[Entry], sb: list[Entry], resorted: bool = False
+    ) -> None:
         new_value = self.current[1] if self.current else None
         # canonical order within the wave (worker-count invariance)
         sb = sorted(sb, key=lambda e: e[0].value)

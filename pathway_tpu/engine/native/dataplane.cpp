@@ -1630,6 +1630,180 @@ int64_t dp_join_rows(void* h, int64_t n, const uint64_t* l_lo,
     return 0;
 }
 
+// ------------------------------------------------- stateful-tail kernels
+//
+// Token-resident support for the stateful operator tail (update_cells,
+// ix, flatten) — reference: src/engine/dataflow.rs:1555-2224 runs these
+// on typed records; here the row bytes splice/decode directly.
+
+// Output col j = column idx[j] of (side[j]==0 ? left : right) input row.
+// Returns 0, or -1-i on a malformed/unknown row at pair i.
+int64_t dp_splice_cols(void* h, int64_t n, const uint64_t* l_tok,
+                       const uint64_t* r_tok, int64_t n_out,
+                       const int64_t* side, const int64_t* idx,
+                       uint64_t* out_tok) {
+    auto* tab = static_cast<InternTable*>(h);
+    // per-side sorted unique column lists for find_cols
+    std::vector<int64_t> cols[2];
+    for (int64_t j = 0; j < n_out; ++j) cols[side[j] ? 1 : 0].push_back(idx[j]);
+    std::unordered_map<int64_t, int64_t> slot[2];
+    for (int s = 0; s < 2; ++s) {
+        std::sort(cols[s].begin(), cols[s].end());
+        cols[s].erase(std::unique(cols[s].begin(), cols[s].end()),
+                      cols[s].end());
+        for (size_t k = 0; k < cols[s].size(); ++k)
+            slot[s][cols[s][k]] = static_cast<int64_t>(k);
+    }
+    std::vector<const char*> starts[2], ends[2];
+    for (int s = 0; s < 2; ++s) {
+        starts[s].resize(cols[s].size());
+        ends[s].resize(cols[s].size());
+    }
+    std::string row_bytes;
+    PendingRows pend;
+    {
+        std::shared_lock<std::shared_mutex> rg(tab->mu);
+        for (int64_t i = 0; i < n; ++i) {
+            const uint64_t toks[2] = {l_tok[i], r_tok[i]};
+            bool ok = true;
+            for (int s = 0; s < 2 && ok; ++s) {
+                if (cols[s].empty()) continue;
+                const char* row;
+                int64_t rlen;
+                if (!tab->get(toks[s], &row, &rlen) ||
+                    !find_cols(row, rlen, cols[s].data(),
+                               static_cast<int64_t>(cols[s].size()),
+                               starts[s].data(), ends[s].data()))
+                    ok = false;
+            }
+            if (!ok) return -1 - i;
+            row_bytes.clear();
+            for (int64_t j = 0; j < n_out; ++j) {
+                int s = side[j] ? 1 : 0;
+                int64_t k = slot[s][idx[j]];
+                row_bytes.append(
+                    starts[s][static_cast<size_t>(k)],
+                    static_cast<size_t>(ends[s][static_cast<size_t>(k)] -
+                                        starts[s][static_cast<size_t>(k)]));
+            }
+            pend.add(row_bytes, i);
+        }
+    }
+    pend.intern_all(tab, out_tok);
+    return 0;
+}
+
+// Extract a pointer (Key) column: status[i] 0 = Key (lo/hi valid),
+// 1 = None, 2 = other scalar. Returns 0, or -1-i on malformed row i.
+int64_t dp_decode_key_col(void* h, int64_t n, const uint64_t* tokens,
+                          int64_t col, uint64_t* out_lo, uint64_t* out_hi,
+                          uint8_t* out_status) {
+    auto* tab = static_cast<InternTable*>(h);
+    const char* start;
+    const char* end;
+    std::shared_lock<std::shared_mutex> g(tab->mu);
+    for (int64_t i = 0; i < n; ++i) {
+        const char* row;
+        int64_t rlen;
+        if (!tab->get(tokens[i], &row, &rlen) ||
+            !find_cols(row, rlen, &col, 1, &start, &end))
+            return -1 - i;
+        uint8_t tag = static_cast<uint8_t>(*start);
+        out_lo[i] = 0;
+        out_hi[i] = 0;
+        if (tag == TAG_KEY) {
+            std::memcpy(&out_lo[i], start + 1, 8);
+            std::memcpy(&out_hi[i], start + 9, 8);
+            out_status[i] = 0;
+        } else if (tag == TAG_NONE) {
+            out_status[i] = 1;
+        } else {
+            out_status[i] = 2;
+        }
+    }
+    return 0;
+}
+
+// Flatten a str/bytes column: each input row i expands to one child row
+// per unicode character (str) / per single byte (bytes), with child key
+// = blake2b(piece_key(parent) + piece_int(j)) — byte-identical to Python
+// hash_values(key, j). Rows whose column is None expand to nothing;
+// any other tag gets fb_status[i]=1 (python fallback). Output arrays are
+// caller-sized; returns the child count, or the negated required
+// capacity when cap is too small.
+int64_t dp_flatten(void* h, int64_t n, const uint64_t* tokens,
+                   const uint64_t* key_lo, const uint64_t* key_hi,
+                   const int64_t* diffs, int64_t col, uint8_t* fb_status,
+                   int64_t cap, uint64_t* o_lo, uint64_t* o_hi,
+                   uint64_t* o_tok, int64_t* o_diff) {
+    auto* tab = static_cast<InternTable*>(h);
+    const char* start;
+    const char* end;
+    std::string row_bytes, kb;
+    PendingRows pend;
+    int64_t m = 0;
+    {
+        std::shared_lock<std::shared_mutex> rg(tab->mu);
+        for (int64_t i = 0; i < n; ++i) {
+            const char* row;
+            int64_t rlen;
+            fb_status[i] = 0;
+            if (!tab->get(tokens[i], &row, &rlen) ||
+                !find_cols(row, rlen, &col, 1, &start, &end)) {
+                fb_status[i] = 1;
+                continue;
+            }
+            uint8_t tag = static_cast<uint8_t>(*start);
+            if (tag == TAG_NONE) continue;
+            if (tag != TAG_STR && tag != TAG_BYTES) {
+                fb_status[i] = 1;
+                continue;
+            }
+            int64_t slen;
+            std::memcpy(&slen, start + 1, 8);
+            const char* s = start + 9;
+            const char* prefix = row;
+            size_t prefix_len = static_cast<size_t>(start - row);
+            const char* suffix = end;
+            size_t suffix_len = static_cast<size_t>(row + rlen - end);
+            int64_t j = 0;
+            for (int64_t b = 0; b < slen;) {
+                int64_t clen = 1;
+                if (tag == TAG_STR) {  // utf-8 char boundaries
+                    uint8_t c0 = static_cast<uint8_t>(s[b]);
+                    clen = c0 < 0x80 ? 1 : (c0 < 0xE0 ? 2 : (c0 < 0xF0 ? 3 : 4));
+                    if (b + clen > slen) clen = slen - b;  // defensive
+                }
+                if (m < cap) {
+                    row_bytes.clear();
+                    row_bytes.append(prefix, prefix_len);
+                    if (tag == TAG_STR)
+                        piece_str(row_bytes, s + b, clen);
+                    else {
+                        row_bytes.push_back(static_cast<char>(TAG_BYTES));
+                        put_i64(row_bytes, 1);
+                        row_bytes.push_back(s[b]);
+                    }
+                    row_bytes.append(suffix, suffix_len);
+                    pend.add(row_bytes, m);
+                    kb.clear();
+                    piece_key(kb, key_lo[i], key_hi[i]);
+                    piece_int(kb, j);
+                    blake2b_128(reinterpret_cast<const uint8_t*>(kb.data()),
+                                kb.size(), &o_lo[m], &o_hi[m]);
+                    o_diff[m] = diffs[i];
+                }
+                ++m;
+                ++j;
+                b += clen;
+            }
+        }
+    }
+    if (m > cap) return -m;
+    pend.intern_all(tab, o_tok);
+    return m;
+}
+
 // Import: intern each blob row (offsets implied by ulen), then map local
 // ids in tokens[] back to this process's intern ids.
 int64_t dp_import_tokens(void* h, int64_t n, uint64_t* tokens,

@@ -159,6 +159,19 @@ def _load() -> ctypes.CDLL | None:
             c.c_void_p, c.c_int64, u64p, u64p, u64p, u64p, u64p, u64p,
             c.c_int64, u64p, u64p, u64p,
         ]
+        lib.dp_splice_cols.restype = c.c_int64
+        lib.dp_splice_cols.argtypes = [
+            c.c_void_p, c.c_int64, u64p, u64p, c.c_int64, i64p, i64p, u64p,
+        ]
+        lib.dp_decode_key_col.restype = c.c_int64
+        lib.dp_decode_key_col.argtypes = [
+            c.c_void_p, c.c_int64, u64p, c.c_int64, u64p, u64p, u8p,
+        ]
+        lib.dp_flatten.restype = c.c_int64
+        lib.dp_flatten.argtypes = [
+            c.c_void_p, c.c_int64, u64p, u64p, u64p, i64p, c.c_int64, u8p,
+            c.c_int64, u64p, u64p, u64p, i64p,
+        ]
         lib.dp_export_tokens.restype = c.c_int64
         lib.dp_export_tokens.argtypes = [
             c.c_void_p, c.c_int64, u64p, c.c_char_p, c.c_int64, i64p, c.c_int64,
@@ -767,6 +780,72 @@ def build_rows(
     )
     assert rc == 0
     return out_tok, status
+
+
+def splice_cols(
+    tab: InternTable,
+    l_tok: np.ndarray,
+    r_tok: np.ndarray,
+    specs: list[tuple[int, int]],
+):
+    """Build rows picking columns from two source rows: specs[j] =
+    (side, col) with side 0=left 1=right. None on malformed rows."""
+    lib = _load()
+    n = len(l_tok)
+    side = np.asarray([s for s, _ in specs], np.int64)
+    idx = np.asarray([c for _, c in specs], np.int64)
+    out = np.empty(n, np.uint64)
+    rc = lib.dp_splice_cols(
+        tab._h, n, np.ascontiguousarray(l_tok), np.ascontiguousarray(r_tok),
+        len(specs), side, idx, out,
+    )
+    if rc != 0:
+        return None
+    return out
+
+
+def decode_key_col(tab: InternTable, tokens: np.ndarray, col: int):
+    """-> (lo, hi, status) with status 0=Key 1=None 2=other scalar;
+    None on malformed rows."""
+    lib = _load()
+    n = len(tokens)
+    lo = np.empty(n, np.uint64)
+    hi = np.empty(n, np.uint64)
+    st = np.empty(n, np.uint8)
+    rc = lib.dp_decode_key_col(
+        tab._h, n, np.ascontiguousarray(tokens), col, lo, hi, st
+    )
+    if rc != 0:
+        return None
+    return lo, hi, st
+
+
+def flatten_batch(tab: InternTable, batch: "NativeBatch", col: int):
+    """Expand a str/bytes column into per-character child rows with
+    hash_values(parent_key, j) keys. Returns (child NativeBatch,
+    fallback_mask) — fallback rows (non-str/bytes column) take the
+    object path. None on total kernel failure."""
+    lib = _load()
+    n = len(batch)
+    fb = np.empty(max(n, 1), np.uint8)
+    tok = np.ascontiguousarray(batch.token)
+    lo = np.ascontiguousarray(batch.key_lo)
+    hi = np.ascontiguousarray(batch.key_hi)
+    df = np.ascontiguousarray(batch.diff)
+    cap = max(4 * n, 256)
+    while True:
+        o_lo = np.empty(cap, np.uint64)
+        o_hi = np.empty(cap, np.uint64)
+        o_tok = np.empty(cap, np.uint64)
+        o_diff = np.empty(cap, np.int64)
+        m = lib.dp_flatten(
+            tab._h, n, tok, lo, hi, df, col, fb, cap, o_lo, o_hi, o_tok, o_diff
+        )
+        if m >= 0:
+            break
+        cap = -m
+    child = NativeBatch(tab, o_lo[:m], o_hi[:m], o_tok[:m], o_diff[:m])
+    return child, fb[:n] != 0
 
 
 def format_csv(

@@ -96,15 +96,23 @@ def _canon(v: Any) -> Any:
 
 def native_shards(batch: Any, plan: Any, n: int):
     """Shard array for a NativeBatch under a route plan (('key',) |
-    ('group', cols)), or None when the plan can't judge the batch. The
-    SINGLE dispatch point for thread- AND process-level native routing —
-    both must agree byte-for-byte with _shard_of."""
+    ('group', cols) | ('ptr_col', col)), or None when the plan can't
+    judge the batch. The SINGLE dispatch point for thread- AND
+    process-level native routing — both must agree byte-for-byte with
+    _shard_of."""
     if plan is None:
         return None
     from pathway_tpu.engine.native import dataplane as dp
 
     if plan[0] == "key":
         return dp.route_key(batch.key_lo, batch.key_hi, n)
+    if plan[0] == "ptr_col":
+        # route by the pointer column's key128 (ix colocation); batches
+        # holding a non-Key pointer fall back to the object route
+        res = dp.decode_key_col(batch.tab, batch.token, plan[1])
+        if res is None or (res[2] != 0).any():
+            return None
+        return dp.route_key(res[0], res[1], n)
     res = dp.project_group(batch.tab, batch.token, plan[1], n_shards=n)
     return None if res is None else res[1]
 

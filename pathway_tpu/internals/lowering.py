@@ -493,6 +493,8 @@ class Session:
             return eng.ConcatNode(g, nodes)
 
         if kind == "update_rows":
+            # token-resident: key-level state, rows pass through as tokens
+            self._native_specs.add(spec.id)
             return self._sharded(
                 [self.node_of(spec.inputs[0]), self.node_of(spec.inputs[1])],
                 lambda sg, ins: eng.UpdateRowsNode(sg, ins[0], ins[1]),
@@ -501,6 +503,7 @@ class Session:
 
         if kind == "update_cells":
             col_map = spec.params["col_map"]
+            self._native_specs.add(spec.id)
             return self._sharded(
                 [self.node_of(spec.inputs[0]), self.node_of(spec.inputs[1])],
                 lambda sg, ins: eng.UpdateCellsNode(sg, ins[0], ins[1], col_map),
@@ -510,6 +513,7 @@ class Session:
         if kind == "setop":
             nodes = [self.node_of(t) for t in spec.inputs]
             mode = spec.params["mode"]
+            self._native_specs.add(spec.id)
             return self._sharded(
                 nodes,
                 lambda sg, ins: eng.SetOpNode(sg, ins, mode),
@@ -517,6 +521,7 @@ class Session:
             )
 
         if kind == "with_universe_of":
+            self._native_specs.add(spec.id)
             return self._sharded(
                 [self.node_of(spec.inputs[0]), self.node_of(spec.inputs[1])],
                 lambda sg, ins: eng.SetOpNode(sg, ins, "restrict"),
@@ -528,6 +533,7 @@ class Session:
             nodes = [self.node_of(spec.inputs[0])]
             for ref in indexers:
                 nodes.append(self.node_of(ref.table))
+            self._native_specs.add(spec.id)
             return self._sharded(
                 nodes,
                 lambda sg, ins: eng.SetOpNode(sg, ins, "intersect"),
@@ -579,19 +585,40 @@ class Session:
         if kind == "flatten":
             main = spec.inputs[0]
             idx = main._column_names().index(spec.params["column"])
+            if main._spec.id in self._native_specs:
+                self._native_specs.add(spec.id)
             return eng.FlattenNode(g, self.node_of(main), idx)
 
         if kind == "ix":
             context_t, target_t = spec.inputs
             resolver = Resolver([context_t])
-            pf = compile_expression(spec.params["pointer"], resolver)
+            ptr_e = spec.params["pointer"]
+            pf = compile_expression(ptr_e, resolver)
             optional = spec.params.get("optional", False)
             target_width = len(target_t._column_names())
+            # token-resident gate: a plain pointer-typed column lets the
+            # lookup run key-level in C (dp_decode_key_col)
+            ptr_col = None
+            names = context_t._column_names()
+            if (
+                isinstance(ptr_e, ex.ColumnReference)
+                and not isinstance(ptr_e, ex.IdReference)
+                and ptr_e.name in names
+            ):
+                from pathway_tpu.internals import dtype as dt
+
+                if isinstance(context_t._dtype_of(ptr_e.name), dt.Pointer):
+                    ptr_col = names.index(ptr_e.name)
+                    self._native_specs.add(spec.id)
 
             def route_ptr(key: Key, row: tuple) -> Any:
                 # colocate each source row with its lookup target
                 v = pf(key, (row,))
                 return v.value if isinstance(v, Key) else eng.freeze_value(v)
+
+            native_routes = None
+            if ptr_col is not None:
+                native_routes = [("ptr_col", ptr_col), ("key",)]
 
             return self._sharded(
                 [self.node_of(context_t), self.node_of(target_t)],
@@ -600,8 +627,10 @@ class Session:
                     lambda key, row: pf(key, (row,)),
                     optional=optional,
                     target_width=target_width,
+                    ptr_col=ptr_col,
                 ),
                 [route_ptr, _route_key],
+                native_routes=native_routes,
             )
 
         if kind == "sort":
@@ -626,13 +655,53 @@ class Session:
         if kind == "deduplicate":
             main = spec.inputs[0]
             resolver = Resolver([main])
-            vf = compile_expression(spec.params["value"], resolver)
+            value_e = spec.params["value"]
+            vf = compile_expression(value_e, resolver)
             inst_e = spec.params.get("instance")
             if inst_e is not None:
                 instf = compile_expression(inst_e, resolver)
             else:
                 instf = lambda key, rows: 0  # noqa: E731
             acceptor = spec.params["acceptor"]
+            # token-resident gate: plain stably-typed value/instance
+            # columns — instance groups + output keys compute in C, the
+            # value column bulk-decodes, only the acceptor runs per row
+            native_cfg = None
+            names = main._column_names()
+            from pathway_tpu.internals import dtype as dt
+
+            def _plain_col(e, dtypes) -> int | None:
+                if (
+                    isinstance(e, ex.ColumnReference)
+                    and not isinstance(e, ex.IdReference)
+                    and e.name in names
+                    and main._dtype_of(e.name) in dtypes
+                ):
+                    return names.index(e.name)
+                return None
+
+            vcol = _plain_col(value_e, (dt.INT, dt.FLOAT, dt.BOOL, dt.STR))
+            if vcol is not None:
+                if inst_e is None:
+                    inst_cols: list[int] | None = []
+                else:
+                    icol = _plain_col(
+                        inst_e, (dt.INT, dt.FLOAT, dt.BOOL, dt.STR)
+                    )
+                    inst_cols = [icol] if icol is not None else None
+                if inst_cols is not None:
+                    native_cfg = {
+                        "inst_cols": inst_cols,
+                        "value_col": vcol,
+                        "value_kind": (
+                            "str" if main._dtype_of(value_e.name) is dt.STR
+                            else "num"
+                        ),
+                    }
+                    self._native_specs.add(spec.id)
+            native_routes = None
+            if native_cfg is not None and native_cfg["inst_cols"]:
+                native_routes = [("group", native_cfg["inst_cols"])]
             return self._sharded(
                 [self.node_of(main)],
                 lambda sg, ins: eng.DeduplicateNode(
@@ -640,8 +709,10 @@ class Session:
                     lambda key, row: instf(key, (row,)),
                     lambda key, row: vf(key, (row,)),
                     acceptor,
+                    native_cfg=native_cfg,
                 ),
                 [lambda key, row: eng.freeze_value(instf(key, (row,)))],
+                native_routes=native_routes,
             )
 
         if kind in ("buffer", "forget", "freeze"):
@@ -650,6 +721,15 @@ class Session:
             tf = compile_expression(spec.params["threshold"], resolver)
             cf = compile_expression(spec.params["current"], resolver)
             cls = {"buffer": eng.BufferNode, "forget": eng.ForgetNode, "freeze": eng.FreezeNode}[kind]
+            # token-resident gate: vectorizable threshold/current
+            # expressions evaluate per wave over bulk-decoded columns
+            from pathway_tpu.internals.expression_numpy import compile_numpy
+
+            tp = compile_numpy(spec.params["threshold"], main._column_names())
+            cp = compile_numpy(spec.params["current"], main._column_names())
+            native_plans = (tp, cp) if tp is not None and cp is not None else None
+            if native_plans is not None:
+                self._native_specs.add(spec.id)
             # global watermark state: runs whole on process 0
             (inp,) = self._process_exchange([self.node_of(main)], None)
             return cls(
@@ -657,6 +737,7 @@ class Session:
                 inp,
                 lambda key, row: tf(key, (row,)),
                 lambda key, row: cf(key, (row,)),
+                native_plans=native_plans,
             )
 
         if kind == "iterate_output":
