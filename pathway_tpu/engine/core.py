@@ -478,9 +478,14 @@ class Graph:
             node.time_ns += perf_counter_ns() - t0
 
     def end(self, time: int) -> None:
+        # per node: drain buffered input FIRST, then end-of-stream hooks —
+        # a sink must write the final wave (e.g. an upstream buffer's
+        # flush, delivered via topo order) before its on_end closes the
+        # file. Upstream on_end emissions still precede every downstream
+        # node's finish_time because nodes run in topological order.
         for node in self.nodes:
-            node.on_end(time)
             node.finish_time(time)
+            node.on_end(time)
 
 
 class InputNode(Node):
@@ -527,6 +532,13 @@ class RowwiseNode(Node):
     Reference: expression_table (dataflow.rs:1246) + Rowwise context.
     Input 0 drives the universe; inputs 1..n are key-aligned side tables
     whose current row is visible to the expressions.
+
+    `native_specs` (lowering-gated: every output expression is a plain
+    column of one input) keeps the node token-resident: per-input state
+    is {key128 -> token} and output rows splice across the aligned
+    source rows in C (dp_splice_cols) — the ix/select-from-side pattern
+    stays on the token plane end to end. Demotes permanently on the
+    first plane-unrepresentable row (state decodes once).
     """
 
     _state_routing = {
@@ -542,13 +554,187 @@ class RowwiseNode(Node):
         inputs: Sequence[Node],
         fn: Callable[..., tuple],
         append_only: bool = False,
+        native_specs: list | None = None,
     ):
         super().__init__(graph, inputs)
         self.fn = fn  # fn(key, *rows) -> out_row
         self._persist_attrs = ("side_states", "emitted", "deferred", "_main_state_")
-        self.side_states = [KeyedState() for _ in range(len(inputs) - 1)]
-        self.emitted: dict[Key, tuple] = {}
+        self._specs = native_specs
+        self._tok = native_specs is not None and _tok_plane() is not None
+        if self._tok:
+            self._dp = _tok_plane()
+            self._tab = self._dp.default_table()
+            self.side_states: Any = [{} for _ in range(len(inputs) - 1)]
+            self.emitted: Any = {}
+            self._main_state_: Any = {}
+        else:
+            self.side_states = [KeyedState() for _ in range(len(inputs) - 1)]
+            self.emitted = {}
         self.deferred: dict[Key, int] = {}
+
+    # ------------------------------------------------------- token plane
+
+    def _demote(self) -> None:
+        if not self._tok:
+            return
+        tab = self._tab
+        sides = []
+        for st in self.side_states:
+            ks = KeyedState()
+            ks.rows = {Key(kv): tab.row(t) for kv, t in st.items()}
+            sides.append(ks)
+        self.side_states = sides
+        self.emitted = {Key(kv): tab.row(t) for kv, t in self.emitted.items()}
+        ms = KeyedState()
+        ms.rows = {Key(kv): tab.row(t) for kv, t in self._main_state_.items()}
+        self._main_state_ = ms
+        self._tok = False
+
+    def persist_state(self) -> dict | None:
+        if not self._tok:
+            return super().persist_state()
+        tab = self._tab
+        sides = []
+        for st in self.side_states:
+            ks = KeyedState()
+            ks.rows = {Key(kv): tab.row(t) for kv, t in st.items()}
+            sides.append(ks)
+        ms = KeyedState()
+        ms.rows = {Key(kv): tab.row(t) for kv, t in self._main_state_.items()}
+        return {
+            "side_states": sides,
+            "emitted": {Key(kv): tab.row(t) for kv, t in self.emitted.items()},
+            "deferred": dict(self.deferred),
+            "_main_state_": ms,
+        }
+
+    def restore_state(self, state: dict) -> None:
+        if not self._tok:
+            super().restore_state(state)
+            return
+        tab = self._tab
+        sides = []
+        emitted = {}
+        main = {}
+        ok = True
+        for st in state.get("side_states", []):
+            d = {}
+            for k, row in st.rows.items():
+                t = tab.intern_row(row)
+                if t is None:
+                    ok = False
+                    break
+                d[k.value] = t
+            sides.append(d)
+        if ok:
+            for k, row in state.get("emitted", {}).items():
+                t = tab.intern_row(row)
+                if t is None:
+                    ok = False
+                    break
+                emitted[k.value] = t
+        if ok:
+            for k, row in state.get("_main_state_", KeyedState()).rows.items():
+                t = tab.intern_row(row)
+                if t is None:
+                    ok = False
+                    break
+                main[k.value] = t
+        if not ok:
+            self._demote()
+            super().restore_state(state)
+            return
+        self.side_states = sides
+        self.emitted = emitted
+        self._main_state_ = main
+        self.deferred = dict(state.get("deferred", {}))
+
+    def _finish_tok(self, time: int) -> bool:
+        raws = [self.take_segments(i) for i in range(len(self.inputs))]
+        waves = []
+        for b, e in raws:
+            w = _wave_triples(self._tab, b, e)
+            if w is None:
+                for i, (bb, ee) in enumerate(raws):
+                    for seg in bb:
+                        self.accept(i, seg)
+                    if ee:
+                        self.accept(i, ee)
+                    self.rows_in -= len(ee) + sum(len(x) for x in bb)
+                self._demote()
+                return False
+            waves.append(w)
+        if not any(waves):
+            return True
+        affected: dict = dict.fromkeys(kv for kv, _t, _d in waves[0])
+        for i, w in enumerate(waves[1:]):
+            _tok_update_keyed(self.side_states[i], w)
+            for kv, _t, _d in w:
+                affected[kv] = None
+        main = self._main_state_
+        _tok_update_keyed(main, waves[0])
+        # keys with every aligned source present splice in one C call
+        plan_kvs: list[int] = []
+        src_toks: list[list[int]] = [[] for _ in range(len(self.inputs))]
+        for kv in affected:
+            t0 = main.get(kv)
+            if t0 is None:
+                continue
+            row_toks = [t0]
+            for st in self.side_states:
+                ts = st.get(kv)
+                if ts is None:
+                    break
+                row_toks.append(ts)
+            else:
+                plan_kvs.append(kv)
+                for s, t in enumerate(row_toks):
+                    src_toks[s].append(t)
+        new_toks: dict = {}
+        if plan_kvs:
+            res = self._dp.splice_cols(
+                self._tab,
+                [
+                    np.fromiter(ts, np.uint64, len(plan_kvs))
+                    for ts in src_toks
+                ],
+                self._specs,
+            )
+            if res is None:
+                # malformed token (cannot happen for plane-built rows):
+                # demote and recompute the affected keys object-side
+                keys = [Key(kv) for kv in affected]
+                self._demote()
+                out: list[Entry] = []
+                ms = self._main_state()
+                for key in keys:
+                    row0 = ms.get(key)
+                    new = self._compute(key, row0) if row0 is not None else None
+                    delta_emit(self.emitted, out, key, new)
+                self.emit(time, out)
+                return True
+            new_toks = dict(zip(plan_kvs, res.tolist()))
+        kvs: list = []
+        toks: list = []
+        diffs: list = []
+        for kv in affected:
+            _tok_delta_emit(
+                self.emitted, kvs, toks, diffs, kv, new_toks.get(kv)
+            )
+        dp_nb = self._dp
+        n = len(kvs)
+        if n:
+            self.emit(
+                time,
+                dp_nb.NativeBatch(
+                    self._tab,
+                    np.fromiter((kv & _MASK64 for kv in kvs), np.uint64, n),
+                    np.fromiter((kv >> 64 for kv in kvs), np.uint64, n),
+                    np.fromiter(toks, np.uint64, n),
+                    np.fromiter(diffs, np.int64, n),
+                ),
+            )
+        return True
 
     def _compute(self, key: Key, row0: tuple) -> tuple | None:
         rows = [row0]
@@ -560,6 +746,9 @@ class RowwiseNode(Node):
         return self.fn(key, *rows)  # column fns are individually guarded
 
     def finish_time(self, time: int) -> None:
+        if self._tok:
+            if self._finish_tok(time):
+                return
         main = self.take_input(0)
         side_batches = [self.take_input(i) for i in range(1, len(self.inputs))]
         if not main and not any(side_batches):
@@ -637,11 +826,24 @@ class MapNode(Node):
         if decoded is None:
             self._map_entries(time, batch.materialize())
             return
+        from pathway_tpu.internals.expression_numpy import KeyColsPlan
+
         n_slots = len(plan["plans"])
         vals_i = np.zeros((max(n_slots, 1), n), np.int64)
         vals_f = np.zeros((max(n_slots, 1), n), np.float64)
         vtag = np.zeros((max(n_slots, 1), n), np.uint8)
         for s, p in enumerate(plan["plans"]):
+            if isinstance(p, KeyColsPlan):
+                rk = self._dp.rekey(batch.tab, batch.token, p.cols)
+                if rk is None:
+                    self._map_entries(time, batch.materialize())
+                    return
+                lo, hi = rk
+                bad = (lo == 0) & (hi == 0)  # ERROR in key columns
+                vals_i[s] = lo.view(np.int64)
+                vals_f[s] = hi.view(np.float64)
+                vtag[s] = np.where(bad, np.uint8(255), np.uint8(4))
+                continue
             vi, vf, tg = p.eval_map(decoded, n)
             vals_i[s] = vi
             vals_f[s] = vf
@@ -1327,8 +1529,10 @@ class UpdateCellsNode(_TokTailNode):
             if sl:
                 res = self._dp.splice_cols(
                     self._tab,
-                    np.fromiter(sl, np.uint64, len(sl)),
-                    np.fromiter(sr, np.uint64, len(sr)),
+                    [
+                        np.fromiter(sl, np.uint64, len(sl)),
+                        np.fromiter(sr, np.uint64, len(sr)),
+                    ],
                     self._splice_specs,
                 )
                 if res is None:  # malformed token — cannot happen for

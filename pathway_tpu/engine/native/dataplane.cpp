@@ -1169,7 +1169,9 @@ void dp_route_key(int64_t n, const uint64_t* key_lo, const uint64_t* key_hi,
 //   src_kind[j] == 0 -> passthrough of input column src_col[j]
 //   src_kind[j] == 1 -> computed from value slot s = src_col[j]:
 //                       vtag[s*n+i] 0=int(vals_i) 1=float(vals_f)
-//                       2=None 3=bool(vals_i) 255=python-fallback row
+//                       2=None 3=bool(vals_i)
+//                       4=key128 (lo = vals_i bits, hi = vals_f bits)
+//                       255=python-fallback row
 // status[i]: 0 ok, 1 fallback (any col with vtag 255 or malformed input).
 // Returns 0, or -1 on bad args.
 int64_t dp_build_rows(void* h, int64_t n, const uint64_t* in_tokens,
@@ -1220,6 +1222,13 @@ int64_t dp_build_rows(void* h, int64_t n, const uint64_t* in_tokens,
                     case 1: piece_float(row_bytes, vals_f[o]); break;
                     case 2: piece_none(row_bytes); break;
                     case 3: piece_bool(row_bytes, vals_i[o] != 0); break;
+                    case 4: {
+                        uint64_t lo, hi;
+                        std::memcpy(&lo, &vals_i[o], 8);
+                        std::memcpy(&hi, &vals_f[o], 8);
+                        piece_key(row_bytes, lo, hi);
+                        break;
+                    }
                     default: ok = false;
                 }
             }
@@ -1636,55 +1645,57 @@ int64_t dp_join_rows(void* h, int64_t n, const uint64_t* l_lo,
 // ix, flatten) — reference: src/engine/dataflow.rs:1555-2224 runs these
 // on typed records; here the row bytes splice/decode directly.
 
-// Output col j = column idx[j] of (side[j]==0 ? left : right) input row.
+// Output col j = column idx[j] of source side[j] (0..k-1). toks is
+// [k][n] row-major: source s's token for pair i is toks[s*n + i].
 // Returns 0, or -1-i on a malformed/unknown row at pair i.
-int64_t dp_splice_cols(void* h, int64_t n, const uint64_t* l_tok,
-                       const uint64_t* r_tok, int64_t n_out,
-                       const int64_t* side, const int64_t* idx,
+int64_t dp_splice_cols(void* h, int64_t n, int64_t k, const uint64_t* toks,
+                       int64_t n_out, const int64_t* side, const int64_t* idx,
                        uint64_t* out_tok) {
     auto* tab = static_cast<InternTable*>(h);
-    // per-side sorted unique column lists for find_cols
-    std::vector<int64_t> cols[2];
-    for (int64_t j = 0; j < n_out; ++j) cols[side[j] ? 1 : 0].push_back(idx[j]);
-    std::unordered_map<int64_t, int64_t> slot[2];
-    for (int s = 0; s < 2; ++s) {
-        std::sort(cols[s].begin(), cols[s].end());
-        cols[s].erase(std::unique(cols[s].begin(), cols[s].end()),
-                      cols[s].end());
-        for (size_t k = 0; k < cols[s].size(); ++k)
-            slot[s][cols[s][k]] = static_cast<int64_t>(k);
+    // per-source sorted unique column lists for find_cols
+    std::vector<std::vector<int64_t>> cols(static_cast<size_t>(k));
+    std::vector<std::unordered_map<int64_t, int64_t>> slot(
+        static_cast<size_t>(k));
+    for (int64_t j = 0; j < n_out; ++j) {
+        if (side[j] < 0 || side[j] >= k) return -1;
+        cols[static_cast<size_t>(side[j])].push_back(idx[j]);
     }
-    std::vector<const char*> starts[2], ends[2];
-    for (int s = 0; s < 2; ++s) {
-        starts[s].resize(cols[s].size());
-        ends[s].resize(cols[s].size());
+    std::vector<std::vector<const char*>> starts(static_cast<size_t>(k));
+    std::vector<std::vector<const char*>> ends(static_cast<size_t>(k));
+    for (int64_t s = 0; s < k; ++s) {
+        auto& c = cols[static_cast<size_t>(s)];
+        std::sort(c.begin(), c.end());
+        c.erase(std::unique(c.begin(), c.end()), c.end());
+        for (size_t q = 0; q < c.size(); ++q)
+            slot[static_cast<size_t>(s)][c[q]] = static_cast<int64_t>(q);
+        starts[static_cast<size_t>(s)].resize(c.size());
+        ends[static_cast<size_t>(s)].resize(c.size());
     }
     std::string row_bytes;
     PendingRows pend;
     {
         std::shared_lock<std::shared_mutex> rg(tab->mu);
         for (int64_t i = 0; i < n; ++i) {
-            const uint64_t toks[2] = {l_tok[i], r_tok[i]};
             bool ok = true;
-            for (int s = 0; s < 2 && ok; ++s) {
-                if (cols[s].empty()) continue;
+            for (int64_t s = 0; s < k && ok; ++s) {
+                auto& c = cols[static_cast<size_t>(s)];
+                if (c.empty()) continue;
                 const char* row;
                 int64_t rlen;
-                if (!tab->get(toks[s], &row, &rlen) ||
-                    !find_cols(row, rlen, cols[s].data(),
-                               static_cast<int64_t>(cols[s].size()),
-                               starts[s].data(), ends[s].data()))
+                if (!tab->get(toks[s * n + i], &row, &rlen) ||
+                    !find_cols(row, rlen, c.data(),
+                               static_cast<int64_t>(c.size()),
+                               starts[static_cast<size_t>(s)].data(),
+                               ends[static_cast<size_t>(s)].data()))
                     ok = false;
             }
             if (!ok) return -1 - i;
             row_bytes.clear();
             for (int64_t j = 0; j < n_out; ++j) {
-                int s = side[j] ? 1 : 0;
-                int64_t k = slot[s][idx[j]];
-                row_bytes.append(
-                    starts[s][static_cast<size_t>(k)],
-                    static_cast<size_t>(ends[s][static_cast<size_t>(k)] -
-                                        starts[s][static_cast<size_t>(k)]));
+                size_t s = static_cast<size_t>(side[j]);
+                size_t q = static_cast<size_t>(slot[s][idx[j]]);
+                row_bytes.append(starts[s][q],
+                                 static_cast<size_t>(ends[s][q] - starts[s][q]));
             }
             pend.add(row_bytes, i);
         }

@@ -161,7 +161,8 @@ def _load() -> ctypes.CDLL | None:
         ]
         lib.dp_splice_cols.restype = c.c_int64
         lib.dp_splice_cols.argtypes = [
-            c.c_void_p, c.c_int64, u64p, u64p, c.c_int64, i64p, i64p, u64p,
+            c.c_void_p, c.c_int64, c.c_int64, u64p, c.c_int64, i64p, i64p,
+            u64p,
         ]
         lib.dp_decode_key_col.restype = c.c_int64
         lib.dp_decode_key_col.argtypes = [
@@ -784,20 +785,22 @@ def build_rows(
 
 def splice_cols(
     tab: InternTable,
-    l_tok: np.ndarray,
-    r_tok: np.ndarray,
+    toks: "list[np.ndarray] | np.ndarray",
     specs: list[tuple[int, int]],
 ):
-    """Build rows picking columns from two source rows: specs[j] =
-    (side, col) with side 0=left 1=right. None on malformed rows."""
+    """Build rows picking columns across k aligned source rows: specs[j]
+    = (source, col). `toks` is a list of k aligned token arrays (or one
+    [k, n] array). None on malformed rows."""
     lib = _load()
-    n = len(l_tok)
+    if isinstance(toks, list):
+        toks = np.stack([np.asarray(t, np.uint64) for t in toks])
+    toks = np.ascontiguousarray(toks, np.uint64)
+    k, n = toks.shape
     side = np.asarray([s for s, _ in specs], np.int64)
     idx = np.asarray([c for _, c in specs], np.int64)
     out = np.empty(n, np.uint64)
     rc = lib.dp_splice_cols(
-        tab._h, n, np.ascontiguousarray(l_tok), np.ascontiguousarray(r_tok),
-        len(specs), side, idx, out,
+        tab._h, n, k, toks.reshape(-1), len(specs), side, idx, out,
     )
     if rc != 0:
         return None

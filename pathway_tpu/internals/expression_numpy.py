@@ -43,6 +43,17 @@ class _V:
         self.bad = bad  # bool [n] — error / fallback rows
 
 
+class KeyColsPlan:
+    """A pointer_from(...) value slot: the key128 computes in C from the
+    projected column pieces (dp_rekey, byte-identical to key_for_values).
+    MapNode special-cases this plan type — it needs row tokens, not
+    decoded columns."""
+
+    def __init__(self, cols: list[int]):
+        self.cols = cols
+        self.needed_cols: set[int] = set()
+
+
 class NumpyPlan:
     """Compiled expression: eval(decoded_cols, n) -> (vi, vf, tag)."""
 

@@ -288,6 +288,33 @@ class Session:
         fns = [compile_expression(e, resolver) for e in exprs.values()]
         return input_nodes, self._guarded_row_fn(fns, trace)
 
+    def _pointer_expr_cols(
+        self, main: Table, e: Any, names: list[str]
+    ) -> list[int] | None:
+        """pointer_from over plain stably-typed columns: the key128 can
+        blake in C (dp_rekey / build_rows vtag 4). None = not eligible."""
+        if not (
+            isinstance(e, ex.PointerExpression)
+            and e._instance is None
+            and not e._optional
+            and e._args
+        ):
+            return None
+        from pathway_tpu.internals import dtype as dt
+
+        cols: list[int] = []
+        for a in e._args:
+            if (
+                isinstance(a, ex.ColumnReference)
+                and not isinstance(a, ex.IdReference)
+                and a.name in names
+                and main._dtype_of(a.name) in (dt.INT, dt.STR, dt.BOOL)
+            ):
+                cols.append(names.index(a.name))
+            else:
+                return None
+        return cols
+
     def _try_native_map(
         self, main: Table, exprs: dict, spec: OpSpec
     ) -> eng.Node | None:
@@ -307,7 +334,10 @@ class Session:
         ]
         if side or _collect_async(expr_list):
             return None
-        from pathway_tpu.internals.expression_numpy import compile_numpy
+        from pathway_tpu.internals.expression_numpy import (
+            KeyColsPlan,
+            compile_numpy,
+        )
 
         names = main._column_names()
         specs: list = []
@@ -320,6 +350,11 @@ class Session:
                 and e.name in names
             ):
                 specs.append(("col", names.index(e.name)))
+                continue
+            key_cols = self._pointer_expr_cols(main, e, names)
+            if key_cols is not None:
+                specs.append(("val", len(plans)))
+                plans.append(KeyColsPlan(key_cols))
                 continue
             plan = compile_numpy(e, names)
             if plan is None:
@@ -430,9 +465,46 @@ class Session:
             if node is not None:
                 return node
             input_nodes, fn = self._compile_rowwise(main, exprs, trace=spec.trace)
+            # aligned-select token gate: every output expression is a
+            # plain column of one input table -> rows splice in C
+            # (RowwiseNode native_specs), keeping ix/side-select chains
+            # token-resident
+            native_specs = None
+            expr_list = list(exprs.values())
+            side_tables = [
+                t
+                for t in referenced_tables(expr_list)
+                if isinstance(t, Table) and t is not main
+            ]
+            if not _collect_async(expr_list):
+                tables = [main] + side_tables
+                name_lists = [t._column_names() for t in tables]
+                cand: list = []
+                for e in expr_list:
+                    if isinstance(e, ex.ColumnReference) and not isinstance(
+                        e, ex.IdReference
+                    ):
+                        src = next(
+                            (
+                                s
+                                for s, t in enumerate(tables)
+                                if e.table is t and e.name in name_lists[s]
+                            ),
+                            None,
+                        )
+                        if src is not None:
+                            cand.append((src, name_lists[src].index(e.name)))
+                            continue
+                    cand = None  # type: ignore[assignment]
+                    break
+                if cand is not None:
+                    native_specs = cand
+                    self._native_specs.add(spec.id)
             return self._sharded(
                 input_nodes,
-                lambda sg, ins: eng.RowwiseNode(sg, ins, fn),
+                lambda sg, ins: eng.RowwiseNode(
+                    sg, ins, fn, native_specs=native_specs
+                ),
                 [_route_key] * len(input_nodes),
             )
 

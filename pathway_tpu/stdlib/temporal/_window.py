@@ -47,20 +47,43 @@ class TumblingWindow(Window):
         duration = self.duration
         origin = self.origin if self.origin is not None else self.offset
 
-        def win(t: Any) -> tuple:
+        if isinstance(duration, (int, float)) and (
+            origin is None or isinstance(origin, (int, float))
+        ):
+            # numeric times: pure expression arithmetic — vectorizable,
+            # no tuple column, so rows stay token-resident through the
+            # window assignment and the behavior buffer. _pw_window is
+            # the window START (it uniquely identifies a tumbling window
+            # for a fixed duration; window_join applies one window to
+            # both sides, so equality semantics are unchanged).
+            delta = (
+                time_expr % duration
+                if origin is None
+                else (time_expr - origin) % duration
+            )
+            t2 = table.with_columns(
+                _pw_time=time_expr,
+                _pw_window_start=time_expr - delta,
+            )
+            return t2.with_columns(
+                _pw_window=ex.this._pw_window_start,
+                _pw_window_end=ex.this._pw_window_start + duration,
+            )
+
+        def win(t: Any) -> Any:
             o = origin
             if o is None:
                 o = t - t if not hasattr(t, "timestamp_ns") else type(t)(ns=0)
             k = (t - o) // duration
-            start = o + k * duration
-            return (start, start + duration)
+            return o + k * duration
 
-        return table.with_columns(
-            _pw_window=apply_with_type(win, tuple, time_expr),
+        t2 = table.with_columns(
+            _pw_window_start=apply_with_type(win, dt.ANY, time_expr),
             _pw_time=time_expr,
-        ).with_columns(
-            _pw_window_start=ex.this._pw_window[0],
-            _pw_window_end=ex.this._pw_window[1],
+        )
+        return t2.with_columns(
+            _pw_window=ex.this._pw_window_start,
+            _pw_window_end=ex.this._pw_window_start + duration,
         )
 
 

@@ -1,0 +1,398 @@
+"""The stateful operator tail stays token-resident: set ops, update_rows/
+cells, ix, deduplicate, flatten, and the temporal trio process NativeBatch
+waves without materializing rows (asserted by counting materialize calls),
+demote cleanly when a wave carries plane-unrepresentable rows, and agree
+with the object plane (PATHWAY_TPU_NATIVE=0 equivalence is covered by
+running the same pipelines in conftest's object-plane CI leg).
+
+Reference parity: src/engine/dataflow.rs:1555-2224 (typed-record set ops /
+update / ix / dedup), operators/time_column.rs:380 (postpone/forget/freeze
+on arranged records), dataflow.rs:3101 (deduplicate).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.native import dataplane as dp
+from pathway_tpu.internals.parse_graph import G
+
+pytestmark = pytest.mark.skipif(not dp.available(), reason="no native toolchain")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _write_jsonl(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+@contextlib.contextmanager
+def _count_materialize():
+    counts = []
+    orig = dp.NativeBatch.materialize
+
+    def counted(self):
+        counts.append(len(self))
+        return orig(self)
+
+    dp.NativeBatch.materialize = counted
+    try:
+        yield counts
+    finally:
+        dp.NativeBatch.materialize = orig
+
+
+def _dicts(table):
+    return pw.debug.table_to_dicts(table)
+
+
+def _run_csv(table, tmp_path, name="out.csv"):
+    """Run to CSV (the token-resident output path) and return the body
+    as a list of dicts keyed by header name (time/diff dropped)."""
+    import csv as _csv
+
+    out = tmp_path / name
+    pw.io.csv.write(table, str(out))
+    pw.run()
+    with open(out, newline="") as f:
+        rows = list(_csv.reader(f))
+    header = rows[0]
+    return [
+        {h: v for h, v in zip(header, r) if h not in ("time", "diff")}
+        for r in rows[1:]
+    ]
+
+
+class _XY(pw.Schema):
+    k: int
+    v: int
+
+
+def _jsonl_table(tmp_path, name, rows, schema):
+    p = tmp_path / name
+    _write_jsonl(p, rows)
+    return pw.io.fs.read(str(p), format="json", schema=schema, mode="static")
+
+
+# --------------------------------------------------------------- update_rows
+
+
+def test_update_rows_token_resident(tmp_path):
+    left = _jsonl_table(
+        tmp_path, "l.jsonl",
+        [{"k": i, "v": i} for i in range(50)], _XY,
+    ).with_id_from(pw.this.k)
+    right = _jsonl_table(
+        tmp_path, "r.jsonl",
+        [{"k": i, "v": 100 + i} for i in range(25, 60)], _XY,
+    ).with_id_from(pw.this.k)
+    res = left.update_rows(right)
+    with _count_materialize() as mat:
+        body = _run_csv(res, tmp_path)
+    assert sum(mat) == 0, f"materialized {sum(mat)} rows in update_rows"
+    vals = sorted(int(r["v"]) for r in body)
+    expect = sorted([i for i in range(25)] + [100 + i for i in range(25, 60)])
+    assert vals == expect
+
+
+def test_update_cells_token_resident(tmp_path):
+    left = _jsonl_table(
+        tmp_path, "l.jsonl",
+        [{"k": i, "v": i} for i in range(40)], _XY,
+    ).with_id_from(pw.this.k)
+    right = _jsonl_table(
+        tmp_path, "r.jsonl",
+        [{"k": i, "v": 1000 + i} for i in range(10, 20)], _XY,
+    ).with_id_from(pw.this.k)
+    res = left.update_cells(right.select(right.v))
+    with _count_materialize() as mat:
+        body = _run_csv(res, tmp_path)
+    assert sum(mat) == 0, f"materialized {sum(mat)} rows in update_cells"
+    got = {int(r["k"]): int(r["v"]) for r in body}
+    for i in range(40):
+        assert got[i] == (1000 + i if 10 <= i < 20 else i)
+
+
+# ------------------------------------------------------------------- set ops
+
+
+def test_set_ops_token_resident(tmp_path):
+    a = _jsonl_table(
+        tmp_path, "a.jsonl", [{"k": i, "v": i} for i in range(30)], _XY
+    ).with_id_from(pw.this.k)
+    b = _jsonl_table(
+        tmp_path, "b.jsonl", [{"k": i, "v": i} for i in range(20, 50)], _XY
+    ).with_id_from(pw.this.k)
+    import csv as _csv
+
+    inter = a.intersect(b)
+    diff = a.difference(b)
+    iout = tmp_path / "i.csv"
+    dout = tmp_path / "d.csv"
+    pw.io.csv.write(inter, str(iout))
+    pw.io.csv.write(diff, str(dout))
+    with _count_materialize() as mat:
+        pw.run()
+    assert sum(mat) == 0, f"materialized {sum(mat)} rows in set ops"
+
+    def ks(path):
+        with open(path, newline="") as f:
+            rows = list(_csv.reader(f))
+        ki = rows[0].index("k")
+        return sorted(int(r[ki]) for r in rows[1:])
+
+    assert ks(iout) == list(range(20, 30))
+    assert ks(dout) == list(range(20))
+
+
+# ------------------------------------------------------------------------ ix
+
+
+def test_ix_token_resident(tmp_path):
+    class _Ref(pw.Schema):
+        name: str
+        owner: int
+
+    people = _jsonl_table(
+        tmp_path, "p.jsonl",
+        [{"k": i, "v": i * 11} for i in range(20)], _XY,
+    ).with_id_from(pw.this.k)
+    pets = _jsonl_table(
+        tmp_path, "q.jsonl",
+        [{"name": f"pet{i}", "owner": i % 20} for i in range(60)], _Ref,
+    )
+    pets2 = pets.with_columns(optr=people.pointer_from(pw.this.owner))
+    looked = pets2.select(owner_v=people.ix(pets2.optr).v)
+    with _count_materialize() as mat:
+        body = _run_csv(looked, tmp_path)
+    assert sum(mat) == 0, f"materialized {sum(mat)} rows in ix"
+    assert sorted(int(r["owner_v"]) for r in body) == sorted(
+        (i % 20) * 11 for i in range(60)
+    )
+
+
+# ------------------------------------------------------------------- flatten
+
+
+def test_flatten_str_token_resident(tmp_path):
+    class _S(pw.Schema):
+        w: str
+
+    t = _jsonl_table(
+        tmp_path, "w.jsonl",
+        [{"w": w} for w in ["héllo", "ab", "", "x"]], _S,
+    )
+    flat = t.flatten(t.w)
+    with _count_materialize() as mat:
+        body = _run_csv(flat, tmp_path)
+    assert sum(mat) == 0, f"materialized {sum(mat)} rows in flatten"
+    assert sorted(r["w"] for r in body) == sorted("hélloabx")
+
+
+def test_flatten_tuple_column_still_works(tmp_path):
+    rows = [(1, (1, 2, 3)), (2, (4,))]
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int, tup=tuple), rows
+    )
+    flat = t.flatten(t.tup)
+    _ids, cols = _dicts(flat)
+    assert sorted(cols["tup"].values()) == [1, 2, 3, 4]
+
+
+# --------------------------------------------------------------- deduplicate
+
+
+def test_deduplicate_token_resident(tmp_path):
+    t = _jsonl_table(
+        tmp_path, "d.jsonl",
+        [{"k": i % 5, "v": i} for i in range(100)], _XY,
+    )
+    res = t.deduplicate(
+        value=pw.this.v, instance=pw.this.k, acceptor=lambda new, old: new > old
+    )
+    with _count_materialize() as mat:
+        body = _run_csv(res, tmp_path)
+    got = {}
+    for r in body:  # csv stream: the last write per key wins
+        got[int(r["k"])] = int(r["v"])
+    assert sum(mat) == 0, f"materialized {sum(mat)} rows in deduplicate"
+    assert got == {j: 95 + j for j in range(5)}  # max v per instance
+
+
+def test_deduplicate_str_value(tmp_path):
+    class _S(pw.Schema):
+        g: int
+        s: str
+
+    t = _jsonl_table(
+        tmp_path, "s.jsonl",
+        [{"g": i % 3, "s": f"s{i:03d}"} for i in range(30)], _S,
+    )
+    res = t.deduplicate(
+        value=pw.this.s, instance=pw.this.g,
+        acceptor=lambda new, old: new > old,
+    )
+    with _count_materialize() as mat:
+        _ids, cols = _dicts(res)
+    # the capture boundary itself materializes; state upkeep must not
+    assert sum(mat) <= 3
+    assert sorted(cols["s"].values()) == ["s027", "s028", "s029"]
+
+
+def test_deduplicate_no_instance(tmp_path):
+    t = _jsonl_table(
+        tmp_path, "d.jsonl", [{"k": i, "v": i} for i in range(20)], _XY
+    )
+    res = t.deduplicate(value=pw.this.v, acceptor=lambda new, old: new > old)
+    _ids, cols = _dicts(res)
+    assert list(cols["v"].values()) == [19]
+
+
+# ------------------------------------------------------------- temporal trio
+
+
+def test_tumbling_window_token_resident(tmp_path):
+    class _Ev(pw.Schema):
+        t: int
+        v: int
+
+    t = _jsonl_table(
+        tmp_path, "e.jsonl",
+        [{"t": i, "v": i} for i in range(100)], _Ev,
+    )
+    win = pw.temporal.windowby(
+        t, t.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.exactly_once_behavior(),
+    )
+    res = win.reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+        sv=pw.reducers.sum(pw.this.v),
+    )
+    with _count_materialize() as mat:
+        body = _run_csv(res, tmp_path)
+    assert sum(mat) == 0, f"materialized {sum(mat)} rows in windowby"
+    got = {int(r["start"]): (int(r["n"]), int(r["sv"])) for r in body}
+    assert got == {
+        10 * w: (10, sum(range(10 * w, 10 * w + 10))) for w in range(10)
+    }
+
+
+def test_forget_cutoff_token_resident(tmp_path):
+    class _Ev(pw.Schema):
+        t: int
+        v: int
+
+    t = _jsonl_table(
+        tmp_path, "e.jsonl", [{"t": i, "v": i} for i in range(50)], _Ev
+    )
+    win = pw.temporal.windowby(
+        t, t.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(cutoff=100, keep_results=False),
+    )
+    res = win.reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    with _count_materialize() as mat:
+        body = _run_csv(res, tmp_path)
+    assert sum(mat) == 0
+    got = {}
+    for r in body:
+        got[int(r["start"])] = got.get(int(r["start"]), 0) + int(r["diff"]) if False else int(r["n"])
+    assert sorted(got.values()) == [10] * 5
+
+
+# ------------------------------------------------------------------ demotion
+
+
+def test_update_rows_demotes_on_tuple_rows():
+    """A wave carrying plane-unrepresentable rows demotes the node to the
+    object plane mid-run, with identical results."""
+    rows_l = [(i, (i, i + 1)) for i in range(10)]
+    rows_r = [(i, (100 + i,)) for i in range(5, 15)]
+    sch = pw.schema_from_types(a=int, tup=tuple)
+    left = pw.debug.table_from_rows(sch, rows_l).with_id_from(pw.this.a)
+    right = pw.debug.table_from_rows(sch, rows_r).with_id_from(pw.this.a)
+    res = left.update_rows(right)
+    _ids, cols = _dicts(res)
+    got = {cols["a"][i]: cols["tup"][i] for i in cols["a"]}
+    for i in range(5):
+        assert got[i] == (i, i + 1)
+    for i in range(5, 15):
+        assert got[i] == (100 + i,)
+
+
+def test_dedup_demotes_on_none_values(tmp_path):
+    """None in the value column is outside the numeric decode: the node
+    demotes and the object path's semantics take over seamlessly."""
+
+    class _S(pw.Schema):
+        g: int
+        v: int | None
+
+    t = _jsonl_table(
+        tmp_path, "n.jsonl",
+        [{"g": 0, "v": 1}, {"g": 0, "v": None}, {"g": 0, "v": 7}], _S,
+    )
+    res = t.deduplicate(
+        value=pw.this.v, instance=pw.this.g,
+        acceptor=lambda new, old: (new or 0) > (old or 0),
+    )
+    _ids, cols = _dicts(res)
+    assert list(cols["v"].values()) == [7]
+
+
+# ------------------------------------------------- snapshots across planes
+
+
+def test_tok_state_snapshot_roundtrip(tmp_path):
+    """Token-mode nodes snapshot in the plane-neutral object form and
+    restore into token mode (re-interning rows)."""
+    from pathway_tpu.engine.core import Graph, InputNode, UpdateRowsNode
+    from pathway_tpu.internals.keys import key_for_values
+
+    g = Graph()
+    left = InputNode(g)
+    right = InputNode(g)
+    node = UpdateRowsNode(g, left, right)
+    assert node._tok
+    k1, k2 = key_for_values(1), key_for_values(2)
+    left.push([(k1, (1, "a"), 1)])
+    right.push([(k2, (2, "b"), 1)])
+    g.step(0)
+    st = node.persist_state()
+    # object-form snapshot: keyed by Key, row tuples
+    assert all(hasattr(k, "value") for k in st["left"].rows)
+
+    g2 = Graph()
+    node2 = UpdateRowsNode(g2, InputNode(g2), InputNode(g2))
+    node2.restore_state(st)
+    assert node2._tok
+    assert node2.left[k1.value] == node2._tab.intern_row((1, "a"))
+    assert node2.emitted[k2.value] == node2._tab.intern_row((2, "b"))
+
+    # restoring rows that cannot enter the plane demotes cleanly
+    from pathway_tpu.engine.core import KeyedState
+
+    st_obj = {
+        "left": KeyedState(),
+        "right": KeyedState(),
+        "emitted": {},
+    }
+    st_obj["left"].rows[k1] = ((1, 2), "tuple-valued")
+    node3 = UpdateRowsNode(Graph(), InputNode(Graph()), InputNode(Graph()))
+    node3.restore_state(st_obj)
+    assert not node3._tok
+    assert node3.left.get(k1) == ((1, 2), "tuple-valued")
