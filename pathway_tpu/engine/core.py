@@ -1039,6 +1039,287 @@ def _flatten_segments(batches, entries) -> list[Entry]:
     return flat
 
 
+_EMPTY_U64 = np.empty(0, np.uint64)
+_EMPTY_I64 = np.empty(0, np.int64)
+_MISSING_SENTINEL = object()  # "no previous value" marker (None is a value)
+
+
+def _wave_arrays(tab, batches, entries):
+    """One wave as (lo, hi, tok, diff) numpy columns — the array twin of
+    `_wave_triples` for nodes whose whole wave logic is vectorized (no
+    per-row tuples ever built). None when an object entry is not
+    plane-representable (caller demotes)."""
+    los, his, tks, dfs = [], [], [], []
+    for b in batches:
+        los.append(np.asarray(b.key_lo, np.uint64))
+        his.append(np.asarray(b.key_hi, np.uint64))
+        tks.append(np.asarray(b.token, np.uint64))
+        dfs.append(np.asarray(b.diff, np.int64))
+    if entries:
+        n = len(entries)
+        elo = np.empty(n, np.uint64)
+        ehi = np.empty(n, np.uint64)
+        etk = np.empty(n, np.uint64)
+        edf = np.empty(n, np.int64)
+        for i, (key, row, d) in enumerate(entries):
+            t = tab.intern_row(row)
+            if t is None:
+                return None
+            kv = key.value
+            elo[i] = kv & _MASK64
+            ehi[i] = kv >> 64
+            etk[i] = t
+            edf[i] = d
+        los.append(elo)
+        his.append(ehi)
+        tks.append(etk)
+        dfs.append(edf)
+    if not los:
+        return _EMPTY_U64, _EMPTY_U64, _EMPTY_U64, _EMPTY_I64
+    if len(los) == 1:
+        return los[0], his[0], tks[0], dfs[0]
+    return (
+        np.concatenate(los),
+        np.concatenate(his),
+        np.concatenate(tks),
+        np.concatenate(dfs),
+    )
+
+
+_VOID16 = np.dtype((np.void, 16))
+
+
+def _void16(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    """(lo, hi) uint64 columns as one void16 array — hashable 128-bit key
+    cells for vectorized membership (np.isin) without Python bigints."""
+    a = np.empty((len(lo), 2), np.uint64)
+    a[:, 0] = lo
+    a[:, 1] = hi
+    return a.reshape(-1).view(_VOID16)
+
+
+def _kvs_of(lo: np.ndarray, hi: np.ndarray) -> list[int]:
+    """Python bigint kvs for (lo, hi) columns (rare paths / state dicts)."""
+    return [
+        (h << 64) | l for h, l in zip(hi.tolist(), lo.tolist())
+    ]
+
+
+def _kv_cols(kvs) -> tuple[np.ndarray, np.ndarray]:
+    """Bigint kvs -> (lo, hi) uint64 columns."""
+    n = len(kvs)
+    lo = np.empty(n, np.uint64)
+    hi = np.empty(n, np.uint64)
+    for i, kv in enumerate(kvs):
+        lo[i] = kv & _MASK64
+        hi[i] = kv >> 64
+    return lo, hi
+
+
+class _Key128Set:
+    """Set of 128-bit keys as numpy void16 cells: O(1) amortized bulk
+    adds, vectorized membership, bigints only on demand (demote/
+    snapshot). Replaces per-row Python-int sets on hot paths
+    (BufferNode.released holds every row ever released).
+
+    Layout: one sorted-unique base array + small pending chunks; pending
+    folds into the base (sort + unique) only when it outgrows half the
+    base, so total maintenance is O(n log n) amortized and memory stays
+    bounded by the DISTINCT key count — matching the set it replaces."""
+
+    __slots__ = ("_base", "_pending", "_pending_n")
+
+    def __init__(self):
+        self._base: np.ndarray | None = None  # sorted unique void16
+        self._pending: list[np.ndarray] = []
+        self._pending_n = 0
+
+    def add_arrays(self, lo: np.ndarray, hi: np.ndarray) -> None:
+        if len(lo):
+            self._pending.append(_void16(lo, hi))
+            self._pending_n += len(lo)
+            base_n = 0 if self._base is None else len(self._base)
+            if self._pending_n * 2 > base_n:
+                self._compact()
+
+    def _compact(self) -> None:
+        parts = self._pending if self._base is None else [self._base, *self._pending]
+        self._base = np.unique(np.concatenate(parts))
+        self._pending = []
+        self._pending_n = 0
+
+    def add_kvs(self, kvs) -> None:
+        if kvs:
+            self.add_arrays(*_kv_cols(list(kvs)))
+
+    def contains(self, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+        """Vectorized membership mask for (lo, hi) columns. Pending
+        chunks are probed directly (they are small by the add_arrays
+        threshold) — no per-call re-sort of the whole history."""
+        cand = _void16(lo, hi)
+        if self._base is not None and len(self._base):
+            pos = np.searchsorted(self._base, cand)
+            pos[pos == len(self._base)] = 0
+            mask = self._base[pos] == cand
+        else:
+            mask = np.zeros(len(lo), bool)
+        for c in self._pending:
+            mask |= np.isin(cand, c)
+        return mask
+
+    def to_kv_set(self) -> set[int]:
+        if self._pending:
+            self._compact()
+        out: set[int] = set()
+        if self._base is not None and len(self._base):
+            pairs = self._base.view(np.uint64).reshape(-1, 2)
+            out.update(_kvs_of(pairs[:, 0], pairs[:, 1]))
+        return out
+
+    def __len__(self) -> int:
+        if self._pending:
+            self._compact()
+        return 0 if self._base is None else len(self._base)
+
+
+_F53 = 1 << 53  # largest contiguous exact-int range of float64
+
+
+class _Live128Map:
+    """{128-bit key -> (tok, thr[, diff])} as chunked numpy columns — the
+    ForgetNode live rows and (with_diff=True) the BufferNode pending rows
+    (each holds up to EVERY in-flight row; a dict of Python bigints would
+    dominate the wave).
+
+    Dict semantics replay positionally: each appended chunk preserves
+    ROW order, deletions are entries with tok == 0 (tokens start at 1),
+    and `_gather` keeps the LAST entry per key across the chronological
+    chunks, then drops deletion sentinels — exactly `live[kv] = ...` /
+    `live.pop(kv)` applied in arrival order, so a retract + re-insert of
+    the same row in one wave stays live and an insert + retract stays
+    dead.
+
+    Thresholds stay exact: chunks may be int64 or float64, and
+    `thr_compatible` refuses a mix of floats with ints beyond 2^53
+    (concatenation would round them) — the caller demotes to the
+    object plane's exact Python-scalar comparisons instead."""
+
+    __slots__ = ("_lo", "_hi", "_tok", "_thr", "_diff", "_big_int", "_float")
+
+    def __init__(self, with_diff: bool = False):
+        self._lo: list[np.ndarray] = []
+        self._hi: list[np.ndarray] = []
+        self._tok: list[np.ndarray] = []
+        self._thr: list[np.ndarray] = []
+        self._diff: list[np.ndarray] | None = [] if with_diff else None
+        self._big_int = False  # any stored int chunk with |thr| > 2^53
+        self._float = False  # any stored float chunk
+
+    def thr_compatible(self, thr: np.ndarray) -> bool:
+        """Would storing this thr chunk keep comparisons exact?"""
+        if thr.dtype.kind == "f":
+            return not self._big_int
+        if np.abs(thr).max(initial=0) > _F53:
+            return not self._float
+        return True
+
+    def apply(self, lo, hi, tok, thr, ins_mask, diff=None) -> None:
+        """One wave's worth of ops in row order: rows with ins_mask True
+        upsert (tok, thr[, diff]); rows with False delete their key."""
+        if not len(lo):
+            return
+        thr = np.asarray(thr)
+        if thr.dtype.kind == "f":
+            self._float = True
+        elif np.abs(thr).max(initial=0) > _F53:
+            self._big_int = True
+        self._lo.append(lo)
+        self._hi.append(hi)
+        self._tok.append(np.where(ins_mask, tok, np.uint64(0)))
+        self._thr.append(thr)
+        if self._diff is not None:
+            self._diff.append(
+                np.ones(len(lo), np.int64)
+                if diff is None
+                else np.asarray(diff, np.int64)
+            )
+
+    @staticmethod
+    def _cat(parts: list[np.ndarray]) -> np.ndarray:
+        if len(parts) == 1:
+            return parts[0]
+        return np.concatenate(
+            parts, dtype=np.result_type(*(p.dtype for p in parts))
+        )
+
+    def _gather(self):
+        """(lo, hi, tok, thr, diff|None) after replaying overwrites/
+        deletes (last entry per key wins; tok == 0 rows drop), or None
+        when empty."""
+        if not self._lo:
+            return None
+        lo = self._cat(self._lo)
+        hi = self._cat(self._hi)
+        tok = self._cat(self._tok)
+        thr = self._cat(self._thr)
+        diff = self._cat(self._diff) if self._diff is not None else None
+        keys = _void16(lo, hi)
+        # keep the last occurrence per key: unique on the reversed array
+        n = len(keys)
+        _, first_rev = np.unique(keys[::-1], return_index=True)
+        last = np.zeros(n, bool)
+        last[n - 1 - first_rev] = True
+        keep = last & (tok != 0)
+        lo, hi, tok, thr = lo[keep], hi[keep], tok[keep], thr[keep]
+        self._lo, self._hi, self._tok, self._thr = [lo], [hi], [tok], [thr]
+        if diff is not None:
+            diff = diff[keep]
+            self._diff = [diff]
+        if not len(lo):
+            return None
+        return lo, hi, tok, thr, diff
+
+    def expire(self, now):
+        """Pop rows with thr <= now. Returns (lo, hi, tok, diff|None) of
+        the popped rows; compacts the store to one chunk of survivors."""
+        g = self._gather()
+        if g is None:
+            return _EMPTY_U64, _EMPTY_U64, _EMPTY_U64, None
+        lo, hi, tok, thr, diff = g
+        exp = thr <= now
+        keep = ~exp
+        self._lo = [lo[keep]]
+        self._hi = [hi[keep]]
+        self._tok = [tok[keep]]
+        self._thr = [thr[keep]]
+        if diff is not None:
+            self._diff = [diff[keep]]
+        return lo[exp], hi[exp], tok[exp], diff[exp] if diff is not None else None
+
+    def items_arrays(self):
+        """(lo, hi, tok, thr, diff|None) of live rows (demote/snapshot)."""
+        return self._gather()
+
+
+def _plan_array(plan, decoded, n):
+    """Plan results as one numeric numpy column, or None (demote). Pure
+    int waves stay exact int64. Int/float mixes unify to float64 only
+    while every int is exactly representable (|v| <= 2^53); beyond that
+    the wave demotes so threshold comparisons keep exact Python-int
+    semantics (ns-epoch timestamps mixed with float durations)."""
+    vi, vf, tg = plan.eval_map(decoded, n)
+    if n == 0:
+        return vi[:0]
+    if (tg == 0).all():
+        return vi
+    if (tg <= 1).all():
+        is_int = tg == 0
+        if np.abs(vi[is_int]).max(initial=0) > (1 << 53):
+            return None
+        return np.where(is_int, vi.astype(np.float64), vf)
+    return None  # bool / None / error / fallback: object semantics
+
+
 class _TokTailNode(Node):
     """Shared machinery for token-resident stateful-tail nodes."""
 
@@ -1096,6 +1377,40 @@ class _TokTailNode(Node):
             if not len(nb):
                 return
         self.emit(time, nb)
+
+    def _emit_tok_arrays(
+        self, time: int, lo, hi, tok, diff, consolidate_out: bool = False
+    ) -> None:
+        """Array twin of _emit_tok: emit (lo, hi, tok, diff) columns as one
+        NativeBatch without materializing Python kv ints."""
+        if len(lo) == 0:
+            return
+        nb = self._dp.NativeBatch(
+            self._tab,
+            np.ascontiguousarray(lo, np.uint64),
+            np.ascontiguousarray(hi, np.uint64),
+            np.ascontiguousarray(tok, np.uint64),
+            np.ascontiguousarray(diff, np.int64),
+        )
+        if consolidate_out:
+            nb = nb.consolidate()
+            if not len(nb):
+                return
+        self.emit(time, nb)
+
+    def _demote_replay(self, lo, hi, tok, diff) -> list[Entry]:
+        """Demote with a wave already drained into arrays: decode it to
+        object entries (state converts via _demoted_state) so the caller
+        can replay it through its object path."""
+        tab = self._tab
+        tl = tok.tolist()
+        dl = diff.tolist()
+        entries = [
+            (Key(kv), tab.row(tl[i]), dl[i])
+            for i, kv in enumerate(_kvs_of(lo, hi))
+        ]
+        self._demote()
+        return entries
 
     def _requeue(self, raws: list) -> None:
         """Put drained segments back so the object path re-drains them."""
@@ -2704,68 +3019,138 @@ class DeduplicateNode(_TokTailNode):
 
     def _finish_tok(self, time: int) -> bool:
         raw = self.take_segments()
-        w = _wave_triples(self._tab, *raw)
+        w = _wave_arrays(self._tab, *raw)
         if w is None:
             self._requeue([raw])
             self._demote()
             return False
-        if not w:
+        lo0, hi0, tok0, diff0 = w
+        if not len(lo0):
             return True
-        w.sort(key=lambda t: t[0])  # canonical within-wave order
-        ins = [(kv, tok) for kv, tok, d in w if d > 0]
-        if not ins:
+        ins = diff0 > 0
+        if not ins.any():
             return True
-        toks = np.fromiter((t for _kv, t in ins), np.uint64, len(ins))
+        lo, hi, tok = lo0[ins], hi0[ins], tok0[ins]
+        order = np.lexsort((lo, hi))  # canonical within-wave order
+        lo, hi, tok = lo[order], hi[order], tok[order]
+        n = len(tok)
         cfg = self._cfg
-        vals = self._decode_values(toks)
-        rk = res = None
-        if vals is not None and cfg["inst_cols"]:
-            res = self._dp.project_group(self._tab, toks, cfg["inst_cols"])
-            rk = self._dp.rekey(self._tab, toks, cfg["inst_cols"])
-        if vals is None or (
-            cfg["inst_cols"]
-            and (res is None or rk is None or ((rk[0] == 0) & (rk[1] == 0)).any())
-        ):
-            # value/instance not expressible in the token plane (None or
-            # ERROR values, unexpected types): object plane from here on
+        acceptor = self.acceptor
+        accepted = self.accepted
+
+        def _demote_full_wave() -> None:
             tab = self._tab
-            entries = [(Key(kv), tab.row(tok), d) for kv, tok, d in w]
+            tl = tok0.tolist()
+            dl = diff0.tolist()
+            entries = [
+                (Key(kv), tab.row(tl[i]), dl[i])
+                for i, kv in enumerate(_kvs_of(lo0, hi0))
+            ]
             self._demote()
             self._finish_object(time, entries)
-            return True
+
+        gts = None
+        rep_ug = rep_ilo = rep_ihi = None
         if cfg["inst_cols"]:
-            gts = res[0].tolist()
-            ilo = rk[0].tolist()
-            ihi = rk[1].tolist()
+            res = self._dp.project_group(self._tab, tok, cfg["inst_cols"])
+            if res is None:
+                _demote_full_wave()
+                return True
+            gts = res[0]
+            # rekey pre-flight on ONE representative row per group (the
+            # instance key is a pure function of the group token): any
+            # unkeyable instance demotes BEFORE the acceptor runs, so the
+            # acceptor is never invoked twice for a row (once here, once
+            # in the object replay)
+            rep_ug, rep_idx = np.unique(gts, return_index=True)
+            rkr = self._dp.rekey(self._tab, tok[rep_idx], cfg["inst_cols"])
+            if rkr is None or ((rkr[0] == 0) & (rkr[1] == 0)).any():
+                _demote_full_wave()
+                return True
+            rep_ilo, rep_ihi = rkr
+
+        # Phase 1 — fold winners per group WITHOUT touching state:
+        # widx[g] = winning row index this wave, touched[g] = accepted
+        # entry at wave start. State mutates only after the pre-flight
+        # checks below, so a demotion mid-wave replays cleanly.
+        touched: dict = {}
+        widx: dict = {}
+        if acceptor is None:
+            # keep-latest: winner is the last row per group in canonical
+            # order — whole wave folds vectorized, no per-row Python
+            if gts is None:
+                widx[0] = n - 1
+                touched[0] = accepted.get(0)
+            else:
+                _u, first_rev = np.unique(gts[::-1], return_index=True)
+                idxs = n - 1 - first_rev
+                for g, i in zip(gts[idxs].tolist(), idxs.tolist()):
+                    widx[g] = i
+                    touched[g] = accepted.get(g)
         else:
-            gts = None
-        accepted = self.accepted
-        acceptor = self.acceptor
+            vals = self._decode_values(tok)
+            if vals is None:
+                _demote_full_wave()
+                return True
+            gl = gts.tolist() if gts is not None else None
+            log_error = self.log_error
+            _miss = _MISSING_SENTINEL
+            for i in range(n):
+                g = gl[i] if gl is not None else 0
+                j = widx.get(g)
+                if j is not None:
+                    pv = vals[j]
+                else:
+                    pa = accepted.get(g)
+                    if pa is None:
+                        pv = _miss
+                    else:
+                        pv = pa[2]
+                try:
+                    ok = True if pv is _miss else acceptor(vals[i], pv)
+                except Exception as e:  # noqa: BLE001
+                    log_error(f"deduplicate acceptor: {e}")
+                    ok = False
+                if ok:
+                    if g not in touched:
+                        touched[g] = accepted.get(g)
+                    widx[g] = i
+        if not widx:
+            return True
+
+        # Phase 2 — materialize winner identity (kv/tok/ikv) for the few
+        # winning rows only; the instance keys come from the pre-flighted
+        # per-group representatives (rekey never runs over the full wave).
+        groups = list(widx)
+        idx_arr = np.fromiter(widx.values(), np.int64, len(groups))
+        if cfg["inst_cols"]:
+            pos = np.searchsorted(
+                rep_ug, np.asarray(groups, rep_ug.dtype)
+            )
+            ikvs = _kvs_of(rep_ilo[pos], rep_ihi[pos])
+        else:
+            ikvs = [self._const_ikv] * len(groups)
+        wkvs = _kvs_of(lo[idx_arr], hi[idx_arr])
+        wtoks = tok[idx_arr].tolist()
+        if acceptor is None:
+            wvals = [None] * len(groups)
+        else:
+            wvals = [vals[i] for i in widx.values()]
         kvs: list = []
         toks_o: list = []
         diffs: list = []
-        for i, (kv, tok) in enumerate(ins):
-            g = gts[i] if gts is not None else 0
-            prev = accepted.get(g)
-            try:
-                ok = acceptor(vals[i], prev[2]) if prev is not None else True
-            except Exception as e:  # noqa: BLE001
-                self.log_error(f"deduplicate acceptor: {e}")
-                ok = False
-            if ok:
-                ikv = (
-                    ((ihi[i] << 64) | ilo[i])
-                    if gts is not None
-                    else self._const_ikv
-                )
-                if prev is not None:
-                    kvs.append(ikv)
-                    toks_o.append(prev[1])
-                    diffs.append(-1)
-                kvs.append(ikv)
-                toks_o.append(tok)
-                diffs.append(1)
-                accepted[g] = (kv, tok, vals[i], ikv)
+        for j, g in enumerate(groups):
+            orig = touched[g]
+            accepted[g] = (wkvs[j], wtoks[j], wvals[j], ikvs[j])
+            if orig is not None:
+                if orig[1] == wtoks[j] and orig[3] == ikvs[j]:
+                    continue  # wave ended on the row it started with
+                kvs.append(orig[3])
+                toks_o.append(orig[1])
+                diffs.append(-1)
+            kvs.append(ikvs[j])
+            toks_o.append(wtoks[j])
+            diffs.append(1)
         self._emit_tok(time, kvs, toks_o, diffs, consolidate_out=True)
         return True
 
@@ -2797,7 +3182,7 @@ class DeduplicateNode(_TokTailNode):
             try:
                 ok = (
                     self.acceptor(self.value_fn(key, row), self.value_fn(*prev))
-                    if prev is not None
+                    if prev is not None and self.acceptor is not None
                     else True
                 )
             except Exception as e:  # noqa: BLE001
@@ -3294,40 +3679,25 @@ class _TimeColNode(_TokTailNode):
                 native_plans[0].needed_cols | native_plans[1].needed_cols
             )
 
-    @staticmethod
-    def _plan_scalars(plan, decoded, n):
-        """Plan results as Python scalars, or None (demote)."""
-        vi, vf, tg = plan.eval_map(decoded, n)
-        tgl = tg.tolist()
-        vil = vi.tolist()
-        vfl = vf.tolist()
-        out = []
-        for i, t in enumerate(tgl):
-            if t == 0:
-                out.append(vil[i])
-            elif t == 1:
-                out.append(vfl[i])
-            else:  # None / bool / error / fallback: object semantics
-                return None
-        return out
-
     def _tok_wave(self, time: int):
-        """Drain + decode one wave: [(kv, tok, d)], thr[], cur[] — or None
-        after demotion (object path re-drains; nothing consumed)."""
+        """Drain + decode one wave: ((lo, hi, tok, diff) columns, thr[],
+        cur[] numeric arrays) — or None after demotion (object path
+        re-drains; nothing consumed)."""
         raw = self.take_segments()
-        w = _wave_triples(self._tab, *raw)
+        w = _wave_arrays(self._tab, *raw)
         thr = cur = None
-        if w:
-            toks = np.fromiter((t for _kv, t, _d in w), np.uint64, len(w))
-            decoded = decode_cols_dict(self._dp, self._tab, toks, self._needed_cols)
+        if w is not None and len(w[0]):
+            decoded = decode_cols_dict(self._dp, self._tab, w[2], self._needed_cols)
             if decoded is not None:
-                thr = self._plan_scalars(self._plans[0], decoded, len(w))
-                cur = self._plan_scalars(self._plans[1], decoded, len(w))
-        if w is None or (w and (thr is None or cur is None)):
+                thr = _plan_array(self._plans[0], decoded, len(w[0]))
+                cur = _plan_array(self._plans[1], decoded, len(w[0]))
+        if w is None or (len(w[0]) and (thr is None or cur is None)):
             self._requeue([raw])
             self._demote()
             return None
-        return w, thr or [], cur or []
+        if thr is None:
+            thr = cur = _EMPTY_I64
+        return w, thr, cur
 
     def _demote(self) -> None:
         if not self._tok:
@@ -3353,80 +3723,198 @@ class BufferNode(_TimeColNode):
         native_plans: tuple | None = None,
     ):
         super().__init__(graph, inp, threshold_fn, current_fn, native_plans)
-        # token mode: {kv -> (tok, diff, thr)}; object: {Key -> (row, diff, thr)}
-        self.pending: dict = {}
-        self.released: set[int] = set()
+        # token mode: _Live128Map pending (kv -> (tok, thr, diff) columns)
+        # + _Key128Set released; object: {Key -> (row, diff, thr)} + set
+        self.pending = _Live128Map(with_diff=True) if self._tok else {}
+        self.released = _Key128Set() if self._tok else set()
         self.flush_on_end = flush_on_end
         self._virtual_end = False
 
     def _demoted_state(self) -> dict:
         tab = self._tab
+        pending: dict = {}
+        g = self.pending.items_arrays()
+        if g is not None:
+            plo, phi, ptok, pthr, pdiff = g
+            tokl = ptok.tolist()
+            thrl = pthr.tolist()
+            dl = pdiff.tolist()
+            for i, kv in enumerate(_kvs_of(plo, phi)):
+                pending[Key(kv)] = (tab.row(tokl[i]), dl[i], thrl[i])
         return {
             "now": self.now,
-            "pending": {
-                Key(kv): (tab.row(t), d, thr)
-                for kv, (t, d, thr) in self.pending.items()
-            },
-            "released": set(self.released),
+            "pending": pending,
+            "released": self.released.to_kv_set(),
         }
 
     def _encode_state(self, st: dict) -> bool:
         tab = self._tab
-        pending = {}
-        for key, (row, d, thr) in st["pending"].items():
+        n = len(st["pending"])
+        lo = np.empty(n, np.uint64)
+        hi = np.empty(n, np.uint64)
+        tok = np.empty(n, np.uint64)
+        dif = np.empty(n, np.int64)
+        thr_f = np.empty(n, np.float64)
+        thr_i = np.empty(n, np.int64)
+        all_int = True
+        any_big = False
+        for i, (key, (row, d, thr)) in enumerate(st["pending"].items()):
             t = tab.intern_row(row)
-            if t is None:
+            if t is None or not isinstance(thr, (int, float)):
                 return False
-            pending[key.value] = (t, d, thr)
+            kv = key.value
+            lo[i] = kv & _MASK64
+            hi[i] = kv >> 64
+            tok[i] = t
+            dif[i] = d
+            if isinstance(thr, int) and abs(thr) < (1 << 63):
+                thr_i[i] = thr
+                thr_f[i] = thr
+                any_big = any_big or abs(thr) > _F53
+            else:
+                all_int = False
+                thr_f[i] = thr
+        if not all_int and any_big:
+            return False  # float64 storage would round the big ints
         self.now = st["now"]
-        self.pending = pending
-        self.released = set(st["released"])
+        self.pending = _Live128Map(with_diff=True)
+        self.pending.apply(
+            lo, hi, tok, thr_i if all_int else thr_f,
+            np.ones(n, bool), diff=dif,
+        )
+        self.released = _Key128Set()
+        self.released.add_kvs(st["released"])
         return True
 
     def _finish_tok(self, time: int) -> bool:
         res = self._tok_wave(time)
         if res is None:
             return False
-        w, thr, cur = res
-        if not w:
+        (lo, hi, tok, diff), thr, cur = res
+        n = len(lo)
+        if not n:
+            return True
+        pending = self.pending
+        if not pending.thr_compatible(thr):
+            # mixing float thresholds with >2^53 ints would round them:
+            # fall back to the object plane's exact scalar comparisons
+            self._finish_object(time, self._demote_replay(lo, hi, tok, diff))
             return True
         now = self.now
-        for c in cur:
-            if now is None or c > now:
-                now = c
+        if len(cur):
+            cmax = cur.max().item()
+            if now is None or cmax > now:
+                now = cmax
         self.now = now
-        released = self.released
-        pending = self.pending
-        kvs: list = []
-        toks: list = []
-        diffs: list = []
-        for (kv, tok, d), th in zip(w, thr):
-            if kv in released or (now is not None and th <= now):
-                released.add(kv)
-                kvs.append(kv)
-                toks.append(tok)
-                diffs.append(d)
-                pending.pop(kv, None)
-            elif d > 0:
-                pending[kv] = (tok, d, th)
-            else:
-                pending.pop(kv, None)
-        if now is not None and pending:
-            ready = [kv for kv, (_t, _d, th) in pending.items() if th <= now]
-            for kv in ready:
-                tok, d, _th = pending.pop(kv)
-                released.add(kv)
-                kvs.append(kv)
-                toks.append(tok)
-                diffs.append(d)
-        self._emit_tok(time, kvs, toks, diffs, consolidate_out=True)
+        # bulk path: watermark already passed the row's threshold
+        rel = (
+            thr <= now if now is not None else np.zeros(n, bool)
+        )
+        extras: list = []  # (kv, tok, d) released via membership
+        nr_idx = np.flatnonzero(~rel)
+        rel_idx = np.flatnonzero(rel)
+        if nr_idx.size and rel_idx.size:
+            # keys with BOTH released and ahead-of-watermark rows in one
+            # wave (in-wave time corrections) are order-sensitive: a row
+            # releasing the key makes every LATER row of that key pass
+            # through. Replay exactly the object algorithm, in row order,
+            # for those keys only.
+            keyv = _void16(lo, hi)
+            inter = np.intersect1d(keyv[rel_idx], keyv[nr_idx])
+            if inter.size:
+                im = np.isin(keyv, inter)
+                im_idx = np.flatnonzero(im)  # ascending = original order
+                rel_idx = np.flatnonzero(rel & ~im)
+                nr_idx = np.flatnonzero(~rel & ~im)
+                premem = self.released.contains(
+                    lo[im_idx], hi[im_idx]
+                ).tolist()
+                kv_i = _kvs_of(lo[im_idx], hi[im_idx])
+                tok_i = tok[im_idx].tolist()
+                d_i = diff[im_idx].tolist()
+                thr_i = thr[im_idx].tolist()
+                wave_released: set = set()
+                for j, kv in enumerate(kv_i):
+                    one = slice(im_idx[j], im_idx[j] + 1)
+                    if (
+                        kv in wave_released
+                        or premem[j]
+                        or (now is not None and thr_i[j] <= now)
+                    ):
+                        wave_released.add(kv)
+                        extras.append((kv, tok_i[j], d_i[j]))
+                        pending.apply(  # pop the key if pended
+                            lo[one], hi[one], tok[one], thr[one],
+                            np.zeros(1, bool),
+                        )
+                    else:
+                        pending.apply(
+                            lo[one], hi[one], tok[one], thr[one],
+                            np.asarray([d_i[j] > 0]), diff=diff[one],
+                        )
+        if nr_idx.size:
+            # rows ahead of the watermark: released-set membership decides
+            # pass-through vs pending upsert/delete (bulk, row order)
+            member = self.released.contains(lo[nr_idx], hi[nr_idx])
+            if member.any():
+                m_idx = nr_idx[member]
+                kv_m = _kvs_of(lo[m_idx], hi[m_idx])
+                tok_m = tok[m_idx].tolist()
+                d_m = diff[m_idx].tolist()
+                extras.extend(zip(kv_m, tok_m, d_m))
+            pending.apply(
+                lo[nr_idx], hi[nr_idx], tok[nr_idx], thr[nr_idx],
+                (diff[nr_idx] > 0) & ~member, diff=diff[nr_idx],
+            )
+        if rel_idx.size:
+            rlo, rhi = lo[rel_idx], hi[rel_idx]
+            self.released.add_arrays(rlo, rhi)
+            # a pending key released by this wave leaves the buffer
+            # (delete ops; O(released) appends, no pending scan)
+            pending.apply(
+                rlo, rhi, tok[rel_idx], thr[rel_idx],
+                np.zeros(len(rel_idx), bool),
+            )
+        parts_lo = [lo[rel_idx]]
+        parts_hi = [hi[rel_idx]]
+        parts_tok = [tok[rel_idx]]
+        parts_diff = [diff[rel_idx]]
+        if now is not None:
+            # release pending rows whose threshold has passed
+            plo, phi, ptok, pdiff = pending.expire(now)
+            if len(plo):
+                self.released.add_arrays(plo, phi)
+                parts_lo.append(plo)
+                parts_hi.append(phi)
+                parts_tok.append(ptok)
+                parts_diff.append(pdiff)
+        if extras:
+            self.released.add_kvs([kv for kv, _t, _d in extras])
+            elo, ehi = _kv_cols([kv for kv, _t, _d in extras])
+            parts_lo.append(elo)
+            parts_hi.append(ehi)
+            parts_tok.append(
+                np.asarray([t for _kv, t, _d in extras], np.uint64)
+            )
+            parts_diff.append(
+                np.asarray([d for _kv, _t, d in extras], np.int64)
+            )
+        self._emit_tok_arrays(
+            time,
+            np.concatenate(parts_lo),
+            np.concatenate(parts_hi),
+            np.concatenate(parts_tok),
+            np.concatenate(parts_diff),
+            consolidate_out=True,
+        )
         return True
 
     def finish_time(self, time: int) -> None:
-        if self._tok:
-            if self._finish_tok(time):
-                return
-        entries = self.take_input()
+        if self._tok and self._finish_tok(time):
+            return
+        self._finish_object(time, self.take_input())
+
+    def _finish_object(self, time: int, entries: list[Entry]) -> None:
         if not entries:
             return
         # The watermark ("now") advances once per wave, not per row: every
@@ -3459,15 +3947,20 @@ class BufferNode(_TimeColNode):
         self.emit(time, consolidate(out))
 
     def on_end(self, time: int) -> None:
-        if not (self.flush_on_end and self.pending):
+        if not self.flush_on_end:
             return
         if self._tok:
-            kvs = list(self.pending)
-            toks = [t for t, _d, _th in self.pending.values()]
-            diffs = [d for _t, d, _th in self.pending.values()]
-            self.pending.clear()
-            self.released.update(kvs)
-            self._emit_tok(time, kvs, toks, diffs, consolidate_out=True)
+            g = self.pending.items_arrays()
+            self.pending = _Live128Map(with_diff=True)
+            if g is None:
+                return
+            plo, phi, ptok, _pthr, pdiff = g
+            self.released.add_arrays(plo, phi)
+            self._emit_tok_arrays(
+                time, plo, phi, ptok, pdiff, consolidate_out=True
+            )
+            return
+        if not self.pending:
             return
         out = [(k, row, diff) for k, (row, diff, _t) in self.pending.items()]
         self.pending.clear()
@@ -3492,65 +3985,97 @@ class ForgetNode(_TimeColNode):
         native_plans: tuple | None = None,
     ):
         super().__init__(graph, inp, threshold_fn, current_fn, native_plans)
-        # token mode: {kv -> (tok, thr)}; object: {Key -> (row, thr)}
-        self.live: dict = {}
+        # token mode: _Live128Map (kv -> (tok, thr) as numpy columns);
+        # object: {Key -> (row, thr)}
+        self.live = _Live128Map() if self._tok else {}
 
     def _demoted_state(self) -> dict:
         tab = self._tab
-        return {
-            "now": self.now,
-            "live": {
-                Key(kv): (tab.row(t), thr) for kv, (t, thr) in self.live.items()
-            },
-        }
+        live: dict = {}
+        g = self.live.items_arrays()
+        if g is not None:
+            lo, hi, tok, thr, _diff = g
+            thrl = thr.tolist()
+            tokl = tok.tolist()
+            for i, kv in enumerate(_kvs_of(lo, hi)):
+                live[Key(kv)] = (tab.row(tokl[i]), thrl[i])
+        return {"now": self.now, "live": live}
 
     def _encode_state(self, st: dict) -> bool:
         tab = self._tab
-        live = {}
-        for key, (row, thr) in st["live"].items():
+        n = len(st["live"])
+        lo = np.empty(n, np.uint64)
+        hi = np.empty(n, np.uint64)
+        tok = np.empty(n, np.uint64)
+        thr = np.empty(n, np.float64)
+        thr_i = np.empty(n, np.int64)
+        all_int = True
+        any_big = False
+        for i, (key, (row, th)) in enumerate(st["live"].items()):
             t = tab.intern_row(row)
             if t is None:
                 return False
-            live[key.value] = (t, thr)
+            if not isinstance(th, (int, float)):
+                return False
+            kv = key.value
+            lo[i] = kv & _MASK64
+            hi[i] = kv >> 64
+            tok[i] = t
+            if isinstance(th, int) and abs(th) < (1 << 63):
+                thr_i[i] = th
+                thr[i] = th
+                any_big = any_big or abs(th) > _F53
+            else:
+                all_int = False
+                thr[i] = th
+        if not all_int and any_big:
+            return False  # float64 storage would round the big ints
         self.now = st["now"]
-        self.live = live
+        self.live = _Live128Map()
+        self.live.apply(
+            lo, hi, tok, thr_i if all_int else thr, np.ones(n, bool)
+        )
         return True
 
     def _finish_tok(self, time: int) -> bool:
         res = self._tok_wave(time)
         if res is None:
             return False
-        w, thr, cur = res
-        if not w:
+        (lo, hi, tok, diff), thr, cur = res
+        n = len(lo)
+        if not n:
+            return True
+        live = self.live
+        if not live.thr_compatible(thr):
+            # mixing float thresholds with >2^53 ints would round them:
+            # fall back to the object plane's exact scalar comparisons
+            self._finish_object(time, self._demote_replay(lo, hi, tok, diff))
             return True
         now0 = self.now
-        live = self.live
-        kvs: list = []
-        toks: list = []
-        diffs: list = []
-        for (kv, tok, d), th in zip(w, thr):
-            if now0 is not None and th <= now0 and d > 0:
-                continue  # late row: ignore
-            kvs.append(kv)
-            toks.append(tok)
-            diffs.append(d)
-            if d > 0:
-                live[kv] = (tok, th)
-            else:
-                live.pop(kv, None)
+        # the watermark advances from EVERY row's current-time value —
+        # including late rows dropped below (object-plane parity)
         now = now0
-        for c in cur:
-            if now is None or c > now:
-                now = c
+        if len(cur):
+            cmax = cur.max().item()
+            if now is None or cmax > now:
+                now = cmax
+        if now0 is not None:
+            keep = ~((thr <= now0) & (diff > 0))  # drop late insertions
+            if not keep.all():
+                lo, hi, tok = lo[keep], hi[keep], tok[keep]
+                diff, thr = diff[keep], thr[keep]
+        live.apply(lo, hi, tok, thr, diff > 0)  # upserts + deletes, row order
         self.now = now
-        if now is not None and live:
-            expired = [kv for kv, (_t, th) in live.items() if th <= now]
-            for kv in expired:
-                tok, _th = live.pop(kv)
-                kvs.append(kv)
-                toks.append(tok)
-                diffs.append(-1)
-        self._emit_tok(time, kvs, toks, diffs, consolidate_out=True)
+        if now is not None:
+            elo, ehi, etok, _ed = live.expire(now)
+            if len(elo):
+                lo = np.concatenate([lo, elo])
+                hi = np.concatenate([hi, ehi])
+                tok = np.concatenate([tok, etok])
+                diff = np.concatenate(
+                    [diff, np.full(len(elo), -1, np.int64)]
+                )
+        self._emit_tok_arrays(time, lo, hi, tok, diff, consolidate_out=True)
         return True
 
     def finish_time(self, time: int) -> None:
@@ -3558,6 +4083,9 @@ class ForgetNode(_TimeColNode):
             if self._finish_tok(time):
                 return
         entries = self.take_input()
+        self._finish_object(time, entries)
+
+    def _finish_object(self, time: int, entries: list[Entry]) -> None:
         if not entries:
             return
         # Late-row checks use the PREVIOUS wave's watermark; the watermark
@@ -3616,24 +4144,21 @@ class FreezeNode(_TimeColNode):
         res = self._tok_wave(time)
         if res is None:
             return False
-        w, thr, cur = res
-        if not w:
+        (lo, hi, tok, diff), thr, cur = res
+        if not len(lo):
             return True
         now0 = self.now
+        if now0 is not None:
+            keep = thr > now0  # frozen region: drop the change
+            lo, hi, tok, diff = lo[keep], hi[keep], tok[keep], diff[keep]
+            cur = cur[keep]
         now = now0
-        kvs: list = []
-        toks: list = []
-        diffs: list = []
-        for (kv, tok, d), th, c in zip(w, thr, cur):
-            if now0 is not None and th <= now0:
-                continue  # frozen region: drop the change
-            kvs.append(kv)
-            toks.append(tok)
-            diffs.append(d)
-            if now is None or c > now:  # only accepted rows advance the clock
-                now = c
+        if len(cur):  # only accepted rows advance the clock
+            cmax = cur.max().item()
+            if now is None or cmax > now:
+                now = cmax
         self.now = now
-        self._emit_tok(time, kvs, toks, diffs, consolidate_out=True)
+        self._emit_tok_arrays(time, lo, hi, tok, diff, consolidate_out=True)
         return True
 
     def finish_time(self, time: int) -> None:

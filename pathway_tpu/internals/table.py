@@ -546,8 +546,9 @@ class Table:
         """
         value_e = wrap_arg(value) if value is not None else IdReference(self)
         instance_e = wrap_arg(instance) if instance is not None else None
-        if acceptor is None:
-            acceptor = lambda new, old: True  # noqa: E731 - keep latest
+        # acceptor=None means keep-latest (always accept); the engine keeps
+        # it as None so the token plane can fold whole waves vectorized
+        # instead of calling a trivially-true Python acceptor per row
         spec = OpSpec(
             "deduplicate", [self], value=value_e, instance=instance_e, acceptor=acceptor
         )

@@ -31,9 +31,16 @@ def _num(v: Any) -> Any:
 
 
 class Window:
-    def assign(self, table: Table, time_expr: ColumnExpression) -> Table:
-        """Return table with added columns:
-        _pw_window_start, _pw_window_end, _pw_shard_time (original time)."""
+    def assign(
+        self,
+        table: Table,
+        time_expr: ColumnExpression,
+        extra: dict | None = None,
+    ) -> Table:
+        """Return table with added columns: _pw_window_start,
+        _pw_window_end, _pw_shard_time (original time), plus any `extra`
+        columns — folded into the SAME select where possible, so the
+        whole window assignment is one row-build pass over the wave."""
         raise NotImplementedError
 
 
@@ -43,7 +50,12 @@ class TumblingWindow(Window):
     origin: Any = None
     offset: Any = None
 
-    def assign(self, table: Table, time_expr: ColumnExpression) -> Table:
+    def assign(
+        self,
+        table: Table,
+        time_expr: ColumnExpression,
+        extra: dict | None = None,
+    ) -> Table:
         duration = self.duration
         origin = self.origin if self.origin is not None else self.offset
 
@@ -55,19 +67,21 @@ class TumblingWindow(Window):
             # window assignment and the behavior buffer. _pw_window is
             # the window START (it uniquely identifies a tumbling window
             # for a fixed duration; window_join applies one window to
-            # both sides, so equality semantics are unchanged).
+            # both sides, so equality semantics are unchanged). All four
+            # columns (plus extras) build in ONE select: repeating the
+            # start expression costs two vector subtracts, where a second
+            # select would re-build every row in the wave.
             delta = (
                 time_expr % duration
                 if origin is None
                 else (time_expr - origin) % duration
             )
-            t2 = table.with_columns(
+            return table.with_columns(
                 _pw_time=time_expr,
                 _pw_window_start=time_expr - delta,
-            )
-            return t2.with_columns(
-                _pw_window=ex.this._pw_window_start,
-                _pw_window_end=ex.this._pw_window_start + duration,
+                _pw_window=time_expr - delta,
+                _pw_window_end=time_expr - delta + duration,
+                **(extra or {}),
             )
 
         def win(t: Any) -> Any:
@@ -80,6 +94,7 @@ class TumblingWindow(Window):
         t2 = table.with_columns(
             _pw_window_start=apply_with_type(win, dt.ANY, time_expr),
             _pw_time=time_expr,
+            **(extra or {}),
         )
         return t2.with_columns(
             _pw_window=ex.this._pw_window_start,
@@ -99,7 +114,12 @@ class SlidingWindow(Window):
     origin: Any = None
     offset: Any = None
 
-    def assign(self, table: Table, time_expr: ColumnExpression) -> Table:
+    def assign(
+        self,
+        table: Table,
+        time_expr: ColumnExpression,
+        extra: dict | None = None,
+    ) -> Table:
         hop = self.hop
         duration = self.duration if self.duration is not None else self.ratio * hop
         origin = self.origin if self.origin is not None else self.offset
@@ -124,6 +144,7 @@ class SlidingWindow(Window):
         expanded = table.with_columns(
             _pw_windows=apply_with_type(windows, tuple, time_expr),
             _pw_time=time_expr,
+            **(extra or {}),
         ).flatten(ex.this._pw_windows)
         return expanded.with_columns(
             _pw_window=ex.this._pw_windows,
@@ -266,11 +287,12 @@ def windowby(
     elif isinstance(window, IntervalsOverWindow):
         expanded = _windowby_intervals_over(table, time_expr, window, instance)
     else:
-        expanded = window.assign(table, time_expr)
-        if instance is not None:
-            expanded = expanded.with_columns(_pw_instance=instance)
-        else:
-            expanded = expanded.with_columns(_pw_instance=0)
+        # _pw_instance folds into the window-assign select: one row-build
+        # pass for the whole assignment instead of a second full-wave map
+        expanded = window.assign(
+            table, time_expr,
+            extra={"_pw_instance": instance if instance is not None else 0},
+        )
 
     if isinstance(behavior, ExactlyOnceBehavior):
         shift = behavior.shift

@@ -396,3 +396,160 @@ def test_tok_state_snapshot_roundtrip(tmp_path):
     node3.restore_state(st_obj)
     assert not node3._tok
     assert node3.left.get(k1) == ((1, 2), "tuple-valued")
+
+
+# ------------------------------------------------- array-state containers
+
+
+def test_live128map_retract_reinsert_one_wave():
+    """A retract + re-insert of the SAME row inside one wave must leave
+    the row live (dict pop-then-set semantics in arrival order), and an
+    insert + retract must leave it dead."""
+    import numpy as np
+
+    from pathway_tpu.engine.core import _Live128Map
+
+    m = _Live128Map()
+    one = np.ones(1, np.uint64)
+    # wave 1: key (1,1) goes live with tok 7
+    m.apply(one, one, np.asarray([7], np.uint64), np.asarray([100]), np.ones(1, bool))
+    # wave 2: [-key][+key] in row order (net zero, e.g. a join re-deriving)
+    m.apply(
+        np.asarray([1, 1], np.uint64),
+        np.asarray([1, 1], np.uint64),
+        np.asarray([7, 7], np.uint64),
+        np.asarray([100, 100]),
+        np.asarray([False, True]),
+    )
+    g = m.items_arrays()
+    assert g is not None and len(g[0]) == 1 and int(g[2][0]) == 7
+    # wave 3: [+key2][-key2] — transient row stays dead
+    two = np.full(1, 2, np.uint64)
+    m.apply(
+        np.asarray([2, 2], np.uint64),
+        np.asarray([2, 2], np.uint64),
+        np.asarray([9, 9], np.uint64),
+        np.asarray([50, 50]),
+        np.asarray([True, False]),
+    )
+    lo, hi, tok, _d = m.expire(60)
+    assert len(lo) == 0  # key2 is dead, key1's thr=100 > 60
+    lo, hi, tok, _d = m.expire(150)
+    assert len(lo) == 1 and int(tok[0]) == 7
+
+
+def test_key128set_membership_and_dedup():
+    import numpy as np
+
+    from pathway_tpu.engine.core import _Key128Set
+
+    s = _Key128Set()
+    assert not s.contains(np.asarray([1], np.uint64), np.asarray([0], np.uint64)).any()
+    s.add_arrays(np.asarray([1, 2, 2], np.uint64), np.asarray([0, 5, 5], np.uint64))
+    s.add_kvs([(5 << 64) | 2])
+    mask = s.contains(
+        np.asarray([1, 2, 3, 2], np.uint64), np.asarray([0, 5, 0, 5], np.uint64)
+    )
+    assert mask.tolist() == [True, True, False, True]
+    assert len(s) == 2  # duplicates collapse
+    assert s.to_kv_set() == {1, (5 << 64) | 2}
+
+
+_FORGET_EQ_SCRIPT = """
+import sys
+sys.path.insert(0, {repo!r})
+import pathway_tpu as pw
+
+t = pw.debug.table_from_markdown('''
+    t  | v | __time__ | __diff__
+    5  | 1 | 2        | 1
+    15 | 1 | 2        | 1
+    5  | 1 | 4        | -1
+    5  | 1 | 4        | 1
+    40 | 1 | 6        | 1
+''')
+win = pw.temporal.windowby(
+    t, t.t, window=pw.temporal.tumbling(duration=10),
+    behavior=pw.temporal.common_behavior(cutoff=15, keep_results=False),
+)
+res = win.reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+_ids, cols = pw.debug.table_to_dicts(res)
+out = sorted((int(v), int(cols["n"][k])) for k, v in cols["start"].items())
+print("RESULT", out)
+"""
+
+
+def test_forget_retract_reinsert_plane_equivalence(tmp_path):
+    """windowby forget pipeline with a retract+re-add wave agrees between
+    the token plane and the object plane (the native flag is read once
+    per process, so each leg runs in its own subprocess)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = _FORGET_EQ_SCRIPT.format(repo=repo)
+
+    def run(native: bool) -> str:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PATHWAY_TPU_NATIVE"] = "1" if native else "0"
+        r = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, env=env, timeout=240,
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("RESULT"):
+                return line
+        raise AssertionError(f"no RESULT: {r.stdout[-400:]} {r.stderr[-1500:]}")
+
+    native = run(True)
+    obj = run(False)
+    assert native == obj == "RESULT [(40, 1)]"
+
+
+_BUFFER_INTER_SCRIPT = """
+import sys
+sys.path.insert(0, {repo!r})
+import pathway_tpu as pw
+
+t = pw.debug.table_from_markdown('''
+    k | t  | __time__ | __diff__
+    a | 15 | 2        | 1
+    c | 30 | 4        | 1
+    a | 15 | 4        | -1
+    a | 35 | 4        | 1
+''', id_from=["k"])
+buf = t._buffer(pw.this.t, pw.this.t)
+_ids, cols = pw.debug.table_to_dicts(buf)
+out = sorted((v, int(cols["t"][k])) for k, v in cols["k"].items())
+print("RESULT", out)
+"""
+
+
+def test_buffer_inwave_release_then_readd_plane_equivalence():
+    """A wave that releases a key (watermark passes its threshold) and
+    re-adds the same key AHEAD of the watermark later in the wave must
+    pass the re-add through (in-wave released membership) — the
+    order-sensitive interacting-keys path of BufferNode._finish_tok."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = _BUFFER_INTER_SCRIPT.format(repo=repo)
+
+    def run(native: bool) -> str:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PATHWAY_TPU_NATIVE"] = "1" if native else "0"
+        r = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, env=env, timeout=240,
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("RESULT"):
+                return line
+        raise AssertionError(f"no RESULT: {r.stdout[-400:]} {r.stderr[-1500:]}")
+
+    native = run(True)
+    obj = run(False)
+    assert native == obj == "RESULT [('a', 35), ('c', 30)]"
