@@ -571,7 +571,7 @@ def bench_dataflow(repo: str) -> dict:
         )
         py_rate = _run_engine_script(
             wc_py, {"PATHWAY_THREADS": "1", "PATHWAY_TPU_NATIVE": "0"},
-            trials=2, stats=stats, rung="wordcount_python_rows_per_sec",
+            stats=stats, rung="wordcount_python_rows_per_sec",
         )
         out["wordcount_python_rows_per_sec"] = round(py_rate, 1)
         out["wordcount_native_vs_python"] = round(
@@ -626,7 +626,7 @@ def bench_dataflow(repo: str) -> dict:
         )
         win_py = _run_engine_script(
             ws_py, {"PATHWAY_THREADS": "1", "PATHWAY_TPU_NATIVE": "0"},
-            trials=2, stats=stats, rung="window_python_rows_per_sec",
+            stats=stats, rung="window_python_rows_per_sec",
         )
         out["window_python_rows_per_sec"] = round(win_py, 1)
         out["window_native_vs_python"] = round(
@@ -648,7 +648,7 @@ def bench_dataflow(repo: str) -> dict:
         )
         dd_py = _run_engine_script(
             ds_py, {"PATHWAY_THREADS": "1", "PATHWAY_TPU_NATIVE": "0"},
-            trials=2, stats=stats, rung="dedup_python_rows_per_sec",
+            stats=stats, rung="dedup_python_rows_per_sec",
         )
         out["dedup_python_rows_per_sec"] = round(dd_py, 1)
         out["dedup_native_vs_python"] = round(

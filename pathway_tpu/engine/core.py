@@ -1223,6 +1223,16 @@ class _Live128Map:
             return not self._float
         return True
 
+    def now_compatible(self, now) -> bool:
+        """Would `stored thr <= now` evaluate without rounding?"""
+        if now is None:
+            return True
+        if isinstance(now, float):
+            return not self._big_int
+        if abs(now) > _F53:
+            return not self._float
+        return True
+
     def apply(self, lo, hi, tok, thr, ins_mask, diff=None) -> None:
         """One wave's worth of ops in row order: rows with ins_mask True
         upsert (tok, thr[, diff]); rows with False delete their key."""
@@ -1299,6 +1309,19 @@ class _Live128Map:
     def items_arrays(self):
         """(lo, hi, tok, thr, diff|None) of live rows (demote/snapshot)."""
         return self._gather()
+
+
+def _thr_cmp_exact(thr: np.ndarray, now) -> bool:
+    """Can `thr <= now` evaluate without float64 rounding? (numpy casts
+    int64 to float64 when the other side is a float — exact only within
+    |v| <= 2^53; the object plane compares Python scalars exactly)."""
+    if now is None:
+        return True
+    if thr.dtype.kind == "i":
+        if isinstance(now, float):
+            return bool(np.abs(thr).max(initial=0) <= _F53)
+        return True
+    return not (isinstance(now, int) and abs(now) > _F53)
 
 
 def _plan_array(plan, decoded, n):
@@ -3039,15 +3062,9 @@ class DeduplicateNode(_TokTailNode):
         accepted = self.accepted
 
         def _demote_full_wave() -> None:
-            tab = self._tab
-            tl = tok0.tolist()
-            dl = diff0.tolist()
-            entries = [
-                (Key(kv), tab.row(tl[i]), dl[i])
-                for i, kv in enumerate(_kvs_of(lo0, hi0))
-            ]
-            self._demote()
-            self._finish_object(time, entries)
+            self._finish_object(
+                time, self._demote_replay(lo0, hi0, tok0, diff0)
+            )
 
         gts = None
         rep_ug = rep_ilo = rep_ihi = None
@@ -3773,6 +3790,9 @@ class BufferNode(_TimeColNode):
                 any_big = any_big or abs(thr) > _F53
             else:
                 all_int = False
+                # ints >= 2^63 don't fit int64 either: they force float
+                # storage AND are always beyond float64 exactness
+                any_big = any_big or isinstance(thr, int)
                 thr_f[i] = thr
         if not all_int and any_big:
             return False  # float64 storage would round the big ints
@@ -3795,16 +3815,22 @@ class BufferNode(_TimeColNode):
         if not n:
             return True
         pending = self.pending
-        if not pending.thr_compatible(thr):
-            # mixing float thresholds with >2^53 ints would round them:
-            # fall back to the object plane's exact scalar comparisons
-            self._finish_object(time, self._demote_replay(lo, hi, tok, diff))
-            return True
         now = self.now
         if len(cur):
             cmax = cur.max().item()
             if now is None or cmax > now:
                 now = cmax
+        if not (
+            pending.thr_compatible(thr)
+            and pending.now_compatible(now)
+            and _thr_cmp_exact(thr, now)
+        ):
+            # any float/big-int mix (stored, wave, or threshold-vs-
+            # watermark) would round: fall back to the object plane's
+            # exact Python-scalar comparisons. self.now is untouched —
+            # the object replay recomputes it from the same entries.
+            self._finish_object(time, self._demote_replay(lo, hi, tok, diff))
+            return True
         self.now = now
         # bulk path: watermark already passed the row's threshold
         rel = (
@@ -3852,16 +3878,15 @@ class BufferNode(_TimeColNode):
                             lo[one], hi[one], tok[one], thr[one],
                             np.asarray([d_i[j] > 0]), diff=diff[one],
                         )
+        member_idx = None
         if nr_idx.size:
             # rows ahead of the watermark: released-set membership decides
-            # pass-through vs pending upsert/delete (bulk, row order)
+            # pass-through vs pending upsert/delete (bulk, row order;
+            # member rows emit below as array slices — already released,
+            # so no set update and no Python bigints)
             member = self.released.contains(lo[nr_idx], hi[nr_idx])
             if member.any():
-                m_idx = nr_idx[member]
-                kv_m = _kvs_of(lo[m_idx], hi[m_idx])
-                tok_m = tok[m_idx].tolist()
-                d_m = diff[m_idx].tolist()
-                extras.extend(zip(kv_m, tok_m, d_m))
+                member_idx = nr_idx[member]
             pending.apply(
                 lo[nr_idx], hi[nr_idx], tok[nr_idx], thr[nr_idx],
                 (diff[nr_idx] > 0) & ~member, diff=diff[nr_idx],
@@ -3879,6 +3904,11 @@ class BufferNode(_TimeColNode):
         parts_hi = [hi[rel_idx]]
         parts_tok = [tok[rel_idx]]
         parts_diff = [diff[rel_idx]]
+        if member_idx is not None:
+            parts_lo.append(lo[member_idx])
+            parts_hi.append(hi[member_idx])
+            parts_tok.append(tok[member_idx])
+            parts_diff.append(diff[member_idx])
         if now is not None:
             # release pending rows whose threshold has passed
             plo, phi, ptok, pdiff = pending.expire(now)
@@ -4027,6 +4057,9 @@ class ForgetNode(_TimeColNode):
                 any_big = any_big or abs(th) > _F53
             else:
                 all_int = False
+                # ints >= 2^63 don't fit int64 either: they force float
+                # storage AND are always beyond float64 exactness
+                any_big = any_big or isinstance(th, int)
                 thr[i] = th
         if not all_int and any_big:
             return False  # float64 storage would round the big ints
@@ -4046,11 +4079,6 @@ class ForgetNode(_TimeColNode):
         if not n:
             return True
         live = self.live
-        if not live.thr_compatible(thr):
-            # mixing float thresholds with >2^53 ints would round them:
-            # fall back to the object plane's exact scalar comparisons
-            self._finish_object(time, self._demote_replay(lo, hi, tok, diff))
-            return True
         now0 = self.now
         # the watermark advances from EVERY row's current-time value —
         # including late rows dropped below (object-plane parity)
@@ -4059,6 +4087,17 @@ class ForgetNode(_TimeColNode):
             cmax = cur.max().item()
             if now is None or cmax > now:
                 now = cmax
+        if not (
+            live.thr_compatible(thr)
+            and live.now_compatible(now)
+            and _thr_cmp_exact(thr, now)
+            and _thr_cmp_exact(thr, now0)
+        ):
+            # any float/big-int mix (stored, wave, or threshold-vs-
+            # watermark) would round: fall back to the object plane's
+            # exact Python-scalar comparisons (self.now untouched)
+            self._finish_object(time, self._demote_replay(lo, hi, tok, diff))
+            return True
         if now0 is not None:
             keep = ~((thr <= now0) & (diff > 0))  # drop late insertions
             if not keep.all():
@@ -4148,6 +4187,11 @@ class FreezeNode(_TimeColNode):
         if not len(lo):
             return True
         now0 = self.now
+        if not _thr_cmp_exact(thr, now0):
+            # int/float watermark mix beyond 2^53 would round: object
+            # plane's exact scalar comparisons take over
+            self._finish_object(time, self._demote_replay(lo, hi, tok, diff))
+            return True
         if now0 is not None:
             keep = thr > now0  # frozen region: drop the change
             lo, hi, tok, diff = lo[keep], hi[keep], tok[keep], diff[keep]
@@ -4162,10 +4206,11 @@ class FreezeNode(_TimeColNode):
         return True
 
     def finish_time(self, time: int) -> None:
-        if self._tok:
-            if self._finish_tok(time):
-                return
-        entries = self.take_input()
+        if self._tok and self._finish_tok(time):
+            return
+        self._finish_object(time, self.take_input())
+
+    def _finish_object(self, time: int, entries: list[Entry]) -> None:
         if not entries:
             return
         # freeze checks use the previous wave's watermark; advance at wave

@@ -179,8 +179,9 @@ def test_csv_write_native_quoting(tmp_path):
 
 def test_streaming_native_matches_python_parser(tmp_path):
     """Native streaming ingest produces the same aggregate as the object
-    plane (PATHWAY_TPU_NATIVE=0 equivalence is covered by running this
-    same suite with the env flag; here: exactness of the native sums)."""
+    plane (PATHWAY_TPU_NATIVE=0 equivalence is covered by
+    scripts/test_both_planes.py, which runs the suite on both planes and
+    records TESTLEGS.json; here: exactness of the native sums)."""
     import threading
     import time as _t
 

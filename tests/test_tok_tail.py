@@ -2,8 +2,10 @@
 cells, ix, deduplicate, flatten, and the temporal trio process NativeBatch
 waves without materializing rows (asserted by counting materialize calls),
 demote cleanly when a wave carries plane-unrepresentable rows, and agree
-with the object plane (PATHWAY_TPU_NATIVE=0 equivalence is covered by
-running the same pipelines in conftest's object-plane CI leg).
+with the object plane (PATHWAY_TPU_NATIVE=0 equivalence: run
+`python scripts/test_both_planes.py` — both legs green is recorded in
+TESTLEGS.json; order-sensitive edge cases also pin cross-plane equality
+in-process below via subprocess legs).
 
 Reference parity: src/engine/dataflow.rs:1555-2224 (typed-record set ops /
 update / ix / dedup), operators/time_column.rs:380 (postpone/forget/freeze
