@@ -404,16 +404,29 @@ def decode_step(
     token: Array,  # [b] current token ids
     pos: Array,  # scalar int32 position
     cfg: TransformerConfig,
+    pad_len: Array | None = None,  # [b] left-pad lengths (batched serving)
 ) -> tuple[Array, Params]:
-    """One autoregressive step with KV cache; returns ([b, vocab], cache)."""
+    """One autoregressive step with KV cache; returns ([b, vocab], cache).
+
+    With `pad_len` the batch is LEFT-padded: each row's logical position
+    is pos - pad_len (continuing the prefill's mask-cumsum positions) and
+    pad cache slots never enter attention — a row's tokens match what an
+    unpadded single-prompt run would produce."""
     b = token.shape[0]
     h, dh = cfg.n_heads, cfg.head_dim
     x = params["tok_embed"].astype(cfg.dtype)[token][:, None, :]  # [b,1,d]
-    x = x + jax.lax.dynamic_slice_in_dim(
-        params["pos_embed"].astype(cfg.dtype), pos, 1, axis=0
-    )[None]
     mask_len = cfg.max_len
-    kmask = (jnp.arange(mask_len) <= pos)[None, None, None, :]
+    if pad_len is None:
+        x = x + jax.lax.dynamic_slice_in_dim(
+            params["pos_embed"].astype(cfg.dtype), pos, 1, axis=0
+        )[None]
+        kmask = (jnp.arange(mask_len) <= pos)[None, None, None, :]
+    else:
+        x = x + params["pos_embed"].astype(cfg.dtype)[pos - pad_len][:, None, :]
+        kmask = (
+            (jnp.arange(mask_len)[None, :] <= pos)
+            & (jnp.arange(mask_len)[None, :] >= pad_len[:, None])
+        )[:, None, None, :]
     for li, block in enumerate(params["blocks"]):
         xin = _rmsnorm(x, block["ln1_scale"])
         qkv = jnp.einsum(
@@ -454,18 +467,33 @@ def decode_step(
 
 
 def prefill(
-    params: Params, prompt_ids: Array, cache: Params, cfg: TransformerConfig
+    params: Params,
+    prompt_ids: Array,
+    cache: Params,
+    cfg: TransformerConfig,
+    prompt_mask: Array | None = None,
 ) -> tuple[Array, Params]:
     """One batched causal forward over the whole prompt, writing every
     layer's K/V into the cache. Returns (last-position logits [b, vocab],
     cache). This is ONE XLA program over [b, p] — prefill cost does not
     serialize over prompt length the way per-token decode would.
+
+    With `prompt_mask` the batch is LEFT-padded (pad tokens first, real
+    tokens end at p-1 so the last-position logits are every row's next-
+    token logits): real tokens take positions 0..len-1 via the mask
+    cumsum and pad keys are masked out, so a padded row's outputs equal
+    an unpadded single-prompt run.
     """
     b, p = prompt_ids.shape
     h, dh = cfg.n_heads, cfg.head_dim
     x = params["tok_embed"].astype(cfg.dtype)[prompt_ids]
-    x = x + params["pos_embed"].astype(cfg.dtype)[None, :p, :]
-    mask = _build_mask(jnp.ones((b, p), jnp.int32), causal=True)
+    if prompt_mask is None:
+        x = x + params["pos_embed"].astype(cfg.dtype)[None, :p, :]
+        mask = _build_mask(jnp.ones((b, p), jnp.int32), causal=True)
+    else:
+        pos_idx = jnp.clip(jnp.cumsum(prompt_mask, axis=1) - 1, 0, None)
+        x = x + params["pos_embed"].astype(cfg.dtype)[pos_idx]
+        mask = _build_mask(prompt_mask, causal=True)
     for li, block in enumerate(params["blocks"]):
         xin = _rmsnorm(x, block["ln1_scale"])
         qkv = jnp.einsum(
@@ -511,8 +539,14 @@ def generate(
     cfg: TransformerConfig,
     temperature: float = 0.0,
     rng: Array | None = None,
+    prompt_mask: Array | None = None,  # [b, p] 1/0, LEFT-padded batches
 ) -> Array:
-    """Batched prefill + `lax.scan` decode. Returns [b, p + n_steps]."""
+    """Batched prefill + `lax.scan` decode. Returns [b, p + n_steps].
+
+    `prompt_mask` enables serving-style batching of heterogeneous
+    prompts: left-pad every prompt to a common length, pass the validity
+    mask, and each row generates exactly what an unpadded single-prompt
+    run would (mask-cumsum positions; pad slots never attend)."""
     b, p = prompt_ids.shape
     if p + n_steps > cfg.max_len:
         raise ValueError(
@@ -521,7 +555,12 @@ def generate(
     if temperature > 0.0 and rng is None:
         raise ValueError("sampled generation (temperature > 0) requires rng")
     cache = init_kv_cache(cfg, b)
-    first_logits, cache = prefill(params, prompt_ids, cache, cfg)
+    first_logits, cache = prefill(params, prompt_ids, cache, cfg, prompt_mask)
+    pad_len = (
+        None
+        if prompt_mask is None
+        else (p - jnp.sum(prompt_mask, axis=1)).astype(jnp.int32)
+    )
 
     def pick(lg: Array, key):
         if temperature > 0.0:
@@ -534,7 +573,7 @@ def generate(
 
     def body(carry, i):
         cache, tok, key = carry
-        lg, cache = decode_step(params, cache, tok, p + i, cfg)
+        lg, cache = decode_step(params, cache, tok, p + i, cfg, pad_len=pad_len)
         nxt, key = pick(lg, key)
         # emit the token being consumed this step; the carry holds the next
         return (cache, nxt, key), tok

@@ -192,6 +192,30 @@ class _MicroBatcher:
                         fut.set_exception(e)
 
 
+def bucket_len(longest: int, cap: int) -> int:
+    """Power-of-two-ish sequence bucket (>=16) so the jit cache sees few
+    distinct shapes as lengths vary — shared by the embedder's right-pad
+    and the chat's left-pad batching."""
+    bucket = 16
+    while bucket < longest:
+        bucket *= 2
+    return min(bucket, cap)
+
+
+def pad_left_rows(rows: list, cap: int):
+    """Left-pad variable-length token rows into (ids, mask) int32 arrays
+    at a bucketed width (generation convention — real tokens end at the
+    last column, so last-position logits are every row's next token)."""
+    bucket = bucket_len(max((len(r) for r in rows), default=1) or 1, cap)
+    ids = np.zeros((len(rows), bucket), np.int32)
+    mask = np.zeros((len(rows), bucket), np.int32)
+    for i, r in enumerate(rows):
+        r = r[-bucket:]
+        ids[i, bucket - len(r):] = r
+        mask[i, bucket - len(r):] = 1
+    return ids, mask
+
+
 class JaxEmbedder(BaseEmbedder):
     """The TPU-native embedder: hash tokenizer + the flagship JAX encoder.
 
@@ -253,10 +277,7 @@ class JaxEmbedder(BaseEmbedder):
             mask = np.pad(mask, ((0, pad), (0, 0)))
         # pad seq to a power-of-two-ish bucket
         seq = ids.shape[1]
-        bucket = 16
-        while bucket < seq:
-            bucket *= 2
-        bucket = min(bucket, self.config.max_len)
+        bucket = bucket_len(seq, self.config.max_len)
         if bucket != seq:
             ids = np.pad(ids, ((0, 0), (0, bucket - seq)))
             mask = np.pad(mask, ((0, 0), (0, bucket - seq)))

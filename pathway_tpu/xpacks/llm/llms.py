@@ -192,20 +192,23 @@ class JaxLMChat(BaseChat):
         tokenizer: Any = None,
         max_new_tokens: int = 64,
         temperature: float = 0.0,
+        max_batch: int = 64,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
+        import functools
+
+        import jax
+
         from pathway_tpu.models import lm_config, transformer
         from pathway_tpu.models.tokenizer import HashTokenizer
+        from pathway_tpu.xpacks.llm.embedders import _MicroBatcher
 
-        self._tfm = transformer
         self.config = config or lm_config(
             vocab_size=32768, d_model=256, n_heads=8, n_layers=4, d_ff=1024,
             max_len=512,
         )
         if params is None:
-            import jax
-
             params = transformer.init_params(jax.random.PRNGKey(0), self.config)
         self.params = params
         self.tokenizer = tokenizer or HashTokenizer(
@@ -218,24 +221,49 @@ class JaxLMChat(BaseChat):
             )
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
+        # serving batcher: a wave of concurrent chat calls left-pads into
+        # ONE generate dispatch (prompt_mask keeps per-row outputs equal
+        # to unpadded runs); per-question dispatch would serialize on
+        # host->device submission latency
+        self._gen = jax.jit(
+            functools.partial(
+                transformer.generate,
+                n_steps=self.max_new_tokens,
+                cfg=self.config,
+                temperature=self.temperature,
+            )
+        )
+        self._batcher = _MicroBatcher(self._generate_batch, max_batch=max_batch)
 
-    def __wrapped__(self, messages: Any, **kwargs: Any) -> str:
+    def _generate_batch(self, prompts: list[str]) -> list[str]:
+        import jax
         import jax.numpy as jnp
+        import numpy as np
 
+        from pathway_tpu.xpacks.llm.embedders import pad_left_rows
+
+        budget = self.config.max_len - self.max_new_tokens
+        rows = [self.tokenizer.tokenize(p)[-budget:] for p in prompts]
+        ids, mask = pad_left_rows(rows, budget)
+        bucket = ids.shape[1]
+        kwargs = {}
+        if self.temperature > 0.0:
+            kwargs["rng"] = jax.random.PRNGKey(abs(hash(tuple(prompts))) % (1 << 31))
+        out = np.asarray(
+            self._gen(
+                self.params, jnp.asarray(ids),
+                prompt_mask=jnp.asarray(mask), **kwargs,
+            )
+        )
+        return [
+            " ".join(f"<{int(t)}>" for t in out[i, bucket:])
+            for i in range(len(rows))
+        ]
+
+    async def __wrapped__(self, messages: Any, **kwargs: Any) -> str:
         msgs = messages.value if isinstance(messages, Json) else messages
         if isinstance(msgs, list):
             prompt = "\n".join(m["content"] for m in msgs)
         else:
             prompt = str(msgs)
-        ids = self.tokenizer.tokenize(prompt)
-        budget = self.config.max_len - self.max_new_tokens
-        ids = ids[-budget:]
-        out = self._tfm.generate(
-            self.params,
-            jnp.asarray([ids], jnp.int32),
-            n_steps=self.max_new_tokens,
-            cfg=self.config,
-            temperature=self.temperature,
-        )
-        toks = [int(t) for t in out[0, len(ids):]]
-        return " ".join(f"<{t}>" for t in toks)
+        return await self._batcher.submit(prompt)

@@ -56,12 +56,20 @@ def main() -> int:
         run_leg("object", {"PATHWAY_TPU_NATIVE": "0"}, extra),
     ]
     ok = all(l["rc"] == 0 and l["failed"] == 0 and l["passed"] > 0 for l in legs)
+    dirty = bool(
+        subprocess.run(
+            ["git", "status", "--porcelain"], cwd=REPO,
+            capture_output=True, text=True,
+        ).stdout.strip()
+    )
     out = {
         "ok": ok,
         "git": subprocess.run(
             ["git", "rev-parse", "HEAD"], cwd=REPO,
             capture_output=True, text=True,
         ).stdout.strip(),
+        # a dirty tree means the recorded commit is NOT what actually ran
+        "working_tree_dirty": dirty,
         "legs": legs,
     }
     with open(os.path.join(REPO, "TESTLEGS.json"), "w") as fh:
