@@ -839,6 +839,29 @@ def bench_dataflow(repo: str) -> dict:
             ),
             1,
         )
+        # py leg at half the native rows: per-row rates are size-invariant
+        # here (both scripts start their clock after imports, so fixed
+        # startup is excluded; the object plane is ~10x slower per row,
+        # and a full-size leg would triple the bench wall-clock)
+        n_ev_py = n_ev // 2
+        einp_small = os.path.join(tmp, "events_small.jsonl")
+        with open(einp, "r") as fin, open(einp_small, "w") as fout:
+            for i, line in enumerate(fin):
+                if i >= n_ev_py:
+                    break
+                fout.write(line)
+        js_py = _JOIN_SCRIPT.format(
+            repo=repo, users=uinp, events=einp_small,
+            out=os.path.join(tmp, "join_out_py.csv"), n=n_ev_py,
+        )
+        join_py = _run_engine_script(
+            js_py, {"PATHWAY_THREADS": "1", "PATHWAY_TPU_NATIVE": "0"},
+            stats=stats, rung="join_python_rows_per_sec",
+        )
+        out["join_python_rows_per_sec"] = round(join_py, 1)
+        out["join_native_vs_python"] = round(
+            out["join_rows_per_sec"] / join_py, 2
+        )
 
         rinp = os.path.join(tmp, "reg.jsonl")
         _gen_regression_input(rinp, REGRESSION_ROWS)

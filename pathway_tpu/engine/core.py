@@ -1929,6 +1929,7 @@ class JoinNode(Node):
             f"JoinNode/{self.mode}/{self.id_mode}/{self.left_width}"
             f"/{self.right_width}/{int(self.asof_now)}"
             f"/native={int(getattr(self, '_plan', None) is not None)}"
+            f"/emit={getattr(self, 'emit_cols', None)}"
         )
 
     def merge_shard_states(self, states: list[dict]) -> dict:
@@ -2060,6 +2061,7 @@ class JoinNode(Node):
         exact_match: bool = False,
         asof_now: bool = False,
         native_plan: dict | None = None,
+        emit_cols: list[int] | None = None,
     ):
         super().__init__(graph, [left, right])
         self.left_jk = left_jk
@@ -2068,6 +2070,10 @@ class JoinNode(Node):
         self.id_mode = id_mode
         self.left_width = left_width
         self.right_width = right_width
+        # projection pushdown (lowering-gated): the post-join select's
+        # column picks fuse into the C row emission — indexes into the
+        # virtual (lkey, rkey, *lrow, *rrow) joined row
+        self.emit_cols = emit_cols
         self.left_state = MultisetState()
         self.right_state = MultisetState()
         # asof_now: left deltas join the right side's state as of their
@@ -2175,6 +2181,8 @@ class JoinNode(Node):
         res = self._dp.join_rows(
             self._tab, *l_arrs, *r_arrs,
             id_mode=self._ID_MODES.get(self.id_mode, 0),
+            out_cols=self.emit_cols,
+            l_width=self.left_width,
         )
         if res is None:
             self.log_error("join: malformed row token in match set")

@@ -1661,14 +1661,61 @@ int64_t dj_export(void* h, uint64_t* jk, uint64_t* klo, uint64_t* khi,
 // piece_key(lkey) + piece_key(rkey) + lrow_bytes + rrow_bytes, interned;
 // out key: id_mode 0 = blake2b(piece_key(l)+piece_key(r)) (hash),
 // 1 = left key, 2 = right key. Returns 0 or -1-p on a bad row token.
+// n_out < 0: emit the full joined row (lkey, rkey, *lrow, *rrow).
+// n_out >= 0: PROJECTED emission — out_sel[j] indexes the virtual joined
+// row (0 = lkey piece, 1 = rkey piece, 2+c = combined column c, where
+// c < l_width selects left column c and c >= l_width selects right
+// column c - l_width). The post-join select fuses into the join this
+// way: one row build instead of two full passes over the match set.
 int64_t dp_join_rows(void* h, int64_t n, const uint64_t* l_lo,
                      const uint64_t* l_hi, const uint64_t* l_tok,
                      const uint64_t* r_lo, const uint64_t* r_hi,
                      const uint64_t* r_tok, int64_t id_mode,
+                     int64_t n_out, const int64_t* out_sel, int64_t l_width,
                      uint64_t* out_lo, uint64_t* out_hi, uint64_t* out_tok) {
     auto* tab = static_cast<InternTable*>(h);
     std::string row_bytes, keys_bytes;
     PendingRows pend;
+    // projection: per-side sorted unique column lists for find_cols
+    std::vector<int64_t> l_cols, r_cols;
+    std::vector<int64_t> sel_side, sel_slot;  // per out col: 0/1/2 lkey/rkey/col
+    if (n_out >= 0) {
+        for (int64_t j = 0; j < n_out; ++j) {
+            int64_t s = out_sel[j];
+            if (s == 0 || s == 1) {
+                sel_side.push_back(s);
+                sel_slot.push_back(0);
+            } else {
+                int64_t c = s - 2;
+                if (c < l_width) {
+                    sel_side.push_back(2);
+                    l_cols.push_back(c);
+                    sel_slot.push_back(c);
+                } else {
+                    sel_side.push_back(3);
+                    r_cols.push_back(c - l_width);
+                    sel_slot.push_back(c - l_width);
+                }
+            }
+        }
+        auto uniq = [](std::vector<int64_t>& v) {
+            std::sort(v.begin(), v.end());
+            v.erase(std::unique(v.begin(), v.end()), v.end());
+        };
+        uniq(l_cols);
+        uniq(r_cols);
+        // slot -> position in the sorted unique list
+        for (size_t j = 0; j < sel_side.size(); ++j) {
+            if (sel_side[j] == 2)
+                sel_slot[j] = std::lower_bound(l_cols.begin(), l_cols.end(),
+                                               sel_slot[j]) - l_cols.begin();
+            else if (sel_side[j] == 3)
+                sel_slot[j] = std::lower_bound(r_cols.begin(), r_cols.end(),
+                                               sel_slot[j]) - r_cols.begin();
+        }
+    }
+    std::vector<const char*> lst(l_cols.size()), len_(l_cols.size());
+    std::vector<const char*> rst(r_cols.size()), ren(r_cols.size());
     {
         std::shared_lock<std::shared_mutex> rg(tab->mu);
         for (int64_t i = 0; i < n; ++i) {
@@ -1680,10 +1727,42 @@ int64_t dp_join_rows(void* h, int64_t n, const uint64_t* l_lo,
                 !tab->get(r_tok[i], &rrow, &rlen))
                 return -1 - i;
             row_bytes.clear();
-            piece_key(row_bytes, l_lo[i], l_hi[i]);
-            piece_key(row_bytes, r_lo[i], r_hi[i]);
-            row_bytes.append(lrow, static_cast<size_t>(llen));
-            row_bytes.append(rrow, static_cast<size_t>(rlen));
+            if (n_out < 0) {
+                piece_key(row_bytes, l_lo[i], l_hi[i]);
+                piece_key(row_bytes, r_lo[i], r_hi[i]);
+                row_bytes.append(lrow, static_cast<size_t>(llen));
+                row_bytes.append(rrow, static_cast<size_t>(rlen));
+            } else {
+                if (!l_cols.empty() &&
+                    !find_cols(lrow, llen, l_cols.data(),
+                               static_cast<int64_t>(l_cols.size()),
+                               lst.data(), len_.data()))
+                    return -1 - i;
+                if (!r_cols.empty() &&
+                    !find_cols(rrow, rlen, r_cols.data(),
+                               static_cast<int64_t>(r_cols.size()),
+                               rst.data(), ren.data()))
+                    return -1 - i;
+                for (size_t j = 0; j < sel_side.size(); ++j) {
+                    switch (sel_side[j]) {
+                        case 0: piece_key(row_bytes, l_lo[i], l_hi[i]); break;
+                        case 1: piece_key(row_bytes, r_lo[i], r_hi[i]); break;
+                        case 2:
+                            row_bytes.append(
+                                lst[static_cast<size_t>(sel_slot[j])],
+                                static_cast<size_t>(
+                                    len_[static_cast<size_t>(sel_slot[j])] -
+                                    lst[static_cast<size_t>(sel_slot[j])]));
+                            break;
+                        default:
+                            row_bytes.append(
+                                rst[static_cast<size_t>(sel_slot[j])],
+                                static_cast<size_t>(
+                                    ren[static_cast<size_t>(sel_slot[j])] -
+                                    rst[static_cast<size_t>(sel_slot[j])]));
+                    }
+                }
+            }
             pend.add(row_bytes, i);
             if (id_mode == 1) {
                 out_lo[i] = l_lo[i];
@@ -1692,7 +1771,9 @@ int64_t dp_join_rows(void* h, int64_t n, const uint64_t* l_lo,
                 out_lo[i] = r_lo[i];
                 out_hi[i] = r_hi[i];
             } else {
-                keys_bytes.assign(row_bytes, 0, 34);  // the two key pieces
+                keys_bytes.clear();
+                piece_key(keys_bytes, l_lo[i], l_hi[i]);
+                piece_key(keys_bytes, r_lo[i], r_hi[i]);
                 blake2b_128(
                     reinterpret_cast<const uint8_t*>(keys_bytes.data()),
                     keys_bytes.size(), &out_lo[i], &out_hi[i]);

@@ -157,7 +157,7 @@ def _load() -> ctypes.CDLL | None:
         lib.dp_join_rows.restype = c.c_int64
         lib.dp_join_rows.argtypes = [
             c.c_void_p, c.c_int64, u64p, u64p, u64p, u64p, u64p, u64p,
-            c.c_int64, u64p, u64p, u64p,
+            c.c_int64, c.c_int64, i64p, c.c_int64, u64p, u64p, u64p,
         ]
         lib.dp_splice_cols.restype = c.c_int64
         lib.dp_splice_cols.argtypes = [
@@ -545,22 +545,33 @@ def join_rows(
     l_lo, l_hi, l_tok,
     r_lo, r_hi, r_tok,
     id_mode: int = 0,
+    out_cols: "list[int] | None" = None,
+    l_width: int = 0,
 ):
     """Assemble joined output rows (lkey, rkey, *lrow, *rrow) as interned
     tokens with output keys (id_mode 0=hash, 1=left, 2=right) —
-    byte-identical to the object plane's join output rows."""
+    byte-identical to the object plane's join output rows.
+
+    `out_cols` fuses the post-join select into the emission: each entry
+    indexes the virtual joined row (0 lkey, 1 rkey, 2+c combined column)
+    and only those pieces are assembled — one row build for join+select
+    instead of two full passes."""
     lib = _load()
     n = len(l_tok)
     out_lo = np.empty(n, np.uint64)
     out_hi = np.empty(n, np.uint64)
     out_tok = np.empty(n, np.uint64)
+    if out_cols is None:
+        n_out, sel = -1, np.empty(0, np.int64)
+    else:
+        n_out, sel = len(out_cols), np.asarray(out_cols, np.int64)
     rc = lib.dp_join_rows(
         tab._h, n,
         np.ascontiguousarray(l_lo), np.ascontiguousarray(l_hi),
         np.ascontiguousarray(l_tok),
         np.ascontiguousarray(r_lo), np.ascontiguousarray(r_hi),
         np.ascontiguousarray(r_tok),
-        id_mode, out_lo, out_hi, out_tok,
+        id_mode, n_out, sel, l_width, out_lo, out_hi, out_tok,
     )
     if rc != 0:
         return None

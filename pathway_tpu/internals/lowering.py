@@ -1053,6 +1053,32 @@ class Session:
                 for le, re_ in on
             ):
                 native_plan = {"l_cols": l_cols, "r_cols": r_cols}
+        jres = JoinResolver(left_t, right_t)
+        # pure-column output picks on a native join fuse into the join's
+        # C row emission (projection pushdown): the JoinNode emits the
+        # selected pieces directly and no post-join row build runs at all
+        emit_cols: list[int] | None = None
+        if native_plan is not None:
+            emit_cols = []
+            for e in out_exprs.values():
+                try:
+                    from pathway_tpu.internals.joins import _JoinIdRef
+
+                    if isinstance(e, _JoinIdRef):
+                        emit_cols = None
+                        break
+                    if isinstance(e, ex.ColumnReference):
+                        _inp, idx = jres.resolve(e)
+                        if idx is None:
+                            emit_cols = None
+                            break
+                        emit_cols.append(idx)
+                        continue
+                except Exception:  # noqa: BLE001
+                    emit_cols = None
+                    break
+                emit_cols = None
+                break
         jnode = self._sharded(
             [left_node, right_node],
             lambda sg, ins: eng.JoinNode(
@@ -1061,6 +1087,7 @@ class Session:
                 left_width=left_width, right_width=right_width,
                 asof_now=asof_now,
                 native_plan=native_plan,
+                emit_cols=emit_cols,
             ),
             # exchange both sides on the join key (reference: Shard impls on
             # join arrangements, src/engine/dataflow/shard.rs)
@@ -1074,42 +1101,11 @@ class Session:
                 else None
             ),
         )
-        jres = JoinResolver(left_t, right_t)
+        if emit_cols is not None:
+            self._native_specs.add(spec.id)
+            return jnode
         fns = [compile_expression(e, jres) for e in out_exprs.values()]
         fn = self._guarded_row_fn(fns, getattr(spec, "trace", None))
-        if native_plan is not None:
-            # joined rows stay token-resident through the post-process
-            # select when every output is a plain column of the combined
-            # (lkey, rkey, *lrow, *rrow) row
-            specs: list | None = []
-            for e in out_exprs.values():
-                try:
-                    from pathway_tpu.internals.joins import _JoinIdRef
-
-                    if isinstance(e, _JoinIdRef):
-                        specs = None
-                        break
-                    if isinstance(e, ex.ColumnReference):
-                        _inp, idx = jres.resolve(e)
-                        if idx is None:
-                            specs = None
-                            break
-                        specs.append(("col", idx))
-                        continue
-                except Exception:  # noqa: BLE001
-                    specs = None
-                    break
-                specs = None
-                break
-            if specs is not None:
-                node = eng.MapNode(
-                    self.graph, jnode, fn,  # fn(key, *rows) ≡ fn(key, row)
-                    native_plan={
-                        "specs": specs, "plans": [], "needed_cols": [],
-                    },
-                )
-                self._native_specs.add(spec.id)
-                return node
         return self._sharded(
             [jnode], lambda sg, ins: eng.RowwiseNode(sg, ins, fn), [_route_key]
         )

@@ -202,13 +202,18 @@ def bucket_len(longest: int, cap: int) -> int:
     return min(bucket, cap)
 
 
-def pad_left_rows(rows: list, cap: int):
+def pad_left_rows(rows: list, cap: int, pad_rows_to: int = 8):
     """Left-pad variable-length token rows into (ids, mask) int32 arrays
     at a bucketed width (generation convention — real tokens end at the
-    last column, so last-position logits are every row's next token)."""
+    last column, so last-position logits are every row's next token).
+    The batch dimension pads to a multiple of `pad_rows_to` with all-
+    masked rows so arbitrary wave sizes hit few jit shapes — without it
+    every distinct concurrent-wave size recompiles the whole generate
+    program."""
     bucket = bucket_len(max((len(r) for r in rows), default=1) or 1, cap)
-    ids = np.zeros((len(rows), bucket), np.int32)
-    mask = np.zeros((len(rows), bucket), np.int32)
+    n = ((len(rows) + pad_rows_to - 1) // pad_rows_to) * pad_rows_to
+    ids = np.zeros((n, bucket), np.int32)
+    mask = np.zeros((n, bucket), np.int32)
     for i, r in enumerate(rows):
         r = r[-bucket:]
         ids[i, bucket - len(r):] = r
