@@ -719,6 +719,14 @@ class Table:
         if context is None:
             refs = referenced_tables([expression])
             refs = [t for t in refs if isinstance(t, Table)]
+            if not refs and isinstance(expression, ex.PointerExpression):
+                # constant-argument pointer_from carries no column refs;
+                # its origin table IS the lookup context (without this,
+                # context fell back to the TARGET table and the lookup
+                # silently produced the wrong universe)
+                origin = expression._table
+                if isinstance(origin, Table):
+                    refs = [origin]
             context_table = refs[0] if refs else self
         elif isinstance(context, Table):
             context_table = context

@@ -92,9 +92,218 @@ def bellman_ford(vertices: Table, edges: Table) -> Table:
     return result
 
 
-def louvain_level(G: Graph, **kwargs: Any) -> Table:
-    raise NotImplementedError("louvain communities: planned (round 2)")
+def _with_weight(E: Table) -> Table:
+    """Edges with a weight column (default 1.0 — unweighted graphs)."""
+    if "weight" not in E._column_names():
+        E = E.with_columns(weight=1.0)
+    return E
 
 
-def louvain_communities(G: Graph, **kwargs: Any) -> Table:
-    raise NotImplementedError("louvain communities: planned (round 2)")
+def louvain_level(
+    G: Graph, iteration_limit: int | None = None
+) -> Table:
+    """One Louvain level: vertices move between communities while the
+    modularity gain is strictly positive; a per-round random-priority
+    independent set makes parallel moves safe (no community participates
+    in two movements in one round). Returns a clustering keyed like the
+    vertex set with column `c` (community id, Pointer).
+
+    Edge convention matches the reference: directed edge rows, an
+    undirected edge {u, v} appears as both (u, v) and (v, u)
+    (reference: stdlib/graphs/louvain_communities/impl.py:_louvain_level,
+    _one_step, _propose_clusters; gain = 2*deg(v in C') -
+    deg(v)*(2*deg(C') + deg(v))/m, evaluated per adjacent community and
+    for staying put with the vertex's own degree removed).
+    """
+    from pathway_tpu.internals.common import apply_with_type
+    from pathway_tpu.internals.keys import key_for_values
+
+    E = _with_weight(G.E)
+    V = G.V
+    total = E.reduce(m=red.sum(E.weight)).with_id_from(0)
+    init = V.select(c=V.pointer_from(V.id))
+
+    def step(clustering: Table) -> dict[str, Table]:
+        cl = clustering
+        # endpoint communities (two key-joins over the clustering)
+        e2 = E.join(cl, E.u == cl.id).select(
+            u=ex.left.u, v=ex.left.v, weight=ex.left.weight, cu=ex.right.c
+        )
+        e3 = e2.join(cl, e2.v == cl.id).select(
+            u=ex.left.u, v=ex.left.v, weight=ex.left.weight,
+            cu=ex.left.cu, vc=ex.right.c,
+        )
+        # vertex degrees (self loops included, as in the reference);
+        # isolated vertices get 0.0 via the placeholder leg
+        vdeg0 = cl.select(u=ex.this.id, deg=0.0).with_id(ex.this.u)
+        vdeg = vdeg0.update_rows(
+            e3.groupby(e3.u)
+            .reduce(u=e3.u, deg=red.sum(e3.weight))
+            .with_id(ex.this.u)
+        )
+        # community degree sums deg(C); empty communities get 0.0
+        cdeg0 = (
+            cl.groupby(cl.c).reduce(cu=cl.c).with_columns(cdeg=0.0)
+            .with_id(ex.this.cu)
+        )
+        cdeg = cdeg0.update_rows(
+            e2.groupby(e2.cu)
+            .reduce(cu=e2.cu, cdeg=red.sum(e2.weight))
+            .with_id(ex.this.cu)
+        )
+        # vertex -> adjacent-community weights; the zero-weight
+        # placeholder row per vertex guarantees a "stay" candidate even
+        # when v has no edge into its own community
+        nl = e3.filter(e3.u != e3.v)
+        vc_edges = nl.select(nl.u, nl.vc, nl.weight).concat_reindex(
+            cl.select(u=ex.this.id, vc=ex.this.c, weight=0.0)
+        )
+        gw = vc_edges.groupby(vc_edges.u, vc_edges.vc).reduce(
+            u=vc_edges.u, vc=vc_edges.vc, gw=red.sum(vc_edges.weight)
+        )
+        g2 = gw.join(vdeg, gw.u == vdeg.id).select(
+            u=ex.left.u, vc=ex.left.vc, gw=ex.left.gw, deg=ex.right.deg
+        )
+        g3 = g2.join(cdeg, g2.vc == cdeg.id).select(
+            u=ex.left.u, vc=ex.left.vc, gw=ex.left.gw, deg=ex.left.deg,
+            cdeg=ex.right.cdeg,
+        )
+        g4 = g3.join(cl, g3.u == cl.id).select(
+            u=ex.left.u, vc=ex.left.vc, gw=ex.left.gw, deg=ex.left.deg,
+            cdeg=ex.left.cdeg, cu=ex.right.c,
+        )
+        g4p = g4.with_columns(_mp=g4.pointer_from(0))
+        gains = g4p.select(
+            g4p.u, g4p.vc, g4p.cu,
+            gain=2.0 * g4p.gw
+            - g4p.deg
+            * (
+                2.0 * if_else(g4p.vc == g4p.cu, g4p.cdeg - g4p.deg, g4p.cdeg)
+                + g4p.deg
+            )
+            / total.ix(g4p._mp).m,
+        )
+        best = gains.groupby(gains.u).reduce(
+            u=gains.u,
+            gain=red.max(gains.gain),
+            # argmax payload form: the community of the max-gain row
+            # (ties break to the smallest community pointer)
+            vc=red.ReducerExpression(
+                red.ArgMaxReducer(), gains.gain, gains.vc
+            ),
+        )
+        stay = gains.filter(gains.vc == gains.cu)
+        # strict improvement only: equal-gain moves would oscillate
+        cand = (
+            best.join(stay, best.u == stay.u)
+            .select(
+                u=ex.left.u, vc=ex.left.vc, gain=ex.left.gain,
+                sgain=ex.right.gain, cu=ex.right.cu,
+            )
+            .filter(ex.this.gain > ex.this.sgain)
+        )
+        # independent set over the community graph: only the max-priority
+        # move touching each community executes this round
+        cand = cand.with_columns(
+            r=apply_with_type(
+                lambda a, b: key_for_values(a, b).value & ((1 << 63) - 1),
+                int, ex.this.u, ex.this.vc,
+            )
+        )
+        pris = cand.select(c=cand.cu, r=cand.r).concat_reindex(
+            cand.select(c=cand.vc, r=cand.r)
+        )
+        cmax = pris.groupby(pris.c).reduce(c=pris.c, rmax=red.max(pris.r))
+        w1 = cand.join(cmax, cand.cu == cmax.c).select(
+            u=ex.left.u, vc=ex.left.vc, r=ex.left.r, rmax_u=ex.right.rmax
+        )
+        w2 = w1.join(cmax, w1.vc == cmax.c).select(
+            u=ex.left.u, vc=ex.left.vc, r=ex.left.r,
+            rmax_u=ex.left.rmax_u, rmax_v=ex.right.rmax,
+        )
+        winners = w2.filter(
+            (w2.r == w2.rmax_u) & (w2.r == w2.rmax_v)
+        )
+        delta = (
+            winners.select(u=winners.u, c=winners.vc)
+            .with_id(ex.this.u)
+            .without("u")
+        )
+        return {"clustering": cl.update_rows(delta)}
+
+    return iterate(
+        lambda clustering: step(clustering),
+        iteration_limit=iteration_limit,
+        clustering=init,
+    )
+
+
+def louvain_communities(
+    G: Graph, levels: int = 1, iteration_limit: int | None = None
+) -> Table:
+    """Louvain community detection: `levels` rounds of one-level moves +
+    community-graph contraction. Returns a table keyed like G.V with
+    column `c` — each vertex's community at the final level (reference:
+    louvain_communities/impl.py louvain_communities_fixed_iterations +
+    contracted_to_weighted_simple_graph)."""
+    V, E = G.V, _with_weight(G.E)
+    mapping: Table | None = None
+    for _lvl in range(levels):
+        cl = louvain_level(Graph(V, E), iteration_limit=iteration_limit)
+        if mapping is None:
+            mapping = cl
+        else:
+            mapping = mapping.join(
+                cl, mapping.c == cl.id, id=ex.left.id
+            ).select(c=ex.right.c)
+        # contract: communities become vertices, parallel edges merge
+        eu = E.join(cl, E.u == cl.id).select(
+            cu=ex.right.c, v=ex.left.v, weight=ex.left.weight
+        )
+        euv = eu.join(cl, eu.v == cl.id).select(
+            u=ex.left.cu, v=ex.right.c, weight=ex.left.weight
+        )
+        E = euv.groupby(euv.u, euv.v).reduce(
+            u=euv.u, v=euv.v, weight=red.sum(euv.weight)
+        )
+        V = cl.groupby(cl.c).reduce(cid=cl.c).with_id(ex.this.cid)
+    return mapping
+
+
+def exact_modularity(G: Graph, C: Table, round_digits: int = 16) -> Table:
+    """Modularity of clustering C over G: sum over communities of
+    (internal*m - deg^2) / m^2, rounded to `round_digits` (reference:
+    louvain_communities/impl.py exact_modularity — a testing helper; the
+    exact global sum creates long dependency chains on live streams)."""
+    from pathway_tpu.internals.common import apply_with_type
+
+    E = _with_weight(G.E)
+    total = E.reduce(m=red.sum(E.weight)).with_id_from(0)
+    eu = E.join(C, E.u == C.id).select(
+        weight=ex.left.weight, cu=ex.right.c, v=ex.left.v
+    )
+    euv = eu.join(C, eu.v == C.id).select(
+        weight=ex.left.weight, cu=ex.left.cu, cv=ex.right.c
+    )
+    cdeg = eu.groupby(eu.cu).reduce(cu=eu.cu, deg=red.sum(eu.weight))
+    cint = (
+        euv.filter(euv.cu == euv.cv)
+        .groupby(ex.this.cu)
+        .reduce(cu=ex.this.cu, internal=red.sum(ex.this.weight))
+    )
+    per = cdeg.join_left(cint, cdeg.cu == cint.cu).select(
+        deg=ex.left.deg, internal=coalesce(ex.right.internal, 0.0)
+    )
+    perp = per.with_columns(_mp=per.pointer_from(0))
+    scored = perp.select(
+        part=(
+            perp.internal * total.ix(perp._mp).m - perp.deg * perp.deg
+        )
+        / (total.ix(perp._mp).m * total.ix(perp._mp).m)
+    )
+    out = scored.reduce(modularity=red.sum(scored.part))
+    return out.select(
+        modularity=apply_with_type(
+            lambda x: round(x, round_digits), float, ex.this.modularity
+        )
+    )

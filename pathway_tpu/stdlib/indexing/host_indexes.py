@@ -324,13 +324,23 @@ class LshIndex(HostIndex):
         bucket_length: float = 2.0,
         metric: str = "l2",
         seed: int = 0,
+        projection: Any = None,
+        distance: Any = None,
     ):
+        """`projection` (vec -> sequence of per-table bucket ids) and
+        `distance` ((query, doc) -> float) plug user callables into the
+        bucket assignment and the candidate rescore — the generic-LSH
+        contract of the reference's knn_lsh_generic_classifier_train
+        (ml/classifiers/_knn_lsh.py:135). Defaults draw OR-AND hyperplane
+        projections and use the named metric."""
         self.dim = dimensions
         self.n_or = n_or
         self.n_and = n_and
         self.bucket_length = bucket_length
         self.metric = metric
         self.seed = seed
+        self.custom_projection = projection
+        self.custom_distance = distance
         self.projections: list[np.ndarray] | None = None
         self.offsets: list[np.ndarray] | None = None
         self.buckets: list[dict[tuple, set[Key]]] = [defaultdict(set) for _ in range(n_or)]
@@ -339,6 +349,8 @@ class LshIndex(HostIndex):
         self._filters = _FilterCache()
 
     def _ensure(self, dim: int) -> None:
+        if self.custom_projection is not None:
+            return
         if self.projections is None:
             self.dim = self.dim or dim
             rng = np.random.default_rng(self.seed)
@@ -351,7 +363,16 @@ class LshIndex(HostIndex):
                 for _ in range(self.n_or)
             ]
 
-    def _bucket_ids(self, vec: np.ndarray) -> list[tuple]:
+    def _bucket_ids(self, vec: np.ndarray) -> list:
+        if self.custom_projection is not None:
+            from pathway_tpu.engine.core import freeze_value
+
+            ids = [freeze_value(b) for b in self.custom_projection(vec)]
+            if len(ids) > len(self.buckets):  # grow to the callable's L
+                self.buckets.extend(
+                    defaultdict(set) for _ in range(len(ids) - len(self.buckets))
+                )
+            return ids
         return [
             tuple(np.floor((vec @ proj + off) / self.bucket_length).astype(np.int64))
             for proj, off in zip(self.projections, self.offsets)
@@ -388,13 +409,20 @@ class LshIndex(HostIndex):
         if not candidates:
             return []
         keys = list(candidates)
-        docs = np.stack([self.vectors[c] for c in keys])
-        if self.metric in ("cos", "cosine"):
-            qn = vec / max(np.linalg.norm(vec), 1e-12)
-            dn = docs / np.maximum(np.linalg.norm(docs, axis=1, keepdims=True), 1e-12)
-            dists = 1.0 - dn @ qn
+        if self.custom_distance is not None:
+            dists = [
+                float(self.custom_distance(vec, self.vectors[c])) for c in keys
+            ]
         else:
-            dists = np.linalg.norm(docs - vec[None, :], axis=1) ** 2
+            docs = np.stack([self.vectors[c] for c in keys])
+            if self.metric in ("cos", "cosine"):
+                qn = vec / max(np.linalg.norm(vec), 1e-12)
+                dn = docs / np.maximum(
+                    np.linalg.norm(docs, axis=1, keepdims=True), 1e-12
+                )
+                dists = 1.0 - dn @ qn
+            else:
+                dists = np.linalg.norm(docs - vec[None, :], axis=1) ** 2
         matches = [(key, float(d)) for key, d in zip(keys, dists)]
         matches.sort(key=lambda m: (m[1], m[0].value))
         return matches[:k]

@@ -117,13 +117,18 @@ class LshKnn(_EmbeddingKnn):
     bucket_length: float = 2.0
     distance_type: str = "l2"
     embedder: Any = None
+    # generic-LSH callables (reference knn_lsh_generic_classifier_train):
+    # projection(vec) -> per-table bucket ids; distance(q, doc) -> float
+    projection: Any = None
+    distance: Any = None
 
     def _host_index_factory(self) -> Callable:
         cfg = (self.dimensions, self.n_or, self.n_and, self.bucket_length,
-               self.distance_type)
+               self.distance_type, self.projection, self.distance)
         return lambda: LshIndex(
             dimensions=cfg[0], n_or=cfg[1], n_and=cfg[2],
             bucket_length=cfg[3], metric=cfg[4],
+            projection=cfg[5], distance=cfg[6],
         )
 
 

@@ -88,22 +88,28 @@ def knn_lsh_generic_classifier_train(
     L: int = 10,
     **kwargs: Any,
 ) -> KnnModel:
-    """Generic variant (reference :135). `distance_function` selects the
-    rescoring metric by name ('euclidean' or 'cosine'); custom projection
-    callables are not supported by the host LSH index."""
-    if lsh_projection is not None:
-        raise NotImplementedError(
-            "knn_lsh_generic_classifier_train: custom lsh_projection "
-            "callables are not supported — the host index draws its own "
-            "hyperplane projections (use L/M/A to shape them)"
-        )
-    if not isinstance(distance_function, str):
-        raise NotImplementedError(
-            "knn_lsh_generic_classifier_train: pass distance_function as a "
-            "metric name ('euclidean' or 'cosine'); arbitrary distance "
-            "callables are not supported"
-        )
-    return knn_lsh_classifier_train(data, L, type=distance_function, **kwargs)  # type: ignore[arg-type]
+    """Generic variant (reference :135): `lsh_projection` is a callable
+    vec -> sequence of per-table bucket ids (one per OR-table) and
+    `distance_function` either a metric name ('euclidean' / 'cosine') or
+    a callable (query_vec, doc_vec) -> float used to rescore bucket
+    candidates."""
+    metric = distance_function if isinstance(distance_function, str) else "l2"
+    metric = {"euclidean": "l2", "cosine": "cos"}.get(metric, metric)
+    inner = LshKnn(
+        data_column=data.data,
+        metadata_column=None,
+        # d/M/A keep the classifier-train spelling and defaults
+        dimensions=kwargs.get("d"),
+        n_or=L,
+        n_and=kwargs.get("M", 10),
+        bucket_length=kwargs.get("A", 1.0),
+        distance_type=metric,
+        projection=lsh_projection,
+        distance=(
+            distance_function if callable(distance_function) else None
+        ),
+    )
+    return _model_from_inner(data, inner)
 
 
 def _model_from_inner(data: Table, inner: LshKnn) -> KnnModel:

@@ -185,15 +185,12 @@ class IntervalsOverWindow(Window):
 
 
 def intervals_over(
-    *, at: Any, lower_bound: Any, upper_bound: Any, is_outer: bool = False
+    *, at: Any, lower_bound: Any, upper_bound: Any, is_outer: bool = True
 ) -> IntervalsOverWindow:
-    # is_outer=True (emit empty windows for `at` points with no data) is a
-    # round-2 item; fail loudly rather than silently dropping the windows.
-    if is_outer:
-        raise NotImplementedError(
-            "intervals_over(is_outer=True) is not supported yet; "
-            "pass is_outer=False"
-        )
+    """Windows at each time t of `at` over [t+lower_bound, t+upper_bound].
+    is_outer=True (the reference default) emits EVERY `at` point's window;
+    empty ones carry a single all-None data row, so e.g. sorted_tuple
+    reduces to (None,) (reference: _window.py:795 intervals_over)."""
     return IntervalsOverWindow(at, lower_bound, upper_bound, is_outer)
 
 
@@ -209,6 +206,8 @@ class WindowedTable:
     def reduce(self, *args: Any, **kwargs: Any) -> Table:
         t = self._expanded
         gb_cols = [t._pw_window, t._pw_window_start, t._pw_window_end]
+        if "_pw_window_location" in t._column_names():
+            gb_cols.append(t._pw_window_location)  # intervals_over probes
         if self._instance_given:
             gb_cols.append(t._pw_instance)
         grouped = t.groupby(*gb_cols)
@@ -408,9 +407,43 @@ def _windowby_intervals_over(
         (ex.this._pw_time >= ex.this._pw_at + lb)
         & (ex.this._pw_time <= ex.this._pw_at + ub)
     )
-    return joined.with_columns(
+    expanded = joined.with_columns(
         _pw_window=ex.this._pw_at,
         _pw_window_start=ex.this._pw_at + lb,
         _pw_window_end=ex.this._pw_at + ub,
         _pw_window_location=ex.this._pw_at,
     )
+    if window.is_outer:
+        # outer windows: every `at` point yields a window even with no
+        # data in [at+lb, at+ub] — one all-None data row per empty
+        # window, exactly the reference's LEFT interval_join row
+        # (reference: _window.py _IntervalsOverWindow._apply:555,
+        # tests/temporal/test_windows.py is_outer=True fixture)
+        at_distinct = (
+            at_table.select(_pw_at=at_ref)
+            .groupby(ex.this._pw_at)
+            .reduce(_pw_at=ex.this._pw_at)
+            .with_id_from(ex.this._pw_at)
+        )
+        have = (
+            joined.groupby(ex.this._pw_at)
+            .reduce(_pw_at=ex.this._pw_at)
+            .with_id_from(ex.this._pw_at)
+        )
+        missing = at_distinct.join_left(
+            have, at_distinct._pw_at == have._pw_at
+        ).select(
+            _pw_at=ex.left._pw_at, _pw_hit=ex.right._pw_at
+        ).filter(ex.this._pw_hit.is_none())
+        empty = missing.select(
+            **{n: None for n in table._column_names()},
+            _pw_time=None,
+            _pw_instance=None,
+            _pw_at=ex.this._pw_at,
+            _pw_window=ex.this._pw_at,
+            _pw_window_start=ex.this._pw_at + lb,
+            _pw_window_end=ex.this._pw_at + ub,
+            _pw_window_location=ex.this._pw_at,
+        )
+        expanded = expanded.concat_reindex(empty)
+    return expanded

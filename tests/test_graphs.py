@@ -1,0 +1,152 @@
+"""Louvain community detection fixtures (reference semantics:
+python/pathway/stdlib/graphs/louvain_communities/impl.py, tests mirrored
+from python/pathway/tests/test_graphs.py test_louvain_* — gain formula
+2*deg(v in C') - deg(v)*(2*deg(C') + deg(v))/m, independent parallel
+moves, level contraction)."""
+
+from __future__ import annotations
+
+import itertools
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G as _G
+from pathway_tpu.stdlib.graphs import (
+    Graph,
+    exact_modularity,
+    louvain_communities,
+    louvain_level,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    _G.clear()
+    yield
+    _G.clear()
+
+
+def _graph(n_vertices: int, und_edges, weights=None):
+    """Build (Graph, vt) from undirected edges — each {u, v} appears as
+    (u, v) and (v, u), the reference's directed-double convention."""
+    rows = []
+    for i, (u, v) in enumerate(und_edges):
+        w = 1.0 if weights is None else float(weights[i])
+        rows.append((u, v, w))
+        rows.append((v, u, w))
+    vt = pw.debug.table_from_rows(
+        pw.schema_from_types(vid=int), [(i,) for i in range(n_vertices)]
+    ).with_id_from(pw.this.vid)
+    et = pw.debug.table_from_rows(
+        pw.schema_from_types(us=int, vs=int, weight=float), rows
+    )
+    et = et.select(
+        u=vt.pointer_from(pw.this.us),
+        v=vt.pointer_from(pw.this.vs),
+        weight=pw.this.weight,
+    )
+    return Graph(vt, et), vt
+
+
+def _communities(cl, vt):
+    _ids, cols = pw.debug.table_to_dicts(
+        cl.join(vt, cl.id == vt.id).select(vid=pw.right.vid, c=pw.left.c)
+    )
+    groups: dict = {}
+    for k in cols["vid"]:
+        groups.setdefault(cols["c"][k], set()).add(cols["vid"][k])
+    return sorted(sorted(g) for g in groups.values())
+
+
+def _modularity(G, cl) -> float:
+    _ids, cols = pw.debug.table_to_dicts(exact_modularity(G, cl, round_digits=9))
+    return next(iter(cols["modularity"].values()))
+
+
+def test_louvain_level_two_triangles():
+    G, vt = _graph(6, [(0, 1), (1, 2), (0, 2), (3, 4), (4, 5), (3, 5), (2, 3)])
+    cl = louvain_level(G)
+    assert _communities(cl, vt) == [[0, 1, 2], [3, 4, 5]]
+    # modularity of the 2-triangle partition: 2 * (6m - 7^2) / m^2, m=14
+    assert _modularity(G, cl) == pytest.approx(2 * (6 * 14 - 49) / 14**2)
+
+
+def test_louvain_level_weighted_pull():
+    # heavy edges 1-2 and 3-4 with a dominant 1-4 bridge: Louvain must
+    # group by weight, not adjacency count (the reference one_step
+    # fixture shape, tests/test_graphs.py test_louvain_one_step_01)
+    G, vt = _graph(
+        5,
+        [(0, 1), (2, 3), (0, 3), (4, 0), (4, 3)],
+        weights=[5.0, 5.0, 15.0, 0.5, 0.5],
+    )
+    cl = louvain_level(G)
+    groups = _communities(cl, vt)
+    merged = next(g for g in groups if 0 in g)
+    assert 3 in merged  # the heavy bridge endpoints cluster together
+
+
+def test_louvain_level_is_local_maximum():
+    """No single-vertex move can improve modularity after louvain_level
+    (the level's defining property in the reference)."""
+    und = [(0, 1), (1, 2), (0, 2), (2, 3), (3, 4), (4, 5), (3, 5), (1, 4)]
+    G, vt = _graph(6, und)
+    cl = louvain_level(G)
+    base = _modularity(G, cl)
+
+    # brute-force recompute modularity for every single-vertex move
+    _ids, cols = pw.debug.table_to_dicts(
+        cl.join(vt, cl.id == vt.id).select(vid=pw.right.vid, c=pw.left.c)
+    )
+    assign = {cols["vid"][k]: cols["c"][k] for k in cols["vid"]}
+    edges_dir = [(u, v, 1.0) for u, v in und] + [(v, u, 1.0) for u, v in und]
+    m = sum(w for _u, _v, w in edges_dir)
+
+    def mod(a: dict) -> float:
+        internal: dict = {}
+        deg: dict = {}
+        for u, v, w in edges_dir:
+            deg[a[u]] = deg.get(a[u], 0.0) + w
+            if a[u] == a[v]:
+                internal[a[u]] = internal.get(a[u], 0.0) + w
+        return sum(
+            (internal.get(c, 0.0) * m - d * d) / (m * m)
+            for c, d in deg.items()
+        )
+
+    assert mod(assign) == pytest.approx(base)
+    comms = set(assign.values())
+    for vid, c_new in itertools.product(assign, comms):
+        if assign[vid] == c_new:
+            continue
+        trial = dict(assign)
+        trial[vid] = c_new
+        assert mod(trial) <= base + 1e-9, (vid, c_new)
+
+
+def test_louvain_communities_two_levels():
+    # 4 triangles in a ring: level 1 groups each triangle; a second level
+    # (contracted graph) must not split level-1 communities
+    und = []
+    for t in range(4):
+        b = 3 * t
+        und += [(b, b + 1), (b + 1, b + 2), (b, b + 2)]
+    und += [(2, 3), (5, 6), (8, 9), (11, 0)]
+    G, vt = _graph(12, und)
+    cl1 = louvain_communities(G, levels=1)
+    g1 = _communities(cl1, vt)
+    assert [0, 1, 2] in g1 and [3, 4, 5] in g1
+    cl2 = louvain_communities(G, levels=2)
+    g2 = _communities(cl2, vt)
+    # level-2 communities are unions of level-1 communities
+    for grp in g1:
+        containing = [h for h in g2 if set(grp) <= set(h)]
+        assert len(containing) == 1, (grp, g2)
+
+
+def test_exact_modularity_singletons():
+    G, _vt = _graph(4, [(0, 1), (2, 3)])
+    singles = G.V.select(c=G.V.pointer_from(G.V.id))
+    # all-singleton modularity: sum of -(deg_c/m)^2 = 4 * -(1/4)^2
+    assert _modularity(G, singles) == pytest.approx(-0.25)

@@ -198,3 +198,48 @@ def test_hmm_reducer_decodes_path():
     decoded2 = obs2.reduce(path=hmm_red(pw.this.observation))
     (path2,) = [r[0] for r in run_capture(decoded2).state.rows.values()]
     assert path2 == ("FULL", "HUNGRY", "HUNGRY", "FULL")
+
+
+def test_knn_lsh_generic_custom_projection_and_distance():
+    """Custom lsh_projection + distance callables drive bucketing and
+    rescoring (reference: ml/classifiers/_knn_lsh.py:135
+    knn_lsh_generic_classifier_train)."""
+    import numpy as np
+
+    from pathway_tpu.stdlib.ml.classifiers import (
+        knn_lsh_classify,
+        knn_lsh_generic_classifier_train,
+    )
+
+    calls = {"proj": 0, "dist": 0}
+
+    def proj(vec):
+        calls["proj"] += 1
+        return [(int(vec[0] > 0),), (int(vec[1] > 0),)]
+
+    def l1(q, d):
+        calls["dist"] += 1
+        return float(np.abs(q - d).sum())
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(20):
+        cls = i % 2
+        center = np.array([4.0, 4.0]) if cls else np.array([-4.0, -4.0])
+        rows.append((center + rng.normal(scale=0.5, size=2), cls))
+    both = pw.debug.table_from_rows(
+        pw.schema_from_types(data=np.ndarray, label=int), rows
+    )
+    data = both.select(both.data)
+    labels = both.select(both.label)
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(data=np.ndarray),
+        [(np.array([3.5, 3.9]),), (np.array([-3.2, -4.4]),)],
+    )
+    model = knn_lsh_generic_classifier_train(
+        data, lsh_projection=proj, distance_function=l1, L=2
+    )
+    result = knn_lsh_classify(model, labels, queries, k=5)
+    _ids, cols = pw.debug.table_to_dicts(result)
+    assert list(cols["predicted_label"].values()) == [1, 0]
+    assert calls["proj"] > 0 and calls["dist"] > 0

@@ -502,3 +502,51 @@ def test_tumbling_window_retracts_on_update():
         start=pw.this._pw_window_start, n=pw.reducers.count()
     )
     assert _vals(res, "start", "n") == [(0, 1), (10, 1)]
+
+
+def test_intervals_over_is_outer_reference_fixture():
+    """is_outer=True (the reference DEFAULT) emits every probe's window;
+    empty ones carry one all-None row, so sorted_tuple gives (None,)
+    (reference: tests/temporal/test_windows.py is_outer=True fixture)."""
+    t = pw.debug.table_from_markdown(
+        """
+        t  | v
+        1  | 10
+        2  | 1
+        3  | 3
+        8  | 2
+        9  | 4
+        10 | 8
+        1  | 9
+        2  | 16
+        """
+    )
+    probes = pw.debug.table_from_markdown(
+        """
+        t
+        2
+        4
+        6
+        8
+        10
+        """
+    )
+    res = pw.temporal.windowby(
+        t, t.t,
+        window=pw.temporal.intervals_over(
+            at=probes.t, lower_bound=-2, upper_bound=1, is_outer=True
+        ),
+    ).reduce(
+        pw.this._pw_window_location, v=pw.reducers.sorted_tuple(pw.this.v)
+    )
+    _ids, cols = pw.debug.table_to_dicts(res)
+    got = sorted(
+        (cols["_pw_window_location"][k], cols["v"][k]) for k in cols["v"]
+    )
+    assert got == [
+        (2, (1, 3, 9, 10, 16)),
+        (4, (1, 3, 16)),
+        (6, (None,)),
+        (8, (2, 4)),
+        (10, (2, 4, 8)),
+    ]
