@@ -12,9 +12,19 @@ its slice of one global mesh and this same program spans hosts over
 ICI/DCN; single-host it runs across the local (or virtual) devices, which
 is what the multichip dryrun validates.
 
-Enabled with PATHWAY_DEVICE_EXCHANGE=1 (off by default: for small host
-batches the device round-trip costs more than it saves; it pays off when
-vector payloads dominate, e.g. DocumentStore embedding shuffles).
+Mode (PATHWAY_DEVICE_EXCHANGE): "1" forces the device plane on, "0"
+forces it off, unset = AUTO. Auto enables per batch only when all of:
+  * the mesh is real multi-device TPU (on a CPU/virtual mesh the
+    "device" hop is just extra copies — measured always slower), and
+  * the vector payload is at least PATHWAY_DEVICE_EXCHANGE_MIN_ELEMS
+    elements (default 262144 = the measured crossover against the
+    pickled TCP wire on the bench host; see docs/parallelism.md for the
+    full rows x width table — in-process reference-passing is always
+    cheaper, so the payoff exists only where rows would otherwise
+    serialize).
+Payload dtypes: float32 natively; int32 rides bit-exactly as f32 views.
+float64 stays host-side (casting would round row bytes and break
+retraction identity) and bf16 host arrays don't exist in numpy.
 """
 
 from __future__ import annotations
@@ -27,9 +37,39 @@ import numpy as np
 from pathway_tpu.parallel.exchange import exchange_with_respill
 from pathway_tpu.parallel.mesh import default_mesh
 
+AUTO_MIN_ELEMS = 262_144  # measured wire crossover (docs/parallelism.md)
+
+
+def mode() -> str:
+    v = os.environ.get("PATHWAY_DEVICE_EXCHANGE")
+    if v == "1":
+        return "force"
+    if v == "0":
+        return "off"
+    return "auto"
+
 
 def enabled() -> bool:
-    return os.environ.get("PATHWAY_DEVICE_EXCHANGE", "0") == "1"
+    return mode() != "off"
+
+
+def auto_min_elems() -> int:
+    raw = os.environ.get("PATHWAY_DEVICE_EXCHANGE_MIN_ELEMS")
+    if raw is None:
+        return AUTO_MIN_ELEMS
+    try:
+        return int(float(raw))
+    except ValueError:
+        return AUTO_MIN_ELEMS  # malformed override: keep the measured default
+
+
+def auto_eligible_mesh(mesh) -> bool:
+    """Auto mode only pays on a real multi-device TPU mesh."""
+    try:
+        devs = list(mesh.devices.flat)
+    except Exception:  # noqa: BLE001
+        return False
+    return len(devs) > 1 and getattr(devs[0], "platform", "") == "tpu"
 
 
 class DeviceExchanger:
@@ -49,19 +89,21 @@ class DeviceExchanger:
         self.axis = axis
         self.invocations = 0
         self.rows_exchanged = 0
+        self._auto_ok = auto_eligible_mesh(self.mesh)
+        self._auto_min = auto_min_elems()  # parsed once, not per batch
 
     # ------------------------------------------------------------ detection
 
     @staticmethod
     def _vector_columns(row: tuple) -> list[int]:
-        # float32 only: the exchange carries f32, and a float64 column
+        # f32 rides natively; i32 rides as a bit-exact f32 view. f64
         # would come back rounded — silently different row bytes break
-        # downstream retraction matching
+        # downstream retraction matching — so it stays host-side.
         return [
             i
             for i, v in enumerate(row)
             if isinstance(v, np.ndarray)
-            and v.dtype == np.float32
+            and v.dtype in (np.float32, np.int32)
             and v.ndim >= 1
         ]
 
@@ -86,15 +128,23 @@ class DeviceExchanger:
         shapes = [first_row[c].shape for c in vcols]
         dtypes = [first_row[c].dtype for c in vcols]
         n = len(entries)
+        if mode() == "auto":
+            n_elems = n * sum(
+                int(np.prod(s)) for s in shapes
+            )
+            if not (self._auto_ok and n_elems >= self._auto_min):
+                return None  # below the measured wire crossover
         dests = np.empty(n, np.int64)
         mats = []
         try:
             for j, c in enumerate(vcols):
                 mat = np.stack([e[1][c] for e in entries])
-                if mat.dtype != np.float32:
-                    # some LATER row wasn't f32: casting would change row
-                    # bytes silently (see _vector_columns) — host path
+                if mat.dtype != dtypes[j]:
+                    # some LATER row changed dtype: casting would change
+                    # row bytes silently (see _vector_columns) — host path
                     return None
+                if mat.dtype == np.int32:
+                    mat = mat.view(np.float32)  # bit-exact transport form
                 mats.append(mat.reshape(n, -1))
             for i, (key, row, _diff) in enumerate(entries):
                 dests[i] = shard_of_entry(key, row)
@@ -116,7 +166,10 @@ class DeviceExchanger:
                 parts = np.split(vec_row, np.cumsum(widths)[:-1]) if len(mats) > 1 else [vec_row]
                 new_row = list(row)
                 for j, c in enumerate(vcols):
-                    new_row[c] = parts[j].reshape(shapes[j]).astype(dtypes[j])
+                    p = np.ascontiguousarray(parts[j], np.float32)
+                    if dtypes[j] == np.int32:
+                        p = p.view(np.int32)  # undo the bit-exact view
+                    new_row[c] = p.reshape(shapes[j])
                 out[d].append((key, tuple(new_row), diff))
         return out
 

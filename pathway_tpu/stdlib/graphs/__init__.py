@@ -246,6 +246,8 @@ def louvain_communities(
     column `c` — each vertex's community at the final level (reference:
     louvain_communities/impl.py louvain_communities_fixed_iterations +
     contracted_to_weighted_simple_graph)."""
+    if levels < 1:
+        raise ValueError(f"louvain_communities: levels must be >= 1, got {levels}")
     V, E = G.V, _with_weight(G.E)
     mapping: Table | None = None
     for _lvl in range(levels):

@@ -93,8 +93,15 @@ def knn_lsh_generic_classifier_train(
     `distance_function` either a metric name ('euclidean' / 'cosine') or
     a callable (query_vec, doc_vec) -> float used to rescore bucket
     candidates."""
-    metric = distance_function if isinstance(distance_function, str) else "l2"
-    metric = {"euclidean": "l2", "cosine": "cos"}.get(metric, metric)
+    if isinstance(distance_function, str):
+        try:
+            metric = {"euclidean": "l2", "cosine": "cos"}[distance_function]
+        except KeyError:
+            raise ValueError(
+                f"unsupported LSH distance type {distance_function!r}"
+            ) from None
+    else:
+        metric = "l2"  # unused: the callable rescorer takes over
     inner = LshKnn(
         data_column=data.data,
         metadata_column=None,

@@ -143,3 +143,65 @@ def test_engine_groupby_routes_vectors_through_device_exchange(monkeypatch):
     assert ex.rows_exchanged >= 64
     assert got == base == [(f"cat{i}", 13 if i < 4 else 12) for i in range(5)]
     dx._ENGINE_EXCHANGER = None
+
+
+def test_device_exchange_auto_mode_policy(monkeypatch):
+    """Auto mode (env unset) enables the device plane only on a real
+    multi-device TPU mesh AND above the measured payload crossover;
+    PATHWAY_DEVICE_EXCHANGE=1/0 force/disable it regardless."""
+    import numpy as np
+
+    from pathway_tpu.internals.keys import key_for_values
+    from pathway_tpu.parallel import device_exchange as dx
+
+    monkeypatch.delenv("PATHWAY_DEVICE_EXCHANGE", raising=False)
+    assert dx.mode() == "auto"
+    monkeypatch.setenv("PATHWAY_DEVICE_EXCHANGE", "1")
+    assert dx.mode() == "force" and dx.enabled()
+    monkeypatch.setenv("PATHWAY_DEVICE_EXCHANGE", "0")
+    assert dx.mode() == "off" and not dx.enabled()
+
+    # the virtual CPU mesh is never auto-eligible (measured always-lose:
+    # in-process routing passes references; the device hop only copies)
+    ex = dx.DeviceExchanger()
+    assert not ex._auto_ok
+    monkeypatch.delenv("PATHWAY_DEVICE_EXCHANGE", raising=False)
+    entries = [
+        (key_for_values(i), (i, np.ones(1024, np.float32)), 1)
+        for i in range(1024)
+    ]
+    assert ex.try_exchange(entries, lambda k, r: k.value % 2, 2) is None
+    # an auto-eligible mesh above the crossover would engage: simulate
+    # eligibility; 1024 rows x 1024 dims = 1M elems >= 262144
+    ex._auto_ok = True
+    routed = ex.try_exchange(entries, lambda k, r: k.value % 2, 2)
+    assert routed is not None and sum(len(r) for r in routed) == 1024
+    # below the crossover auto stays off even on an eligible mesh
+    small = entries[:64]
+    assert ex.try_exchange(small, lambda k, r: k.value % 2, 2) is None
+
+
+def test_device_exchange_int32_bit_exact(monkeypatch):
+    """int32 vector columns ride the exchange as f32 views and come back
+    bit-identical (incl. values whose f32 cast would round)."""
+    import numpy as np
+
+    from pathway_tpu.internals.keys import key_for_values
+    from pathway_tpu.parallel import device_exchange as dx
+
+    monkeypatch.setenv("PATHWAY_DEVICE_EXCHANGE", "1")
+    ex = dx.DeviceExchanger()
+    rng = np.random.default_rng(3)
+    vals = [
+        rng.integers(-(2**31) + 1, 2**31 - 1, 16, dtype=np.int32)
+        for _ in range(32)
+    ]
+    entries = [
+        (key_for_values(i), (i, v), 1) for i, v in enumerate(vals)
+    ]
+    routed = ex.try_exchange(entries, lambda k, r: k.value % 2, 2)
+    assert routed is not None
+    got = {row[0]: row[1] for shard in routed for _k, row, _d in shard}
+    for i, v in enumerate(vals):
+        assert got[i].dtype == np.int32
+        assert np.array_equal(got[i], v), i
