@@ -205,3 +205,58 @@ def test_device_exchange_int32_bit_exact(monkeypatch):
     for i, v in enumerate(vals):
         assert got[i].dtype == np.int32
         assert np.array_equal(got[i], v), i
+
+
+def test_native_kernel_gil_overlap():
+    """Two threads running native wave kernels concurrently must overlap:
+    the C dataplane is called through ctypes.CDLL, which releases the GIL
+    for the duration of every call, so thread shards parallelize across
+    cores. Needs >= 2 cores to observe overlap — SKIPS (never silently
+    passes) on single-core hosts like the current bench box; see
+    docs/parallelism.md for the expected multi-core behavior."""
+    import os
+    import threading
+    import time
+
+    import numpy as np
+
+    from pathway_tpu.engine.native import dataplane as dp
+
+    if not dp.available():
+        pytest.skip("native dataplane unavailable")
+    if (os.cpu_count() or 1) < 2:
+        pytest.skip("kernel-overlap needs >= 2 cores (1-core host)")
+
+    blob = (
+        "\n".join(
+            '{"k": %d, "v": %d}' % (i % 1000, i) for i in range(400_000)
+        )
+        + "\n"
+    ).encode()
+
+    def work():
+        tab = dp.InternTable()
+        dp.ingest_jsonl(tab, blob, ["k", "v"], [], 7, 0, [2, 2])
+
+    work()  # warm (lib load, allocator)
+    t0 = time.perf_counter()
+    work()
+    work()
+    serial = time.perf_counter() - t0
+
+    best_parallel = float("inf")
+    for _ in range(3):
+        th = [threading.Thread(target=work) for _ in range(2)]
+        t0 = time.perf_counter()
+        for t in th:
+            t.start()
+        for t in th:
+            t.join()
+        best_parallel = min(best_parallel, time.perf_counter() - t0)
+
+    overlap = serial / best_parallel
+    assert overlap >= 1.5, (
+        f"native kernels did not overlap across threads: serial {serial:.3f}s"
+        f" vs parallel {best_parallel:.3f}s (x{overlap:.2f}) — is the GIL"
+        " held across dataplane calls?"
+    )
