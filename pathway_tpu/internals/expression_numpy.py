@@ -293,6 +293,30 @@ def compile_numpy(
                               np.zeros(n, bool), v.bad)
                 return vabs
             return None
+        if isinstance(e, ex.IfElseExpression):
+            cf = rec(e._if)
+            tf = rec(e._then)
+            ef = rec(e._else)
+            if cf is None or tf is None or ef is None:
+                return None
+
+            def ifelse(decoded, n, _c=cf, _t=tf, _e=ef):
+                c = _c(decoded, n)
+                t = _t(decoded, n)
+                el = _e(decoded, n)
+                # condition must be a clean bool; branch rows inherit
+                # their branch's value/flags, bad if their branch is bad
+                pick = c.vi != 0
+                bad = c.bad | ~c.isbool | np.where(pick, t.bad, el.bad)
+                return _V(
+                    np.where(pick, t.vf, el.vf),
+                    np.where(pick, t.vi, el.vi),
+                    np.where(pick, t.isint, el.isint),
+                    np.where(pick, t.isbool, el.isbool),
+                    bad,
+                )
+
+            return ifelse
         if isinstance(e, ex.IsNoneExpression):
             f = rec(e._expr)
             if f is None:

@@ -15,7 +15,7 @@ from typing import Any
 import pathway_tpu.internals.reducers as red
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import expression as ex
-from pathway_tpu.internals.common import apply_with_type
+from pathway_tpu.internals.common import apply_with_type, if_else
 from pathway_tpu.internals.expression import ColumnExpression, wrap_arg
 from pathway_tpu.internals.groupbys import GroupedTable
 from pathway_tpu.internals.table import Table
@@ -293,8 +293,24 @@ def windowby(
             extra={"_pw_instance": instance if instance is not None else 0},
         )
 
+    # Behavior operator ORDER mirrors the reference exactly
+    # (reference _window.py:395-415): the cutoff FREEZE sits UPSTREAM of
+    # the buffer so its watermark advances with every arriving row —
+    # downstream of the buffer it would only see released rows, lag
+    # behind, and let late window updates through (breaking
+    # exactly-once). After the buffer, event times clamp to the release
+    # time so the post-buffer forget's watermark tracks releases.
     if isinstance(behavior, ExactlyOnceBehavior):
+        # reference: common_behavior(duration + shift, shift, True)
         shift = behavior.shift
+        thr = (
+            ex.this._pw_window_end
+            if shift is None
+            else ex.this._pw_window_end + shift
+        )
+        expanded = expanded._freeze(
+            _bind_this(thr, expanded), ex.ColumnReference(expanded, "_pw_time")
+        )
         thr = (
             ex.this._pw_window_end
             if shift is None
@@ -303,26 +319,39 @@ def windowby(
         expanded = expanded._buffer(
             _bind_this(thr, expanded), ex.ColumnReference(expanded, "_pw_time")
         )
-        expanded = expanded._freeze(
-            _bind_this(
-                ex.this._pw_window_end + shift if shift is not None else ex.this._pw_window_end,
-                expanded,
-            ),
-            ex.ColumnReference(expanded, "_pw_time"),
-        )
     elif isinstance(behavior, CommonBehavior):
-        if behavior.delay is not None:
-            expanded = expanded._buffer(
-                ex.ColumnReference(expanded, "_pw_window_start") + behavior.delay,
+        if behavior.cutoff is not None:
+            expanded = expanded._freeze(
+                ex.ColumnReference(expanded, "_pw_window_end") + behavior.cutoff,
                 ex.ColumnReference(expanded, "_pw_time"),
             )
-        if behavior.cutoff is not None:
-            thr_e = ex.ColumnReference(expanded, "_pw_window_end") + behavior.cutoff
-            cur_e = ex.ColumnReference(expanded, "_pw_time")
-            if behavior.keep_results:
-                expanded = expanded._freeze(thr_e, cur_e)
-            else:
-                expanded = expanded._forget(thr_e, cur_e)
+        if behavior.delay is not None:
+            release = (
+                ex.ColumnReference(expanded, "_pw_window_start") + behavior.delay
+            )
+            expanded = expanded._buffer(
+                release, ex.ColumnReference(expanded, "_pw_time")
+            )
+            if behavior.cutoff is not None and not behavior.keep_results:
+                # clamp event times to the release time so the post-
+                # buffer forget's watermark tracks releases — only the
+                # forget consumes this (vectorized: if_else compiles to
+                # a numpy plan, so the wave stays token-resident)
+                expanded = expanded.with_columns(
+                    _pw_time=if_else(
+                        ex.ColumnReference(expanded, "_pw_time")
+                        > ex.ColumnReference(expanded, "_pw_window_start")
+                        + behavior.delay,
+                        ex.ColumnReference(expanded, "_pw_time"),
+                        ex.ColumnReference(expanded, "_pw_window_start")
+                        + behavior.delay,
+                    )
+                )
+        if behavior.cutoff is not None and not behavior.keep_results:
+            expanded = expanded._forget(
+                ex.ColumnReference(expanded, "_pw_window_end") + behavior.cutoff,
+                ex.ColumnReference(expanded, "_pw_time"),
+            )
 
     return WindowedTable(expanded, True)
 

@@ -218,8 +218,6 @@ def test_native_kernel_gil_overlap():
     import threading
     import time
 
-    import numpy as np
-
     from pathway_tpu.engine.native import dataplane as dp
 
     if not dp.available():
@@ -239,10 +237,12 @@ def test_native_kernel_gil_overlap():
         dp.ingest_jsonl(tab, blob, ["k", "v"], [], 7, 0, [2, 2])
 
     work()  # warm (lib load, allocator)
-    t0 = time.perf_counter()
-    work()
-    work()
-    serial = time.perf_counter() - t0
+    serial = float("inf")
+    for _ in range(3):  # best-of-3 both sides: robust to co-tenant load
+        t0 = time.perf_counter()
+        work()
+        work()
+        serial = min(serial, time.perf_counter() - t0)
 
     best_parallel = float("inf")
     for _ in range(3):

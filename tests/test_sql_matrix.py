@@ -1,0 +1,216 @@
+"""pw.sql matrix: SELECT / WHERE / GROUP BY / HAVING / JOIN / CTE /
+set-op queries checked against plain-Python models of the same relation
+algebra (reference tier-2: tests/test_sql.py)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+SALES = [
+    ("north", "widget", 10, 2.5),
+    ("north", "gadget", 3, 10.0),
+    ("south", "widget", 7, 2.5),
+    ("south", "gizmo", 2, 99.0),
+    ("east", "widget", 1, 2.5),
+    ("east", "widget", 4, 2.5),
+]
+
+
+def _sales():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(region=str, item=str, qty=int, price=float),
+        SALES,
+    )
+
+
+def _rows(table):
+    _ids, cols = pw.debug.table_to_dicts(table)
+    names = list(cols)
+    return sorted(
+        tuple(cols[n][k] for n in names) for k in cols[names[0]]
+    ), names
+
+
+def test_select_where_projection():
+    t = _sales()
+    q = pw.sql("SELECT region, qty FROM t WHERE qty > 3", t=t)
+    got, _names = _rows(q)
+    want = sorted((r, q_) for r, _i, q_, _p in SALES if q_ > 3)
+    assert got == want
+
+
+def test_select_computed_column_and_alias():
+    t = _sales()
+    q = pw.sql("SELECT region, qty * price AS total FROM t", t=t)
+    got, names = _rows(q)
+    assert names == ["region", "total"]
+    want = sorted((r, q_ * p) for r, _i, q_, p in SALES)
+    assert got == want
+
+
+def test_group_by_aggregates():
+    t = _sales()
+    q = pw.sql(
+        "SELECT region, SUM(qty) AS s, COUNT(*) AS n, AVG(price) AS a "
+        "FROM t GROUP BY region",
+        t=t,
+    )
+    got, _ = _rows(q)
+    model: dict = {}
+    for r, _i, qy, p in SALES:
+        s, n, ps = model.get(r, (0, 0, 0.0))
+        model[r] = (s + qy, n + 1, ps + p)
+    want = sorted((r, s, n, ps / n) for r, (s, n, ps) in model.items())
+    assert got == want
+
+
+def test_group_by_having():
+    t = _sales()
+    # dialect note: HAVING evaluates over the aggregated row, so the
+    # aggregate is referenced by its alias (documented pw.sql subset)
+    q = pw.sql(
+        "SELECT item, SUM(qty) AS s FROM t GROUP BY item HAVING s > 5",
+        t=t,
+    )
+    got, _ = _rows(q)
+    model: dict = {}
+    for _r, i, qy, _p in SALES:
+        model[i] = model.get(i, 0) + qy
+    want = sorted((i, s) for i, s in model.items() if s > 5)
+    assert got == want
+
+
+def test_join_two_tables():
+    t = _sales()
+    taxes = pw.debug.table_from_rows(
+        pw.schema_from_types(region=str, rate=float),
+        [("north", 0.1), ("south", 0.2), ("west", 0.5)],
+    )
+    q = pw.sql(
+        "SELECT t.item, t.qty, x.rate FROM t JOIN x ON t.region = x.region",
+        t=t, x=taxes,
+    )
+    got, _ = _rows(q)
+    rates = {"north": 0.1, "south": 0.2, "west": 0.5}
+    want = sorted(
+        (i, qy, rates[r]) for r, i, qy, _p in SALES if r in rates
+    )
+    assert got == want
+
+
+def test_cte_with_chain():
+    t = _sales()
+    q = pw.sql(
+        "WITH big AS (SELECT region, qty FROM t WHERE qty >= 3), "
+        "agg AS (SELECT region, SUM(qty) AS s FROM big GROUP BY region) "
+        "SELECT region, s FROM agg WHERE s > 5",
+        t=t,
+    )
+    got, _ = _rows(q)
+    model: dict = {}
+    for r, _i, qy, _p in SALES:
+        if qy >= 3:
+            model[r] = model.get(r, 0) + qy
+    want = sorted((r, s) for r, s in model.items() if s > 5)
+    assert got == want
+
+
+def test_union_dedups_union_all_keeps():
+    a = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(1,), (2,), (2,)]
+    )
+    b = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(2,), (3,)]
+    )
+    u, _ = _rows(pw.sql("SELECT v FROM a UNION SELECT v FROM b", a=a, b=b))
+    assert u == [(1,), (2,), (3,)]
+    G.clear()
+    a = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(1,), (2,), (2,)]
+    )
+    b = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(2,), (3,)]
+    )
+    ua, _ = _rows(
+        pw.sql("SELECT v FROM a UNION ALL SELECT v FROM b", a=a, b=b)
+    )
+    assert ua == [(1,), (2,), (2,), (2,), (3,)]
+
+
+def test_intersect_except():
+    a = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(1,), (2,), (3,)]
+    )
+    b = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(2,), (3,), (4,)]
+    )
+    i, _ = _rows(
+        pw.sql("SELECT v FROM a INTERSECT SELECT v FROM b", a=a, b=b)
+    )
+    assert i == [(2,), (3,)]
+    G.clear()
+    a = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(1,), (2,), (3,)]
+    )
+    b = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(2,), (3,), (4,)]
+    )
+    e, _ = _rows(pw.sql("SELECT v FROM a EXCEPT SELECT v FROM b", a=a, b=b))
+    assert e == [(1,)]
+
+
+def test_from_subquery():
+    t = _sales()
+    q = pw.sql(
+        "SELECT region, s FROM "
+        "(SELECT region, SUM(qty) AS s FROM t GROUP BY region) "
+        "WHERE s >= 6",
+        t=t,
+    )
+    got, _ = _rows(q)
+    model: dict = {}
+    for r, _i, qy, _p in SALES:
+        model[r] = model.get(r, 0) + qy
+    want = sorted((r, s) for r, s in model.items() if s >= 6)
+    assert got == want
+
+
+def test_where_boolean_combinators():
+    t = _sales()
+    q = pw.sql(
+        "SELECT item FROM t WHERE (qty > 2 AND price < 5.0) OR region = 'east'",
+        t=t,
+    )
+    got, _ = _rows(q)
+    want = sorted(
+        (i,)
+        for r, i, qy, p in SALES
+        if (qy > 2 and p < 5.0) or r == "east"
+    )
+    assert got == want
+
+
+def test_sql_over_update_stream():
+    t = pw.debug.table_from_markdown(
+        """
+        g | v | __time__ | __diff__
+        a | 5 | 2        | 1
+        a | 6 | 2        | 1
+        b | 1 | 4        | 1
+        a | 6 | 6        | -1
+        """
+    )
+    q = pw.sql("SELECT g, SUM(v) AS s FROM t GROUP BY g", t=t)
+    got, _ = _rows(q)
+    assert got == [("a", 5), ("b", 1)]

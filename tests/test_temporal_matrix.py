@@ -1,0 +1,352 @@
+"""Temporal matrix: window kinds x behaviors x planes, interval/asof/
+window join modes — every expectation computed by an independent Python
+model (reference tier-2 style: tests/temporal/test_windows.py,
+test_interval_joins.py, test_asof_joins.py).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+EVENTS = [(1, 10), (2, 1), (3, 3), (4, 7), (8, 2), (9, 4), (10, 8), (15, 5)]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _events_table(rows=EVENTS):
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, v=int), rows
+    )
+
+
+def _window_result(win, rows=EVENTS, behavior=None):
+    t = _events_table(rows)
+    res = pw.temporal.windowby(t, t.t, window=win, behavior=behavior).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        n=pw.reducers.count(),
+        sv=pw.reducers.sum(pw.this.v),
+    )
+    _ids, cols = pw.debug.table_to_dicts(res)
+    return sorted(
+        (cols["start"][k], cols["end"][k], cols["n"][k], cols["sv"][k])
+        for k in cols["n"]
+    )
+
+
+# ------------------------------------------------------------- tumbling
+
+
+@pytest.mark.parametrize("duration", [2, 3, 5, 10])
+def test_tumbling_model(duration):
+    want = {}
+    for t, v in EVENTS:
+        s = (t // duration) * duration
+        n, sv = want.get(s, (0, 0))
+        want[s] = (n + 1, sv + v)
+    expected = sorted((s, s + duration, n, sv) for s, (n, sv) in want.items())
+    assert _window_result(pw.temporal.tumbling(duration=duration)) == expected
+
+
+@pytest.mark.parametrize("origin", [-1, 1, 4])
+def test_tumbling_origin_model(origin):
+    duration = 4
+    want = {}
+    for t, v in EVENTS:
+        s = ((t - origin) // duration) * duration + origin
+        n, sv = want.get(s, (0, 0))
+        want[s] = (n + 1, sv + v)
+    expected = sorted((s, s + duration, n, sv) for s, (n, sv) in want.items())
+    got = _window_result(
+        pw.temporal.tumbling(duration=duration, origin=origin)
+    )
+    assert got == expected
+
+
+# -------------------------------------------------------------- sliding
+
+
+@pytest.mark.parametrize("hop,duration", [(2, 4), (3, 6), (5, 5)])
+def test_sliding_model(hop, duration):
+    want = {}
+    for t, v in EVENTS:
+        # all starts s = k*hop with s <= t < s+duration
+        k = (t - duration) // hop + 1
+        while k * hop <= t:
+            s = k * hop
+            if t < s + duration:
+                n, sv = want.get(s, (0, 0))
+                want[s] = (n + 1, sv + v)
+            k += 1
+    expected = sorted((s, s + duration, n, sv) for s, (n, sv) in want.items())
+    got = _window_result(pw.temporal.sliding(hop=hop, duration=duration))
+    assert got == expected
+
+
+# -------------------------------------------------------------- session
+
+
+def test_session_max_gap_model():
+    got = _window_result(pw.temporal.session(max_gap=3))
+    # gaps > 3 split: times 1,2,3,4 | 8,9,10 | 15
+    assert [(n, sv) for _s, _e, n, sv in got] == [(4, 21), (3, 14), (1, 5)]
+
+
+def test_session_predicate():
+    got = _window_result(
+        pw.temporal.session(predicate=lambda a, b: abs(a - b) <= 4)
+    )
+    # chain: 1..4 -> 8,9,10 joins via 4->8; 15 splits (10->15 gap 5)
+    assert [(n, sv) for _s, _e, n, sv in got] == [(7, 35), (1, 5)]
+
+
+# ---------------------------------------------- behaviors on update streams
+
+
+def _stream_window(behavior):
+    t = pw.debug.table_from_markdown(
+        """
+        t  | v | __time__
+        1  | 1 | 2
+        2  | 2 | 2
+        11 | 3 | 4
+        3  | 9 | 6
+        21 | 4 | 6
+        31 | 5 | 8
+        """
+    )
+    win = pw.temporal.windowby(
+        t, t.t, window=pw.temporal.tumbling(duration=10), behavior=behavior
+    )
+    res = win.reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    _ids, cols = pw.debug.table_to_dicts(res)
+    return sorted((cols["start"][k], cols["n"][k]) for k in cols["n"])
+
+
+def test_behavior_none_keeps_late_rows():
+    assert _stream_window(None) == [(0, 3), (10, 1), (20, 1), (30, 1)]
+
+
+def test_behavior_exactly_once_drops_late_window_updates():
+    # t=3 arrives at wall-time 6, after watermark 11 closed window 0
+    assert _stream_window(pw.temporal.exactly_once_behavior()) == [
+        (0, 2), (10, 1), (20, 1), (30, 1),
+    ]
+
+
+def test_behavior_cutoff_forgets_old_windows():
+    got = _stream_window(
+        pw.temporal.common_behavior(cutoff=15, keep_results=False)
+    )
+    # window 0 (end+cutoff = 25 <= final watermark 31) is retracted; the
+    # late t=3 row arrived while 25 > watermark 11, so it was accepted
+    # first. Window 10 survives: 20+15 = 35 > 31.
+    assert got == [(10, 1), (20, 1), (30, 1)]
+
+
+def test_behavior_cutoff_keep_results_freezes():
+    got = _stream_window(
+        pw.temporal.common_behavior(cutoff=15, keep_results=True)
+    )
+    # frozen windows keep their last state; the late t=3 row is ignored
+    # once 0's end+cutoff=25 <= watermark at its arrival? (arrives at
+    # now=11 < 25: accepted). All windows stay visible.
+    assert got == [(0, 3), (10, 1), (20, 1), (30, 1)]
+
+
+# ------------------------------------------------------- interval joins
+
+
+L_TIMES = [(0, "a"), (4, "b"), (7, "c"), (12, "d")]
+R_TIMES = [(1, "x"), (3, "y"), (8, "z"), (20, "w")]
+
+
+def _model_interval(mode, lb, ub):
+    out = []
+    lm, rm = set(), set()
+    for li, (lt, lv) in enumerate(L_TIMES):
+        for ri, (rt, rv) in enumerate(R_TIMES):
+            if lt + lb <= rt <= lt + ub:
+                out.append((lv, rv))
+                lm.add(li)
+                rm.add(ri)
+    if mode in ("left", "outer"):
+        out += [(lv, None) for i, (_t, lv) in enumerate(L_TIMES) if i not in lm]
+    if mode in ("right", "outer"):
+        out += [(None, rv) for i, (_t, rv) in enumerate(R_TIMES) if i not in rm]
+    return sorted(out, key=lambda p: (repr(p[0]), repr(p[1])))
+
+
+@pytest.mark.parametrize("mode", ["inner", "left", "right", "outer"])
+@pytest.mark.parametrize("lb,ub", [(-2, 2), (0, 5), (-1, 1)])
+def test_interval_join_matrix(mode, lb, ub):
+    lt = pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, lv=str), L_TIMES
+    )
+    rt = pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, rv=str), R_TIMES
+    )
+    fn = {
+        "inner": pw.temporal.interval_join_inner,
+        "left": pw.temporal.interval_join_left,
+        "right": pw.temporal.interval_join_right,
+        "outer": pw.temporal.interval_join_outer,
+    }[mode]
+    j = fn(lt, rt, lt.t, rt.t, pw.temporal.interval(lb, ub)).select(
+        lv=pw.left.lv, rv=pw.right.rv
+    )
+    _ids, cols = pw.debug.table_to_dicts(j)
+    got = sorted(
+        ((cols["lv"][k], cols["rv"][k]) for k in cols["lv"]),
+        key=lambda p: (repr(p[0]), repr(p[1])),
+    )
+    assert got == _model_interval(mode, lb, ub), (mode, lb, ub)
+
+
+# ----------------------------------------------------------- asof joins
+
+
+def _model_asof(mode):
+    """For each left row: the LATEST right row with rt <= lt."""
+    out = []
+    rm = set()
+    for lt, lv in L_TIMES:
+        best = None
+        for ri, (rt, rv) in enumerate(R_TIMES):
+            if rt <= lt and (best is None or rt >= R_TIMES[best][0]):
+                best = ri
+        if best is not None:
+            out.append((lv, R_TIMES[best][1]))
+            rm.add(best)
+        elif mode in ("left", "outer"):
+            out.append((lv, None))
+    if mode in ("right", "outer"):
+        out += [(None, rv) for i, (_t, rv) in enumerate(R_TIMES) if i not in rm]
+    return sorted(out, key=lambda p: (repr(p[0]), repr(p[1])))
+
+
+@pytest.mark.parametrize("mode", ["left", "inner"])
+def test_asof_join_model(mode):
+    lt = pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, lv=str), L_TIMES
+    )
+    rt = pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, rv=str), R_TIMES
+    )
+    if mode == "left":
+        j = pw.temporal.asof_join_left(lt, rt, lt.t, rt.t)
+    else:
+        j = pw.temporal.asof_join(lt, rt, lt.t, rt.t, how="inner")
+    j = j.select(lv=pw.left.lv, rv=pw.right.rv)
+    _ids, cols = pw.debug.table_to_dicts(j)
+    got = sorted(
+        ((cols["lv"][k], cols["rv"][k]) for k in cols["lv"]),
+        key=lambda p: (repr(p[0]), repr(p[1])),
+    )
+    want = _model_asof(mode)
+    if mode == "inner":
+        want = [p for p in want if p[0] is not None and p[1] is not None]
+    assert got == want
+
+
+# --------------------------------------------------------- window joins
+
+
+@pytest.mark.parametrize("mode", ["inner", "left"])
+def test_window_join_tumbling_model(mode):
+    lt = pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, lv=str), L_TIMES
+    )
+    rt = pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, rv=str), R_TIMES
+    )
+    fn = (
+        pw.temporal.window_join_inner
+        if mode == "inner"
+        else pw.temporal.window_join_left
+    )
+    j = fn(lt, rt, lt.t, rt.t, pw.temporal.tumbling(duration=5)).select(
+        lv=pw.left.lv, rv=pw.right.rv
+    )
+    _ids, cols = pw.debug.table_to_dicts(j)
+    got = sorted(
+        ((cols["lv"][k], cols["rv"][k]) for k in cols["lv"]),
+        key=lambda p: (repr(p[0]), repr(p[1])),
+    )
+    out = []
+    lm = set()
+    for li, (ltv, lv) in enumerate(L_TIMES):
+        for rtv, rv in R_TIMES:
+            if ltv // 5 == rtv // 5:
+                out.append((lv, rv))
+                lm.add(li)
+    if mode == "left":
+        out += [(lv, None) for i, (_t, lv) in enumerate(L_TIMES) if i not in lm]
+    assert got == sorted(out, key=lambda p: (repr(p[0]), repr(p[1])))
+
+
+# --------------------------------------------- plane equivalence (windows)
+
+
+_WPLANE_SCRIPT = """
+import sys
+sys.path.insert(0, {repo!r})
+import pathway_tpu as pw
+
+t = pw.debug.table_from_rows(
+    pw.schema_from_types(t=int, v=int),
+    [((i * 7) % 500, i % 13) for i in range(2000)])
+win = pw.temporal.windowby(
+    t, t.t, window=pw.temporal.{winexpr},
+    behavior={behavior},
+)
+res = win.reduce(
+    start=pw.this._pw_window_start, n=pw.reducers.count(),
+    sv=pw.reducers.sum(pw.this.v))
+_ids, cols = pw.debug.table_to_dicts(res)
+print("RESULT", sorted(
+    (cols["start"][k], cols["n"][k], cols["sv"][k]) for k in cols["n"]))
+"""
+
+
+@pytest.mark.parametrize(
+    "winexpr,behavior",
+    [
+        ("tumbling(duration=50)", "None"),
+        ("tumbling(duration=50)", "pw.temporal.exactly_once_behavior()"),
+        ("sliding(hop=25, duration=75)", "None"),
+    ],
+    ids=["tumbling", "tumbling-eo", "sliding"],
+)
+def test_window_plane_equivalence(winexpr, behavior):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = _WPLANE_SCRIPT.format(
+        repo=repo, winexpr=winexpr, behavior=behavior
+    )
+
+    def run(native: bool) -> str:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PATHWAY_TPU_NATIVE"] = "1" if native else "0"
+        r = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, env=env, timeout=240,
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("RESULT"):
+                return line
+        raise AssertionError(f"no RESULT: {r.stdout[-300:]} {r.stderr[-1200:]}")
+
+    assert run(True) == run(False)

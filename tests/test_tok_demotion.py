@@ -1,0 +1,383 @@
+"""Token-tail demotion edge cases: mid-stream demotion, snapshot in
+token mode, restore + continue, and interrupted-vs-uninterrupted output
+equality — the round-4 VERDICT's tier-2 ask (mid-stream demote +
+snapshot + restore on the native leg).
+
+Engine-level: nodes are driven directly (InputNode -> node -> Capture)
+so waves, snapshots, and demotion points are exact. Plans are minimal
+stand-ins with the lowering contract (needed_cols + eval_map)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import core as eng
+from pathway_tpu.engine.core import (
+    BufferNode,
+    CaptureNode,
+    DeduplicateNode,
+    ForgetNode,
+    FreezeNode,
+    Graph,
+    InputNode,
+)
+from pathway_tpu.engine.native import dataplane as dp
+from pathway_tpu.internals.keys import key_for_values
+from pathway_tpu.internals.parse_graph import G
+
+pytestmark = pytest.mark.skipif(not dp.available(), reason="no native toolchain")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+class _ColPlan:
+    """Minimal numpy plan: emit column `col` (int) verbatim — the same
+    (needed_cols, eval_map) contract lowering-compiled plans satisfy."""
+
+    def __init__(self, col: int):
+        self.needed_cols = {col}
+        self.col = col
+
+    def eval_map(self, decoded, n):
+        vi, _vf, tg = decoded[self.col]
+        vtag = np.where(tg == 0, np.uint8(0), np.uint8(255))
+        return vi.astype(np.int64), vi.astype(np.float64), vtag
+
+
+def _thr_cur_fns(col_thr: int, col_cur: int):
+    return (
+        lambda key, row: row[col_thr],
+        lambda key, row: row[col_cur],
+    )
+
+
+def _stream(node_factory, waves, snapshot_after=None, restore_into=None):
+    """Drive `waves` through a node; optionally snapshot after wave i and
+    (if restore_into) continue the REMAINING waves in a fresh graph
+    restored from the snapshot. Returns the concatenated capture stream
+    as (key_value, row, diff) tuples in emission order."""
+    g = Graph()
+    inp = InputNode(g)
+    node = node_factory(g, inp)
+    cap = CaptureNode(g, node)
+    out: list = []
+
+    def drain():
+        for t, key, row, diff in cap.stream:
+            out.append((key.value, row, diff))
+        cap.stream.clear()
+
+    time = 0
+    for i, wave in enumerate(waves):
+        inp.push(list(wave))
+        g.step(time)
+        drain()
+        time += 1
+        if snapshot_after is not None and i == snapshot_after:
+            st = node.persist_state()
+            g2 = Graph()
+            inp2 = InputNode(g2)
+            node2 = restore_into(g2, inp2)
+            node2.restore_state(st)
+            cap2 = CaptureNode(g2, node2)
+            for wave2 in waves[i + 1:]:
+                inp2.push(list(wave2))
+                g2.step(time)
+                for t, key, row, diff in cap2.stream:
+                    out.append((key.value, row, diff))
+                cap2.stream.clear()
+                time += 1
+            return out
+    return out
+
+
+def _k(i):
+    return key_for_values(i)
+
+
+# ------------------------------------------------------------- BufferNode
+
+
+def _buffer_factory(tok: bool):
+    def make(g, inp):
+        thr_fn, cur_fn = _thr_cur_fns(0, 1)
+        plans = (_ColPlan(0), _ColPlan(1)) if tok else None
+        node = BufferNode(g, inp, thr_fn, cur_fn, native_plans=plans)
+        if tok:
+            assert node._tok, "expected token mode"
+        return node
+
+    return make
+
+
+BUFFER_WAVES = [
+    # (release_threshold, current_time) rows
+    [(_k(1), (5, 1), 1), (_k(2), (9, 2), 1)],
+    [(_k(3), (4, 6), 1)],  # watermark 6: releases thr 4 and 5
+    [(_k(4), (20, 12), 1)],  # watermark 12: releases thr 9
+]
+
+
+def test_buffer_token_equals_object_stream():
+    got_tok = _stream(_buffer_factory(True), BUFFER_WAVES)
+    got_obj = _stream(_buffer_factory(False), BUFFER_WAVES)
+    assert sorted(got_tok) == sorted(got_obj)
+    released = {kv for kv, _r, d in got_tok if d > 0}
+    assert released == {_k(1).value, _k(2).value, _k(3).value}
+
+
+def test_buffer_snapshot_restore_mid_stream():
+    uninterrupted = _stream(_buffer_factory(True), BUFFER_WAVES)
+    resumed = _stream(
+        _buffer_factory(True), BUFFER_WAVES,
+        snapshot_after=0, restore_into=_buffer_factory(True),
+    )
+    assert sorted(resumed) == sorted(uninterrupted)
+
+
+def test_buffer_snapshot_token_restores_into_object_node():
+    """A snapshot taken in token mode restores into an OBJECT-mode node
+    (plane-neutral snapshot contract)."""
+    uninterrupted = _stream(_buffer_factory(False), BUFFER_WAVES)
+    resumed = _stream(
+        _buffer_factory(True), BUFFER_WAVES,
+        snapshot_after=0, restore_into=_buffer_factory(False),
+    )
+    assert sorted(resumed) == sorted(uninterrupted)
+
+
+def test_buffer_mid_stream_demotion_keeps_pending():
+    """A wave carrying a plane-unrepresentable row (tuple cell) demotes
+    the node; pending state carries over and later watermarks still
+    release it."""
+    waves = [
+        [(_k(1), (5, 1), 1)],  # pending (thr 5 > watermark 1)
+        [(_k(2), ((1, 2), 3), 1)],  # tuple threshold: demote
+        [(_k(3), (2, 9), 1)],  # watermark 9 releases key 1
+    ]
+
+    def make(g, inp):
+        thr_fn = lambda key, row: (
+            row[0] if not isinstance(row[0], tuple) else 10**9
+        )
+        cur_fn = lambda key, row: row[1]
+        return BufferNode(
+            g, inp, thr_fn, cur_fn,
+            native_plans=(_ColPlan(0), _ColPlan(1)),
+        )
+
+    g = Graph()
+    inp = InputNode(g)
+    node = make(g, inp)
+    cap = CaptureNode(g, node)
+    assert node._tok
+    inp.push(waves[0])
+    g.step(0)
+    assert node._tok  # still token-resident
+    inp.push(waves[1])
+    g.step(1)
+    assert not node._tok  # demoted by the tuple row
+    inp.push(waves[2])
+    g.step(2)
+    released = {key.value for _t, key, _row, d in cap.stream if d > 0}
+    assert _k(1).value in released  # pre-demotion pending row released
+    assert _k(3).value in released
+
+
+# ------------------------------------------------------------- ForgetNode
+
+
+def _forget_factory(tok: bool):
+    def make(g, inp):
+        thr_fn, cur_fn = _thr_cur_fns(0, 1)
+        plans = (_ColPlan(0), _ColPlan(1)) if tok else None
+        node = ForgetNode(g, inp, thr_fn, cur_fn, native_plans=plans)
+        if tok:
+            assert node._tok
+        return node
+
+    return make
+
+
+FORGET_WAVES = [
+    [(_k(1), (5, 1), 1), (_k(2), (9, 2), 1)],
+    [(_k(3), (15, 7), 1)],  # watermark 7: key 1 (thr 5) expires
+    [(_k(4), (30, 20), 1)],  # watermark 20: keys 2, 3 expire
+]
+
+
+def test_forget_token_equals_object_stream():
+    got_tok = _stream(_forget_factory(True), FORGET_WAVES)
+    got_obj = _stream(_forget_factory(False), FORGET_WAVES)
+    assert sorted(got_tok) == sorted(got_obj)
+    # every key except the last was retracted by the advancing watermark
+    retracted = {kv for kv, _r, d in got_tok if d < 0}
+    assert retracted == {_k(1).value, _k(2).value, _k(3).value}
+
+
+def test_forget_snapshot_restore_mid_stream():
+    uninterrupted = _stream(_forget_factory(True), FORGET_WAVES)
+    resumed = _stream(
+        _forget_factory(True), FORGET_WAVES,
+        snapshot_after=0, restore_into=_forget_factory(True),
+    )
+    assert sorted(resumed) == sorted(uninterrupted)
+
+
+def test_forget_snapshot_crosses_planes_both_ways():
+    want = sorted(_stream(_forget_factory(False), FORGET_WAVES))
+    tok_to_obj = _stream(
+        _forget_factory(True), FORGET_WAVES,
+        snapshot_after=1, restore_into=_forget_factory(False),
+    )
+    obj_to_tok = _stream(
+        _forget_factory(False), FORGET_WAVES,
+        snapshot_after=1, restore_into=_forget_factory(True),
+    )
+    assert sorted(tok_to_obj) == want
+    assert sorted(obj_to_tok) == want
+
+
+def test_forget_late_row_drop_is_plane_equal():
+    waves = [
+        [(_k(1), (20, 10), 1)],  # watermark 10
+        [(_k(2), (5, 11), 1)],  # thr 5 <= 10: late insert, dropped
+    ]
+    got_tok = _stream(_forget_factory(True), waves)
+    got_obj = _stream(_forget_factory(False), waves)
+    assert sorted(got_tok) == sorted(got_obj)
+    assert all(kv != _k(2).value for kv, _r, _d in got_tok)
+
+
+# ------------------------------------------------------------- FreezeNode
+
+
+def _freeze_factory(tok: bool):
+    def make(g, inp):
+        thr_fn, cur_fn = _thr_cur_fns(0, 1)
+        plans = (_ColPlan(0), _ColPlan(1)) if tok else None
+        node = FreezeNode(g, inp, thr_fn, cur_fn, native_plans=plans)
+        if tok:
+            assert node._tok
+        return node
+
+    return make
+
+
+FREEZE_WAVES = [
+    [(_k(1), (5, 4), 1)],  # clock 4
+    [(_k(2), (3, 6), 1)],  # thr 3 <= 4: frozen region, dropped
+    [(_k(3), (9, 8), 1)],  # thr 9 > 6: accepted
+]
+
+
+def test_freeze_token_equals_object_stream():
+    got_tok = _stream(_freeze_factory(True), FREEZE_WAVES)
+    got_obj = _stream(_freeze_factory(False), FREEZE_WAVES)
+    assert sorted(got_tok) == sorted(got_obj)
+    passed = {kv for kv, _r, d in got_tok if d > 0}
+    assert passed == {_k(1).value, _k(3).value}
+
+
+# -------------------------------------------------------- DeduplicateNode
+
+
+def _dedup_factory(tok: bool, acceptor="max"):
+    acc = None if acceptor is None else (lambda new, old: new > old)
+
+    def make(g, inp):
+        cfg = (
+            {"inst_cols": [0], "value_col": 1, "value_kind": "num"}
+            if tok
+            else None
+        )
+        node = DeduplicateNode(
+            g, inp,
+            instance_fn=lambda key, row: row[0],
+            value_fn=lambda key, row: row[1],
+            acceptor=acc,
+            native_cfg=cfg,
+        )
+        if tok:
+            assert node._tok
+        return node
+
+    return make
+
+
+DEDUP_WAVES = [
+    [(_k(1), (1, 10), 1), (_k(2), (1, 7), 1), (_k(3), (2, 5), 1)],
+    [(_k(4), (1, 12), 1), (_k(5), (2, 1), 1)],
+    [(_k(6), (1, 11), 1)],
+]
+
+
+@pytest.mark.parametrize("acceptor", ["max", None], ids=["custom", "latest"])
+def test_dedup_token_equals_object_stream(acceptor):
+    got_tok = _stream(_dedup_factory(True, acceptor), DEDUP_WAVES)
+    got_obj = _stream(_dedup_factory(False, acceptor), DEDUP_WAVES)
+
+    def net(stream):
+        state: dict = {}
+        for kv, row, d in stream:
+            state[row] = state.get(row, 0) + d
+        return {r for r, c in state.items() if c > 0}
+
+    assert net(got_tok) == net(got_obj)
+    if acceptor == "max":
+        assert net(got_tok) == {(1, 12), (2, 5)}
+    else:
+        assert net(got_tok) == {(1, 11), (2, 1)}
+
+
+@pytest.mark.parametrize("acceptor", ["max", None], ids=["custom", "latest"])
+def test_dedup_snapshot_restore_mid_stream(acceptor):
+    uninterrupted = _stream(_dedup_factory(True, acceptor), DEDUP_WAVES)
+
+    def net(stream):
+        state: dict = {}
+        for kv, row, d in stream:
+            state[row] = state.get(row, 0) + d
+        return {r for r, c in state.items() if c > 0}
+
+    resumed = _stream(
+        _dedup_factory(True, acceptor), DEDUP_WAVES,
+        snapshot_after=0, restore_into=_dedup_factory(True, acceptor),
+    )
+    assert net(resumed) == net(uninterrupted)
+
+
+def test_dedup_mid_stream_demotion_on_bad_value():
+    """A wave whose value column is plane-unrepresentable (None) demotes;
+    accepted state carries over and later waves keep exact semantics."""
+    waves = [
+        [(_k(1), (1, 10), 1)],
+        [(_k(2), (1, None), 1)],  # None value: demote mid-stream
+        [(_k(3), (1, 12), 1), (_k(4), (1, 3), 1)],
+    ]
+    g = Graph()
+    inp = InputNode(g)
+    node = _dedup_factory(True, "max")(g, inp)
+    cap = CaptureNode(g, node)
+    inp.push(waves[0])
+    g.step(0)
+    assert node._tok
+    inp.push(waves[1])
+    g.step(1)
+    assert not node._tok
+    inp.push(waves[2])
+    g.step(2)
+    state: dict = {}
+    for _t, _key, row, d in cap.stream:
+        state[row] = state.get(row, 0) + d
+    live = {r for r, c in state.items() if c > 0}
+    # max chain: 10 -> (None rejected by > comparison error -> logged)
+    # -> 12 wins; 3 rejected
+    assert live == {(1, 12)}

@@ -1,0 +1,202 @@
+"""UDF system matrix: executors (sync/async/fully_async), caching,
+retry strategies, propagate_none, determinism over update streams
+(reference tier-2: tests/test_udfs.py)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import udfs
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _vals(table, col):
+    _ids, cols = pw.debug.table_to_dicts(table)
+    return sorted(cols[col].values())
+
+
+def test_udf_decorator_sync():
+    calls = []
+
+    @pw.udf
+    def double(x: int) -> int:
+        calls.append(x)
+        return 2 * x
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=int), [(1,), (2,), (3,)]
+    )
+    res = t.select(y=double(t.x))
+    assert _vals(res, "y") == [2, 4, 6]
+    assert sorted(calls) == [1, 2, 3]
+
+
+def test_udf_async_coroutine():
+    @pw.udf
+    async def slow_double(x: int) -> int:
+        await asyncio.sleep(0.001)
+        return 2 * x
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=int), [(5,), (6,)]
+    )
+    res = t.select(y=slow_double(t.x))
+    assert _vals(res, "y") == [10, 12]
+
+
+def test_udf_async_capacity_limits_concurrency():
+    peak = [0]
+    live = [0]
+
+    @pw.udf(executor=udfs.async_executor(capacity=2))
+    async def probe(x: int) -> int:
+        live[0] += 1
+        peak[0] = max(peak[0], live[0])
+        await asyncio.sleep(0.005)
+        live[0] -= 1
+        return x
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=int), [(i,) for i in range(8)]
+    )
+    res = t.select(y=probe(t.x))
+    assert _vals(res, "y") == list(range(8))
+    assert peak[0] <= 2, f"capacity=2 exceeded: {peak[0]} concurrent"
+
+
+def test_udf_retry_strategy_eventually_succeeds():
+    attempts = {}
+
+    @pw.udf(
+        executor=udfs.async_executor(
+            retry_strategy=udfs.FixedDelayRetryStrategy(
+                max_retries=5, delay_ms=1
+            )
+        )
+    )
+    async def flaky(x: int) -> int:
+        attempts[x] = attempts.get(x, 0) + 1
+        if attempts[x] < 3:
+            raise RuntimeError("transient")
+        return x * 10
+
+    t = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(1,), (2,)])
+    res = t.select(y=flaky(t.x))
+    assert _vals(res, "y") == [10, 20]
+    assert attempts == {1: 3, 2: 3}
+
+
+def test_udf_in_memory_cache_dedups_calls():
+    calls = []
+
+    @pw.udf(cache_strategy=udfs.InMemoryCache())
+    def expensive(x: int) -> int:
+        calls.append(x)
+        return x + 100
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=int), [(7,), (7,), (7,), (8,)]
+    )
+    res = t.select(y=expensive(t.x))
+    assert _vals(res, "y") == [107, 107, 107, 108]
+    assert sorted(calls) == [7, 8]  # one call per distinct argument
+
+
+def test_udf_disk_cache_survives_sessions(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_PERSISTENT_STORAGE", str(tmp_path))
+    calls = []
+
+    def build():
+        @pw.udf(cache_strategy=udfs.DiskCache(name="expcache"))
+        def expensive(x: int) -> int:
+            calls.append(x)
+            return x * 3
+
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(x=int), [(4,), (5,)]
+        )
+        return t.select(y=expensive(t.x))
+
+    assert _vals(build(), "y") == [12, 15]
+    n_first = len(calls)
+    G.clear()
+    assert _vals(build(), "y") == [12, 15]
+    assert len(calls) == n_first, "disk cache must serve the second session"
+
+
+def test_udf_propagate_none_skips_call():
+    calls = []
+
+    @pw.udf(propagate_none=True)
+    def fn(x) -> int:
+        calls.append(x)
+        return x + 1
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=object), [(1,), (None,), (3,)]
+    )
+    res = t.select(y=fn(t.x))
+    _ids, cols = pw.debug.table_to_dicts(res)
+    assert sorted(cols["y"].values(), key=repr) == sorted(
+        [2, 4, None], key=repr
+    )
+    assert None not in calls
+
+
+def test_udf_error_poisons_cell_only():
+    @pw.udf
+    def maybe_fail(x: int) -> int:
+        if x == 2:
+            raise ValueError("boom")
+        return x
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=int), [(1,), (2,), (3,)]
+    )
+    res = t.select(y=pw.fill_error(maybe_fail(t.x), -1))
+    # the failing row poisons ONLY its own cell; the rest compute
+    assert _vals(res, "y") == [-1, 1, 3]
+
+
+def test_fully_async_udf_returns_future_column():
+    @pw.udf(executor=udfs.fully_async_executor())
+    async def slow(x: int) -> int:
+        await asyncio.sleep(0.002)
+        return x * 2
+
+    t = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(1,), (3,)])
+    res = t.select(y=slow(t.x))
+    res2 = res.await_futures()
+    assert _vals(res2, "y") == [2, 6]
+
+
+def test_udf_on_update_stream_recomputes_only_new_rows():
+    calls = []
+
+    @pw.udf(deterministic=True)
+    def tracked(x: int) -> int:
+        calls.append(x)
+        return x
+
+    t = pw.debug.table_from_markdown(
+        """
+        x | __time__ | __diff__
+        1 | 2        | 1
+        2 | 4        | 1
+        1 | 6        | -1
+        """,
+        id_from=["x"],
+    )
+    res = t.select(y=tracked(t.x))
+    assert _vals(res, "y") == [2]
+    assert calls.count(2) == 1
