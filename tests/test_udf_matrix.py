@@ -113,7 +113,10 @@ def test_udf_in_memory_cache_dedups_calls():
 
 
 def test_udf_disk_cache_survives_sessions(tmp_path, monkeypatch):
+    # get_config() may be a cached singleton from before the env patch,
+    # whose fallback is cwd/.pathway-cache — chdir keeps it in tmp
     monkeypatch.setenv("PATHWAY_PERSISTENT_STORAGE", str(tmp_path))
+    monkeypatch.chdir(tmp_path)
     calls = []
 
     def build():
