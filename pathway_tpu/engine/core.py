@@ -1122,64 +1122,58 @@ class _Key128Set:
     snapshot). Replaces per-row Python-int sets on hot paths
     (BufferNode.released holds every row ever released).
 
-    Layout: one sorted-unique base array + small pending chunks; pending
-    folds into the base (sort + unique) only when it outgrows half the
-    base, so total maintenance is O(n log n) amortized and memory stays
-    bounded by the DISTINCT key count — matching the set it replaces."""
+    Layout: LSM-style sorted-unique chunks merged binary-counter
+    fashion — each add sorts only its own wave, every key is copied
+    O(log n) times total, chunk count stays O(log n), memory is bounded
+    by the DISTINCT key count, and membership binary-searches each chunk
+    for the (few) candidates instead of ever streaming the history."""
 
-    __slots__ = ("_base", "_pending", "_pending_n")
+    __slots__ = ("_chunks",)
 
     def __init__(self):
-        self._base: np.ndarray | None = None  # sorted unique void16
-        self._pending: list[np.ndarray] = []
-        self._pending_n = 0
+        self._chunks: list[np.ndarray] = []  # sorted-unique void16, sizes ↓
 
     def add_arrays(self, lo: np.ndarray, hi: np.ndarray) -> None:
-        if len(lo):
-            self._pending.append(_void16(lo, hi))
-            self._pending_n += len(lo)
-            base_n = 0 if self._base is None else len(self._base)
-            if self._pending_n * 2 > base_n:
-                self._compact()
-
-    def _compact(self) -> None:
-        parts = self._pending if self._base is None else [self._base, *self._pending]
-        self._base = np.unique(np.concatenate(parts))
-        self._pending = []
-        self._pending_n = 0
+        if not len(lo):
+            return
+        self._chunks.append(np.unique(_void16(lo, hi)))
+        # binary-counter merge: amortized O(n log n) total maintenance
+        while (
+            len(self._chunks) > 1
+            and len(self._chunks[-1]) >= len(self._chunks[-2])
+        ):
+            b = self._chunks.pop()
+            a = self._chunks.pop()
+            self._chunks.append(np.unique(np.concatenate([a, b])))
 
     def add_kvs(self, kvs) -> None:
         if kvs:
             self.add_arrays(*_kv_cols(list(kvs)))
 
     def contains(self, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
-        """Vectorized membership mask for (lo, hi) columns. Pending
-        chunks are probed directly (they are small by the add_arrays
-        threshold) — no per-call re-sort of the whole history."""
+        """Vectorized membership mask for (lo, hi) columns."""
         cand = _void16(lo, hi)
-        if self._base is not None and len(self._base):
-            pos = np.searchsorted(self._base, cand)
-            pos[pos == len(self._base)] = 0
-            mask = self._base[pos] == cand
-        else:
-            mask = np.zeros(len(lo), bool)
-        for c in self._pending:
-            mask |= np.isin(cand, c)
+        mask = np.zeros(len(cand), bool)
+        for chunk in self._chunks:
+            pos = np.searchsorted(chunk, cand)
+            pos[pos == len(chunk)] = 0
+            mask |= chunk[pos] == cand
         return mask
 
     def to_kv_set(self) -> set[int]:
-        if self._pending:
-            self._compact()
         out: set[int] = set()
-        if self._base is not None and len(self._base):
-            pairs = self._base.view(np.uint64).reshape(-1, 2)
+        for chunk in self._chunks:
+            pairs = chunk.view(np.uint64).reshape(-1, 2)
             out.update(_kvs_of(pairs[:, 0], pairs[:, 1]))
         return out
 
     def __len__(self) -> int:
-        if self._pending:
-            self._compact()
-        return 0 if self._base is None else len(self._base)
+        # distinct count: chunks may share keys until their merge
+        if not self._chunks:
+            return 0
+        if len(self._chunks) == 1:
+            return len(self._chunks[0])
+        return len(np.unique(np.concatenate(self._chunks)))
 
 
 _F53 = 1 << 53  # largest contiguous exact-int range of float64
@@ -1402,10 +1396,17 @@ class _TokTailNode(Node):
         self.emit(time, nb)
 
     def _emit_tok_arrays(
-        self, time: int, lo, hi, tok, diff, consolidate_out: bool = False
+        self,
+        time: int,
+        lo, hi, tok, diff,
+        consolidate_out: bool = False,
+        distinct: bool = False,
     ) -> None:
         """Array twin of _emit_tok: emit (lo, hi, tok, diff) columns as one
-        NativeBatch without materializing Python kv ints."""
+        NativeBatch without materializing Python kv ints. `distinct=True`
+        asserts the rows are an all-+1 pairwise-distinct insert (e.g. a
+        subset of a distinct ingest wave): output consolidation — and
+        even the O(n) distinct re-check — is skipped."""
         if len(lo) == 0:
             return
         nb = self._dp.NativeBatch(
@@ -1414,8 +1415,9 @@ class _TokTailNode(Node):
             np.ascontiguousarray(hi, np.uint64),
             np.ascontiguousarray(tok, np.uint64),
             np.ascontiguousarray(diff, np.int64),
+            distinct_hint=distinct,
         )
-        if consolidate_out:
+        if consolidate_out and not distinct and not nb.is_distinct_insert():
             nb = nb.consolidate()
             if not len(nb):
                 return
@@ -3706,10 +3708,16 @@ class _TimeColNode(_TokTailNode):
 
     def _tok_wave(self, time: int):
         """Drain + decode one wave: ((lo, hi, tok, diff) columns, thr[],
-        cur[] numeric arrays) — or None after demotion (object path
-        re-drains; nothing consumed)."""
+        cur[] numeric arrays, distinct flag) — or None after demotion
+        (object path re-drains; nothing consumed). `distinct` means the
+        wave is provably an all-+1 pairwise-distinct insert (every
+        segment carried the ingest distinct hint): any row SUBSET emitted
+        from it needs no output consolidation."""
         raw = self.take_segments()
         w = _wave_arrays(self._tab, *raw)
+        distinct = not raw[1] and all(
+            getattr(b, "distinct_hint", False) for b in raw[0]
+        )
         thr = cur = None
         if w is not None and len(w[0]):
             decoded = decode_cols_dict(self._dp, self._tab, w[2], self._needed_cols)
@@ -3722,7 +3730,7 @@ class _TimeColNode(_TokTailNode):
             return None
         if thr is None:
             thr = cur = _EMPTY_I64
-        return w, thr, cur
+        return w, thr, cur, distinct
 
     def _demote(self) -> None:
         if not self._tok:
@@ -3818,7 +3826,7 @@ class BufferNode(_TimeColNode):
         res = self._tok_wave(time)
         if res is None:
             return False
-        (lo, hi, tok, diff), thr, cur = res
+        (lo, hi, tok, diff), thr, cur, distinct = res
         n = len(lo)
         if not n:
             return True
@@ -3902,12 +3910,23 @@ class BufferNode(_TimeColNode):
         if rel_idx.size:
             rlo, rhi = lo[rel_idx], hi[rel_idx]
             self.released.add_arrays(rlo, rhi)
-            # a pending key released by this wave leaves the buffer
-            # (delete ops; O(released) appends, no pending scan)
-            pending.apply(
-                rlo, rhi, tok[rel_idx], thr[rel_idx],
-                np.zeros(len(rel_idx), bool),
-            )
+            # a pending key released by this wave leaves the buffer —
+            # probe the (small) pending key set with searchsorted and
+            # append delete ops only for actual hits, instead of flooding
+            # the pending store with one delete sentinel per released row
+            g = pending.items_arrays()
+            if g is not None:
+                ps = np.sort(_void16(g[0], g[1]))
+                relv = _void16(rlo, rhi)
+                pos = np.searchsorted(ps, relv)
+                pos[pos == len(ps)] = 0
+                hitm = ps[pos] == relv
+                if hitm.any():
+                    idx2 = rel_idx[hitm]
+                    pending.apply(
+                        lo[idx2], hi[idx2], tok[idx2], thr[idx2],
+                        np.zeros(len(idx2), bool),
+                    )
         parts_lo = [lo[rel_idx]]
         parts_hi = [hi[rel_idx]]
         parts_tok = [tok[rel_idx]]
@@ -3917,16 +3936,19 @@ class BufferNode(_TimeColNode):
             parts_hi.append(hi[member_idx])
             parts_tok.append(tok[member_idx])
             parts_diff.append(diff[member_idx])
+        pure_subset = distinct  # rel/member rows ⊆ one distinct wave
         if now is not None:
             # release pending rows whose threshold has passed
             plo, phi, ptok, pdiff = pending.expire(now)
             if len(plo):
+                pure_subset = False  # held rows join from earlier waves
                 self.released.add_arrays(plo, phi)
                 parts_lo.append(plo)
                 parts_hi.append(phi)
                 parts_tok.append(ptok)
                 parts_diff.append(pdiff)
         if extras:
+            pure_subset = False
             self.released.add_kvs([kv for kv, _t, _d in extras])
             elo, ehi = _kv_cols([kv for kv, _t, _d in extras])
             parts_lo.append(elo)
@@ -3944,6 +3966,7 @@ class BufferNode(_TimeColNode):
             np.concatenate(parts_tok),
             np.concatenate(parts_diff),
             consolidate_out=True,
+            distinct=pure_subset,
         )
         return True
 
@@ -4082,7 +4105,7 @@ class ForgetNode(_TimeColNode):
         res = self._tok_wave(time)
         if res is None:
             return False
-        (lo, hi, tok, diff), thr, cur = res
+        (lo, hi, tok, diff), thr, cur, distinct = res
         n = len(lo)
         if not n:
             return True
@@ -4113,16 +4136,21 @@ class ForgetNode(_TimeColNode):
                 diff, thr = diff[keep], thr[keep]
         live.apply(lo, hi, tok, thr, diff > 0)  # upserts + deletes, row order
         self.now = now
+        pure_subset = distinct
         if now is not None:
             elo, ehi, etok, _ed = live.expire(now)
             if len(elo):
+                pure_subset = False  # expiry retractions join the wave
                 lo = np.concatenate([lo, elo])
                 hi = np.concatenate([hi, ehi])
                 tok = np.concatenate([tok, etok])
                 diff = np.concatenate(
                     [diff, np.full(len(elo), -1, np.int64)]
                 )
-        self._emit_tok_arrays(time, lo, hi, tok, diff, consolidate_out=True)
+        self._emit_tok_arrays(
+            time, lo, hi, tok, diff, consolidate_out=True,
+            distinct=pure_subset,
+        )
         return True
 
     def finish_time(self, time: int) -> None:
@@ -4191,7 +4219,7 @@ class FreezeNode(_TimeColNode):
         res = self._tok_wave(time)
         if res is None:
             return False
-        (lo, hi, tok, diff), thr, cur = res
+        (lo, hi, tok, diff), thr, cur, distinct = res
         if not len(lo):
             return True
         now0 = self.now
@@ -4210,7 +4238,9 @@ class FreezeNode(_TimeColNode):
             if now is None or cmax > now:
                 now = cmax
         self.now = now
-        self._emit_tok_arrays(time, lo, hi, tok, diff, consolidate_out=True)
+        self._emit_tok_arrays(
+            time, lo, hi, tok, diff, consolidate_out=True, distinct=distinct
+        )
         return True
 
     def finish_time(self, time: int) -> None:

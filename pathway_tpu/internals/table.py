@@ -685,6 +685,35 @@ class Table:
         spec = OpSpec("with_universe_of", [self, other])
         return Table(spec, self._schema, other._universe)
 
+    def _gradual_broadcast(
+        self,
+        threshold_table: "Table",
+        lower: Any,
+        value: Any,
+        upper: Any,
+    ) -> "Table":
+        """Broadcast `value` from the (small) threshold table onto every
+        row of this table as column `apx_value`, with hysteresis: the
+        broadcast re-emits only when the new value leaves the
+        [lower, upper] band of the currently-held one (reference:
+        table.py _gradual_broadcast over operators/gradual_broadcast.rs —
+        the louvain total-weight plumbing). Returns this table's columns
+        plus `apx_value`."""
+        spec = OpSpec(
+            "gradual_broadcast",
+            [self, threshold_table],
+            lower=wrap_arg(lower),
+            value=wrap_arg(value),
+            upper=wrap_arg(upper),
+        )
+        columns = {
+            "apx_value": sch.ColumnSchema(name="apx_value", dtype=dt.FLOAT)
+        }
+        bc = Table(
+            spec, sch.schema_from_columns(columns), self._universe
+        )
+        return self + bc
+
     # ---------------------------------------------------------- reindexing
 
     def reindex(self, new_id: ColumnExpression) -> "Table":

@@ -21,5 +21,12 @@ def diff(
     kwargs = {}
     for v in values:
         name = v.name
-        kwargs["diff_" + name] = table[name] - prev_rows[name]
+        # the first row (no predecessor) gets None, not an arithmetic
+        # error — reference: stdlib/ordered/diff.py "the value of the
+        # first row is None"
+        kwargs["diff_" + name] = ex.IfElseExpression(
+            ex.IsNoneExpression(prev_rows[name]),
+            None,
+            table[name] - prev_rows[name],
+        )
     return table.select(*table, **kwargs)

@@ -178,29 +178,32 @@ _PLANE_SCRIPT = """
 import sys
 sys.path.insert(0, {repo!r})
 import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
 
-lt = pw.debug.table_from_rows(
-    pw.schema_from_types(k=int, lv=str),
-    [(i % 50, f"l{{i}}") for i in range(500)])
-rt = pw.debug.table_from_rows(
-    pw.schema_from_types(k=int, rv=str),
-    [(i % 70, f"r{{i}}") for i in range(350)])
-j = lt.join(rt, lt.k == rt.k, how={mode!r}).select(
-    lv=pw.left.lv, rv=pw.right.rv)
-agg = j.groupby(j.lv).reduce(j.lv, n=pw.reducers.count())
-_ids, cols = pw.debug.table_to_dicts(agg)
-print("RESULT", sorted((v, cols["n"][k]) for k, v in cols["lv"].items()))
+for mode in ["inner", "left"]:
+    G.clear()
+    lt = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, lv=str),
+        [(i % 50, f"l{{i}}") for i in range(500)])
+    rt = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, rv=str),
+        [(i % 70, f"r{{i}}") for i in range(350)])
+    j = lt.join(rt, lt.k == rt.k, how=mode).select(
+        lv=pw.left.lv, rv=pw.right.rv)
+    agg = j.groupby(j.lv).reduce(j.lv, n=pw.reducers.count())
+    _ids, cols = pw.debug.table_to_dicts(agg)
+    print("RESULT", mode,
+          sorted((v, cols["n"][k]) for k, v in cols["lv"].items()))
 """
 
 
-@pytest.mark.parametrize("mode", ["inner", "left"])
-def test_join_plane_equivalence(mode):
+def test_join_plane_equivalence():
     """Native-plane joins (incl. projection pushdown) agree with the
-    object plane byte-for-byte at 500x350 rows."""
+    object plane at 500x350 rows — both modes in ONE subprocess per leg."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = _PLANE_SCRIPT.format(repo=repo, mode=mode)
+    script = _PLANE_SCRIPT.format(repo=repo)
 
-    def run(native: bool) -> str:
+    def run(native: bool) -> list[str]:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PATHWAY_TPU_NATIVE"] = "1" if native else "0"
@@ -208,10 +211,14 @@ def test_join_plane_equivalence(mode):
             [sys.executable, "-c", script],
             capture_output=True, text=True, env=env, timeout=240,
         )
-        for line in r.stdout.splitlines():
-            if line.startswith("RESULT"):
-                return line
-        raise AssertionError(f"no RESULT: {r.stdout[-300:]} {r.stderr[-1200:]}")
+        lines = [
+            ln for ln in r.stdout.splitlines() if ln.startswith("RESULT")
+        ]
+        if len(lines) != 2:
+            raise AssertionError(
+                f"expected 2 RESULT lines: {r.stdout[-400:]} {r.stderr[-1200:]}"
+            )
+        return lines
 
     assert run(True) == run(False)
 

@@ -1,0 +1,150 @@
+"""Schema & dtype matrix: declaration forms (class / from_types /
+from_dict / builder / from_pandas), optionality, PEP 604 unions, dtype
+propagation through expressions, runtime type errors as poison
+(reference tier-2: tests/test_schema.py + test_types.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_schema_class_and_from_types_agree():
+    class S(pw.Schema):
+        a: int
+        b: str
+        c: float | None
+
+    T = pw.schema_from_types(a=int, b=str, c=float | None)
+    assert list(S.column_names()) == list(T.column_names())
+    for n in S.column_names():
+        assert (
+            S.__columns__[n].dtype == T.__columns__[n].dtype
+        ), n
+
+
+def test_schema_from_dict_with_defaults():
+    S = pw.schema_from_dict({"x": int, "y": str})
+    assert list(S.column_names()) == ["x", "y"]
+    assert S.__columns__["x"].dtype == dt.INT
+
+
+def test_schema_builder_and_column_definition():
+    S = pw.schema_builder(
+        {
+            "k": pw.column_definition(dtype=str, primary_key=True),
+            "v": pw.column_definition(dtype=int),
+        }
+    )
+    assert list(S.column_names()) == ["k", "v"]
+    assert S.primary_key_columns() == ["k"]
+
+
+def test_schema_from_pandas():
+    import pandas as pd
+
+    df = pd.DataFrame({"a": [1, 2], "b": ["x", "y"], "c": [1.5, 2.5]})
+    S = pw.schema_from_pandas(df)
+    assert S.__columns__["a"].dtype == dt.INT
+    assert S.__columns__["b"].dtype == dt.STR
+    assert S.__columns__["c"].dtype == dt.FLOAT
+
+
+def test_pep604_and_typing_optional_equivalent():
+    A = pw.schema_from_types(v=int | None)
+    B = pw.schema_from_types(v=Optional[int])
+    assert A.__columns__["v"].dtype == B.__columns__["v"].dtype
+    assert isinstance(A.__columns__["v"].dtype, dt.Optional)
+
+
+def test_dtype_propagation_through_arithmetic():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(i=int, f=float), [(1, 2.5)]
+    )
+    res = t.select(
+        ii=t.i + t.i,  # int
+        if_=t.i + t.f,  # float (widening)
+        div=t.i / t.i,  # true division -> float
+        fdiv=t.i // t.i,  # floor division of ints -> int
+        cmp=t.i < t.f,  # bool
+    )
+    sch = res.schema
+    assert sch.__columns__["ii"].dtype == dt.INT
+    assert sch.__columns__["if_"].dtype == dt.FLOAT
+    assert sch.__columns__["div"].dtype == dt.FLOAT
+    assert sch.__columns__["fdiv"].dtype == dt.INT
+    assert sch.__columns__["cmp"].dtype == dt.BOOL
+
+
+def test_optional_coalesce_narrows():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int | None), [(1,), (None,)]
+    )
+    res = t.select(w=pw.coalesce(t.v, 0))
+    _ids, cols = pw.debug.table_to_dicts(res)
+    assert sorted(cols["w"].values()) == [0, 1]
+
+
+def test_update_types_widens_declared_schema():
+    t = pw.debug.table_from_rows(pw.schema_from_types(v=int), [(1,)])
+    res = t.update_types(v=int | None)
+    assert isinstance(res.schema.__columns__["v"].dtype, dt.Optional)
+
+
+def test_schema_with_id_from_primary_keys():
+    class S(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int
+
+    assert S.primary_key_columns() == ["k"]
+    rows = [("a", 1), ("b", 2)]
+    t = pw.debug.table_from_rows(S, rows)
+    ids1, _ = pw.debug.table_to_dicts(t)
+    G.clear()
+    # same primary keys -> same row ids across sessions (content keying)
+    t2 = pw.debug.table_from_rows(S, rows)
+    ids2, _ = pw.debug.table_to_dicts(t2)
+    assert set(ids1) == set(ids2)
+
+
+def test_runtime_type_mismatch_poisons_not_crashes():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(v=object), [("str",), (3,)]
+    )
+    res = t.select(out=pw.fill_error(t.v + 1, -1))
+    _ids, cols = pw.debug.table_to_dicts(res)
+    assert sorted(cols["out"].values()) == [-1, 4]
+
+
+def test_schema_repr_and_columns_introspection():
+    class S(pw.Schema):
+        a: int
+        b: str | None
+
+    cols = S.columns()
+    assert set(cols) == {"a", "b"}
+    assert "a" in repr(S) or "a" in str(S.typehints())
+
+
+def test_typehints_roundtrip():
+    class S(pw.Schema):
+        a: int
+        b: float | None
+        c: str
+
+    hints = S.typehints()
+    S2 = pw.schema_from_types(**hints)
+    for n in S.column_names():
+        assert S.__columns__[n].dtype == S2.__columns__[n].dtype

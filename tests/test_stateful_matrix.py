@@ -1,0 +1,178 @@
+"""Stateful-surface matrix: AsyncTransformer, deduplicate acceptors over
+streams, stateful reducers with retractions, gradual_broadcast, and
+interactive LiveTable basics (reference tier-2: test_async_transformer.py
++ test_stateful.py)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _dicts(table):
+    _ids, cols = pw.debug.table_to_dicts(table)
+    return cols
+
+
+# (AsyncTransformer end-to-end coverage incl. retries/failure split lives
+# in test_polish.py — it needs the streaming run loop, not static capture.)
+
+
+# ----------------------------------------------------------- interpolate
+
+
+def test_interpolate_single_gaps_linear():
+    """Alternating present/missing: each gap interpolates linearly
+    between its sort-order neighbors (the v0-documented contract)."""
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, v=float | None),
+        [(0, 10.0), (1, None), (2, 30.0), (3, None), (4, 50.0)],
+    )
+    res = pw.stdlib.statistical.interpolate(t, t.t, t.v)
+    cols = _dicts(res)
+    by_t = {}
+    for k in cols["v"]:
+        by_t[cols["t"][k]] = cols["v"][k]
+    assert by_t[0] == 10.0
+    assert by_t[1] == pytest.approx(20.0)
+    assert by_t[2] == 30.0
+    assert by_t[3] == pytest.approx(40.0)
+    assert by_t[4] == 50.0
+
+
+# --------------------------------------------------- deduplicate acceptors
+
+
+def test_deduplicate_acceptor_state_machine_stream():
+    """The canonical alerting pattern: accept a new value only when it
+    jumps by >= 2 from the held one (reference deduplicate docs)."""
+    t = pw.debug.table_from_markdown(
+        """
+        v  | __time__
+        1  | 2
+        2  | 4
+        4  | 6
+        5  | 8
+        10 | 10
+        """
+    )
+    res = t.deduplicate(
+        value=pw.this.v, acceptor=lambda new, old: new - old >= 2
+    )
+    cols = _dicts(res)
+    # chain: 1 -> (2 rejected) -> 4 -> (5 rejected) -> 10
+    assert list(cols["v"].values()) == [10]
+
+
+def test_deduplicate_instance_isolation_stream():
+    t = pw.debug.table_from_markdown(
+        """
+        g | v | __time__
+        a | 1 | 2
+        b | 9 | 2
+        a | 3 | 4
+        b | 2 | 4
+        """
+    )
+    res = t.deduplicate(
+        value=pw.this.v, instance=pw.this.g,
+        acceptor=lambda new, old: new > old,
+    )
+    cols = _dicts(res)
+    got = {cols["g"][k]: cols["v"][k] for k in cols["g"]}
+    assert got == {"a": 3, "b": 9}  # b's 2 rejected; a's 3 accepted
+
+
+# ------------------------------------------------------- gradual broadcast
+
+
+def test_gradual_broadcast_applies_hysteresis_band():
+    big = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(i,) for i in range(8)]
+    )
+    thresholds = pw.debug.table_from_rows(
+        pw.schema_from_types(lower=float, value=float, upper=float),
+        [(1.0, 2.0, 3.0)],
+    )
+    res = big._gradual_broadcast(
+        thresholds, thresholds.lower, thresholds.value, thresholds.upper
+    )
+    cols = _dicts(res)
+    # every big row carries the broadcast apx value within [lower, upper]
+    vals = set(cols["apx_value"].values())
+    assert len(vals) == 1
+    assert 1.0 <= next(iter(vals)) <= 3.0
+
+
+# ------------------------------------------------------ stateful reducers
+
+
+def test_stateful_reducer_sees_retraction_batches():
+    seen_batches = []
+
+    @pw.reducers.stateful_many
+    def collect(state, rows):
+        seen_batches.append([(tuple(r), c) for r, c in rows])
+        total = state if state is not None else 0
+        for row, cnt in rows:
+            total += row[0] * cnt
+        return total
+
+    t = pw.debug.table_from_markdown(
+        """
+        g | v | __time__ | __diff__
+        a | 5 | 2        | 1
+        a | 3 | 4        | 1
+        a | 5 | 6        | -1
+        """,
+        id_from=["v"],
+    )
+    res = t.groupby(t.g).reduce(g=t.g, s=collect(t.v))
+    cols = _dicts(res)
+    assert list(cols["s"].values()) == [3]
+    flat = [rc for b in seen_batches for rc in b]
+    assert ((5,), -1) in flat  # the retraction reached the reducer
+
+
+# ------------------------------------------------------------- interactive
+
+
+def test_compute_and_print_update_stream_shape(capsys):
+    t = pw.debug.table_from_markdown(
+        """
+        v | __time__ | __diff__
+        1 | 2        | 1
+        1 | 4        | -1
+        2 | 4        | 1
+        """,
+        id_from=["v"],
+    )
+    pw.debug.compute_and_print_update_stream(t, include_id=False)
+    out = capsys.readouterr().out
+    lines = [ln.split("|") for ln in out.strip().splitlines()[1:]]
+    stream = [(int(a), int(b), int(c)) for a, b, c in (map(str.strip, l) for l in lines)]
+    assert (1, 2, 1) in stream and (1, 4, -1) in stream and (2, 4, 1) in stream
+
+
+def test_table_to_pandas_types():
+    import pandas as pd
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(i=int, s=str, f=float),
+        [(1, "a", 0.5), (2, "b", 1.5)],
+    )
+    df = pw.debug.table_to_pandas(t)
+    assert isinstance(df, pd.DataFrame)
+    assert sorted(df["i"].tolist()) == [1, 2]
+    assert sorted(df["s"].tolist()) == ["a", "b"]

@@ -1,0 +1,213 @@
+"""Table-shaping matrix: select/filter/rename/without/cast/concat/
+flatten/sort/slices against Python models, static and update streams
+(reference tier-2: tests/test_common.py table-surface sections)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _dicts(table):
+    _ids, cols = pw.debug.table_to_dicts(table)
+    return cols
+
+
+ROWS = [("a", 1, 1.5), ("b", 2, -2.0), ("c", 3, 0.0), ("d", 4, 9.25)]
+
+
+def _t():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, i=int, f=float), ROWS
+    )
+
+
+def test_select_star_plus_computed():
+    t = _t()
+    res = t.select(*pw.this, double=t.i * 2)
+    cols = _dicts(res)
+    assert sorted(cols.keys()) == ["double", "f", "i", "k"]
+    assert sorted(cols["double"].values()) == [2, 4, 6, 8]
+
+
+def test_filter_keeps_matching_rows_and_keys():
+    t = _t()
+    res = t.filter((t.i % 2 == 0) & (t.f < 5.0))
+    cols = _dicts(res)
+    assert sorted(cols["k"].values()) == ["b"]
+
+
+def test_without_and_rename():
+    t = _t()
+    res = t.without("f").rename_columns(ident=pw.this.k)
+    cols = _dicts(res)
+    assert sorted(cols.keys()) == ["i", "ident"]
+    assert sorted(cols["ident"].values()) == ["a", "b", "c", "d"]
+
+
+def test_rename_by_dict_and_kwargs_agree():
+    t1 = _t().rename({"k": "kk"})
+    cols1 = _dicts(t1)
+    G.clear()
+    t2 = _t().rename_columns(kk=pw.this.k)
+    cols2 = _dicts(t2)
+    assert sorted(cols1["kk"].values()) == sorted(cols2["kk"].values())
+
+
+def test_cast_to_types_int_to_float():
+    t = _t()
+    res = t.cast_to_types(i=float)
+    cols = _dicts(res)
+    vals = sorted(cols["i"].values())
+    assert vals == [1.0, 2.0, 3.0, 4.0]
+    assert all(isinstance(v, float) for v in vals)
+
+
+def test_concat_disjoint_keys():
+    a = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(1,), (2,)]
+    ).with_id_from(pw.this.v)
+    b = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(3,), (4,)]
+    ).with_id_from(pw.this.v)
+    pw.universes.promise_are_pairwise_disjoint(a, b)
+    res = a.concat(b)
+    cols = _dicts(res)
+    assert sorted(cols["v"].values()) == [1, 2, 3, 4]
+
+
+def test_concat_reindex_allows_overlap():
+    a = pw.debug.table_from_rows(pw.schema_from_types(v=int), [(1,), (2,)])
+    b = pw.debug.table_from_rows(pw.schema_from_types(v=int), [(1,), (3,)])
+    res = a.concat_reindex(b)
+    cols = _dicts(res)
+    assert sorted(cols["v"].values()) == [1, 1, 2, 3]
+
+
+def test_flatten_with_origin_id():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str, tags=tuple),
+        [("x", ("p", "q")), ("y", ("r",))],
+    )
+    flat = t.flatten(t.tags, origin_id="src")
+    cols = _dicts(flat)
+    by_tag = {cols["tags"][k]: cols["src"][k] for k in cols["tags"]}
+    src_ids, src_cols = pw.debug.table_to_dicts(
+        pw.debug.table_from_rows(
+            pw.schema_from_types(name=str, tags=tuple),
+            [("x", ("p", "q")), ("y", ("r",))],
+        )
+    )
+    # p and q share x's origin id; r has y's
+    assert by_tag["p"] == by_tag["q"] != by_tag["r"]
+
+
+def test_sort_produces_prev_next_chain():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(30,), (10,), (20,)]
+    )
+    s = t.sort(t.v)
+    _ids, scols = pw.debug.table_to_dicts(s)
+    tcols = _dicts(t)
+    # reconstruct the chain order by following next pointers
+    id_by_v = {tcols["v"][k]: k for k in tcols["v"]}
+    chain = []
+    cur = id_by_v[10]
+    while cur is not None:
+        chain.append(cur)
+        cur = scols["next"].get(cur)
+    vals = [tcols["v"][k] for k in chain]
+    assert vals == [10, 20, 30]
+    assert scols["prev"][id_by_v[10]] is None
+    assert scols["next"][id_by_v[30]] is None
+
+
+def test_table_slice_getitem():
+    t = _t()
+    # t[[cols]] yields column references; select materializes the slice
+    sl = t.select(*t[["k", "i"]])
+    cols = _dicts(sl)
+    assert sorted(cols.keys()) == ["i", "k"]
+
+
+def test_ix_ref_lookup():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(code=str, label=str),
+        [("AA", "first"), ("BB", "second")],
+    ).with_id_from(pw.this.code)
+    q = pw.debug.table_from_rows(
+        pw.schema_from_types(which=str), [("AA",), ("BB",), ("AA",)]
+    )
+    res = q.select(lab=t.ix_ref(q.which).label)
+    cols = _dicts(res)
+    assert sorted(cols["lab"].values()) == ["first", "first", "second"]
+
+
+def test_update_cells_patches_subset():
+    base = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int, w=int),
+        [("a", 1, 10), ("b", 2, 20)],
+    ).with_id_from(pw.this.k)
+    patch = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int), [("a", 100)]
+    ).with_id_from(pw.this.k)
+    res = base.update_cells(patch.select(patch.v))
+    cols = _dicts(res)
+    got = {cols["k"][key]: (cols["v"][key], cols["w"][key]) for key in cols["k"]}
+    assert got == {"a": (100, 10), "b": (2, 20)}
+
+
+def test_with_universe_of_reuses_keys():
+    a = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(1,), (2,)]
+    ).with_id_from(pw.this.v)
+    b = pw.debug.table_from_rows(
+        pw.schema_from_types(w=str), [(1, ), (2, )][:0] or [("x",), ("y",)]
+    )
+    # restrict b onto a's universe is invalid (different keys); instead
+    # restrict a view of a
+    sub = a.filter(a.v == 1)
+    widened = sub.with_universe_of(sub)
+    cols = _dicts(widened)
+    assert sorted(cols["v"].values()) == [1]
+
+
+def test_groupby_on_filtered_stream():
+    t = pw.debug.table_from_markdown(
+        """
+        g | v | __time__ | __diff__
+        a | 1 | 2        | 1
+        a | 5 | 2        | 1
+        b | 2 | 4        | 1
+        a | 5 | 6        | -1
+        """
+    )
+    res = t.filter(t.v < 5).groupby(pw.this.g).reduce(
+        g=pw.this.g, n=pw.reducers.count()
+    )
+    cols = _dicts(res)
+    got = {cols["g"][k]: cols["n"][k] for k in cols["g"]}
+    assert got == {"a": 1, "b": 1}
+
+
+def test_diff_computes_deltas_in_sort_order():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, v=int),
+        [(1, 10), (2, 14), (3, 11)],
+    )
+    res = t.diff(t.t, t.v)
+    cols = _dicts(res)
+    by_t = {}
+    tcols = _dicts(t)
+    for k in cols["diff_v"]:
+        by_t[tcols["t"][k]] = cols["diff_v"][k]
+    assert by_t == {1: None, 2: 4, 3: -3}

@@ -304,39 +304,42 @@ _WPLANE_SCRIPT = """
 import sys
 sys.path.insert(0, {repo!r})
 import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
 
-t = pw.debug.table_from_rows(
-    pw.schema_from_types(t=int, v=int),
-    [((i * 7) % 500, i % 13) for i in range(2000)])
-win = pw.temporal.windowby(
-    t, t.t, window=pw.temporal.{winexpr},
-    behavior={behavior},
-)
-res = win.reduce(
-    start=pw.this._pw_window_start, n=pw.reducers.count(),
-    sv=pw.reducers.sum(pw.this.v))
-_ids, cols = pw.debug.table_to_dicts(res)
-print("RESULT", sorted(
-    (cols["start"][k], cols["n"][k], cols["sv"][k]) for k in cols["n"]))
+CASES = [
+    ("tumbling", lambda: (pw.temporal.tumbling(duration=50), None)),
+    (
+        "tumbling-eo",
+        lambda: (
+            pw.temporal.tumbling(duration=50),
+            pw.temporal.exactly_once_behavior(),
+        ),
+    ),
+    ("sliding", lambda: (pw.temporal.sliding(hop=25, duration=75), None)),
+]
+for name, make in CASES:
+    G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, v=int),
+        [((i * 7) % 500, i % 13) for i in range(2000)])
+    win_obj, behavior = make()
+    win = pw.temporal.windowby(t, t.t, window=win_obj, behavior=behavior)
+    res = win.reduce(
+        start=pw.this._pw_window_start, n=pw.reducers.count(),
+        sv=pw.reducers.sum(pw.this.v))
+    _ids, cols = pw.debug.table_to_dicts(res)
+    print("RESULT", name, sorted(
+        (cols["start"][k], cols["n"][k], cols["sv"][k]) for k in cols["n"]))
 """
 
 
-@pytest.mark.parametrize(
-    "winexpr,behavior",
-    [
-        ("tumbling(duration=50)", "None"),
-        ("tumbling(duration=50)", "pw.temporal.exactly_once_behavior()"),
-        ("sliding(hop=25, duration=75)", "None"),
-    ],
-    ids=["tumbling", "tumbling-eo", "sliding"],
-)
-def test_window_plane_equivalence(winexpr, behavior):
+def test_window_plane_equivalence():
+    """Three window/behavior shapes per plane, ONE subprocess per leg
+    (spawning a leg per shape tripled the suite's subprocess cost)."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = _WPLANE_SCRIPT.format(
-        repo=repo, winexpr=winexpr, behavior=behavior
-    )
+    script = _WPLANE_SCRIPT.format(repo=repo)
 
-    def run(native: bool) -> str:
+    def run(native: bool) -> list[str]:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PATHWAY_TPU_NATIVE"] = "1" if native else "0"
@@ -344,9 +347,13 @@ def test_window_plane_equivalence(winexpr, behavior):
             [sys.executable, "-c", script],
             capture_output=True, text=True, env=env, timeout=240,
         )
-        for line in r.stdout.splitlines():
-            if line.startswith("RESULT"):
-                return line
-        raise AssertionError(f"no RESULT: {r.stdout[-300:]} {r.stderr[-1200:]}")
+        lines = [
+            ln for ln in r.stdout.splitlines() if ln.startswith("RESULT")
+        ]
+        if len(lines) != 3:
+            raise AssertionError(
+                f"expected 3 RESULT lines: {r.stdout[-400:]} {r.stderr[-1200:]}"
+            )
+        return lines
 
     assert run(True) == run(False)
