@@ -1,0 +1,164 @@
+"""Connector-runtime matrix: python ConnectorSubject streams (append /
+upsert sessions, commit batching), subscribe callback ordering
+(on_change -> on_time_end -> on_end), and demo stream generators
+(reference tier-2: connector integration tests)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _run_stream(build, timeout_s=30):
+    """Build sinks, run pw.run() to stream end, return captured events."""
+    events: list = []
+    table = build()
+    pw.io.subscribe(
+        table,
+        on_change=lambda key, row, time, is_addition: events.append(
+            ("change", dict(row), time, is_addition)
+        ),
+        on_time_end=lambda time: events.append(("time_end", time)),
+        on_end=lambda: events.append(("end",)),
+    )
+    th = threading.Thread(target=pw.run, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    assert not th.is_alive(), "stream did not terminate"
+    return events
+
+
+def test_python_connector_append_stream():
+    from pathway_tpu.io.python import ConnectorSubject
+
+    class Numbers(ConnectorSubject):
+        def run(self):
+            for i in range(7):
+                self.next(v=i)
+
+    def build():
+        t = pw.io.python.read(
+            Numbers(), schema=pw.schema_from_types(v=int)
+        )
+        return t.reduce(s=pw.reducers.sum(pw.this.v), n=pw.reducers.count())
+
+    events = _run_stream(build)
+    final_changes = [e for e in events if e[0] == "change" and e[3]]
+    assert final_changes[-1][1] == {"s": 21, "n": 7}
+    assert events[-1] == ("end",)
+
+
+def test_python_connector_upsert_by_primary_key():
+    from pathway_tpu.io.python import ConnectorSubject
+
+    class Prices(ConnectorSubject):
+        def run(self):
+            self.next(ticker="AA", px=10)
+            self.next(ticker="BB", px=5)
+            self.next(ticker="AA", px=12)  # upsert same key
+
+    class S(pw.Schema):
+        ticker: str = pw.column_definition(primary_key=True)
+        px: int
+
+    def build():
+        return pw.io.python.read(Prices(), schema=S)
+
+    events = _run_stream(build)
+    state: dict = {}
+    for e in events:
+        if e[0] != "change":
+            continue
+        _tag, row, _t, add = e
+        if add:
+            state[row["ticker"]] = row["px"]
+        elif state.get(row["ticker"]) == row["px"]:
+            del state[row["ticker"]]
+    assert state == {"AA": 12, "BB": 5}
+
+
+def test_subscribe_callback_ordering():
+    from pathway_tpu.io.python import ConnectorSubject
+
+    class OneShot(ConnectorSubject):
+        def run(self):
+            self.next(v=1)
+
+    def build():
+        return pw.io.python.read(
+            OneShot(), schema=pw.schema_from_types(v=int)
+        )
+
+    events = _run_stream(build)
+    kinds = [e[0] for e in events]
+    assert kinds[-1] == "end"
+    first_change = kinds.index("change")
+    first_time_end = kinds.index("time_end")
+    assert first_change < first_time_end  # changes land before their wave closes
+    assert "end" not in kinds[:-1]  # end fires exactly once, last
+
+
+def test_demo_range_stream_terminates_with_exact_rows():
+    def build():
+        t = pw.demo.range_stream(nb_rows=15, input_rate=1000)
+        return t.reduce(n=pw.reducers.count(), s=pw.reducers.sum(pw.this.value))
+
+    events = _run_stream(build)
+    adds = [e[1] for e in events if e[0] == "change" and e[3]]
+    assert adds[-1] == {"n": 15, "s": sum(range(15))}
+
+
+def test_demo_noisy_linear_stream_schema():
+    def build():
+        t = pw.demo.noisy_linear_stream(nb_rows=10, input_rate=1000)
+        return t.reduce(n=pw.reducers.count())
+
+    events = _run_stream(build)
+    adds = [e[1] for e in events if e[0] == "change" and e[3]]
+    assert adds[-1] == {"n": 10}
+
+
+def test_connector_commit_batches_respect_autocommit():
+    """With a slow producer and small autocommit, results stream across
+    MULTIPLE waves (not one giant batch at the end)."""
+    from pathway_tpu.io.python import ConnectorSubject
+
+    class Slow(ConnectorSubject):
+        def run(self):
+            for i in range(6):
+                self.next(v=i)
+                time.sleep(0.03)
+
+    def build():
+        t = pw.io.python.read(Slow(), schema=pw.schema_from_types(v=int))
+        return t.reduce(n=pw.reducers.count())
+
+    events: list = []
+    table = build()
+    pw.io.subscribe(
+        table,
+        on_change=lambda key, row, time, is_addition: events.append(
+            (dict(row), time, is_addition)
+        ),
+        on_end=lambda: events.append(("end",)),
+    )
+    th = threading.Thread(
+        target=lambda: pw.run(autocommit_duration_ms=20), daemon=True
+    )
+    th.start()
+    th.join(30)
+    assert not th.is_alive()
+    add_times = {t for _r, t, a in [e for e in events if e != ("end",)] if a}
+    assert len(add_times) >= 2, "counts must stream across waves"
