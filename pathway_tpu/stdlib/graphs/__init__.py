@@ -60,36 +60,45 @@ def bellman_ford(vertices: Table, edges: Table) -> Table:
     """Shortest paths from rows with is_source=True.
 
     vertices: (is_source: bool); edges: (u: Pointer, v: Pointer, dist: float).
-    Returns (dist_from_source: float) keyed like vertices.
+    Returns (dist: float) keyed like vertices.
     (reference: stdlib/graphs/bellman_ford/impl.py)
     """
     INF = float("inf")
+    # the vertex key rides as an explicit column (vid) and with_id(vid)
+    # pins every round back onto the vertex universe. The previous shape
+    # (join_left on the STATE placeholder's id + per-round reindex of the
+    # relaxation table) never converged inside the iterate scope; joining
+    # on a carried key column with a direct pointer re-key is the
+    # fixpoint-stable formulation (louvain's delta application works the
+    # same way).
     init = vertices.select(
-        dist=if_else(vertices.is_source, 0.0, INF)
+        vid=vertices.id, dist=if_else(vertices.is_source, 0.0, INF)
     )
 
     def step(state: Table) -> dict[str, Table]:
         relaxed = (
-            edges.join(state, edges.u == state.id)
+            edges.join(state, edges.u == state.vid)
             .select(v=ex.left.v, cand=ex.right.dist + ex.left.dist)
         )
         best = relaxed.groupby(relaxed.v).reduce(
             v=relaxed.v, cand=red.min(relaxed.cand)
-        ).with_id_from(ex.this.v)
-        new_state = state.join_left(best, state.id == best.id).select(
-            dist=if_else(
-                coalesce(ex.right.cand, INF) < ex.left.dist,
-                coalesce(ex.right.cand, INF),
-                ex.left.dist,
-            ),
-            id=ex.left.id,
         )
-        return {"state": new_state.with_id(ex.this.id).without("id")}
+        new_state = (
+            state.join_left(best, state.vid == best.v)
+            .select(
+                vid=ex.left.vid,
+                dist=if_else(
+                    coalesce(ex.right.cand, INF) < ex.left.dist,
+                    coalesce(ex.right.cand, INF),
+                    ex.left.dist,
+                ),
+            )
+            .with_id(ex.this.vid)
+        )
+        return {"state": new_state}
 
-    # NOTE: join_left keeps left ids when id=left.id; we reindex back onto
-    # the vertex universe each round so the fixpoint is key-stable.
     result = iterate(lambda state: step(state), state=init)
-    return result
+    return result.without("vid")
 
 
 def _with_weight(E: Table) -> Table:

@@ -1,0 +1,109 @@
+"""pw.Json value-type matrix: navigation, coercions, flattening through
+pipelines, and jsonlines ingestion of nested payloads (reference tier-2:
+tests/test_json.py)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_json_navigation_and_coercions():
+    j = Json({"a": {"b": [10, 20, {"c": "deep"}]}, "n": 1.5, "t": True})
+    assert j["a"]["b"][0].as_int() == 10
+    assert j["a"]["b"][2]["c"].as_str() == "deep"
+    assert j["n"].as_float() == 1.5
+    assert j["t"].as_bool() is True
+    missing = j.get("missing")
+    assert missing is None or not missing  # absent -> None/Json(None)
+    with pytest.raises(KeyError):
+        j["missing"]
+    assert len(j["a"]["b"]) == 3
+    assert [x.as_int() for x in j["a"]["b"]][:2] == [10, 20]
+
+
+def test_json_parse_dumps_roundtrip():
+    payload = {"k": [1, "two", None, {"nested": False}]}
+    s = Json.dumps(payload)
+    back = Json.parse(s)
+    assert back.value == payload
+    assert json.loads(s) == payload
+
+
+def test_json_equality_and_bool():
+    assert Json({"a": 1}) == Json({"a": 1})
+    assert Json([]) != Json({})
+    assert not Json(None)
+    assert not Json([])
+    assert Json([0])
+
+
+def test_json_column_through_pipeline():
+    rows = [
+        (Json({"user": {"name": "ada", "score": 3}}),),
+        (Json({"user": {"name": "bob", "score": 5}}),),
+    ]
+    t = pw.debug.table_from_rows(pw.schema_from_types(payload=Json), rows)
+    res = t.select(
+        name=pw.apply_with_type(
+            lambda p: p["user"]["name"].as_str(), str, t.payload
+        ),
+        score=pw.apply_with_type(
+            lambda p: p["user"]["score"].as_int(), int, t.payload
+        ),
+    )
+    agg = res.reduce(total=pw.reducers.sum(res.score))
+    _ids, cols = pw.debug.table_to_dicts(agg)
+    assert list(cols["total"].values()) == [8]
+
+
+def test_jsonlines_nested_payload_lands_as_json(tmp_path):
+    class S(pw.Schema):
+        meta: Json
+
+    inp = tmp_path / "in.jsonl"
+    inp.write_text(
+        '{"meta": {"tags": ["x", "y"], "depth": {"z": 3}}}\n'
+        '{"meta": {"tags": [], "depth": {"z": 4}}}\n'
+    )
+    t = pw.io.fs.read(str(inp), format="json", schema=S, mode="static")
+    res = t.select(
+        z=pw.apply_with_type(lambda m: m["depth"]["z"].as_int(), int, t.meta),
+        ntags=pw.apply_with_type(lambda m: len(m["tags"]), int, t.meta),
+    )
+    _ids, cols = pw.debug.table_to_dicts(res)
+    assert sorted(
+        zip(cols["z"].values(), cols["ntags"].values())
+    ) == [(3, 2), (4, 0)]
+
+
+def test_json_groupby_key_via_freeze():
+    """Json cell contents can drive grouping through extracted scalars."""
+    rows = [
+        (Json({"cat": "a", "v": 1}),),
+        (Json({"cat": "b", "v": 10}),),
+        (Json({"cat": "a", "v": 5}),),
+    ]
+    t = pw.debug.table_from_rows(pw.schema_from_types(p=Json), rows)
+    flat = t.select(
+        cat=pw.apply_with_type(lambda p: p["cat"].as_str(), str, t.p),
+        v=pw.apply_with_type(lambda p: p["v"].as_int(), int, t.p),
+    )
+    agg = flat.groupby(flat.cat).reduce(
+        cat=flat.cat, s=pw.reducers.sum(flat.v)
+    )
+    _ids, cols = pw.debug.table_to_dicts(agg)
+    got = {cols["cat"][k]: cols["s"][k] for k in cols["cat"]}
+    assert got == {"a": 6, "b": 10}
