@@ -364,6 +364,34 @@ pw.run()
 print("ROWS_PER_SEC", {n} / (time.time() - t0))
 """
 
+# Pre-tokenized ingest sub-rung: static fs.read parses + interns rows
+# EAGERLY at table-build time, so starting the clock after the reads
+# isolates join + groupby + sink throughput from the shared jsonl I/O —
+# the rows are already resident in the intern table when timing starts.
+# Proves (or refutes) that the 500k join bar is ingest-bound.
+_JOIN_PRETOK_SCRIPT = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import pathway_tpu as pw
+
+class U(pw.Schema):
+    uid: int
+    name: str
+
+class E(pw.Schema):
+    uid: int
+    amount: float
+
+u = pw.io.fs.read({users!r}, format="json", schema=U, mode="static")
+e = pw.io.fs.read({events!r}, format="json", schema=E, mode="static")
+t0 = time.time()  # rows already interned: the clock sees only the engine
+j = e.join(u, e.uid == u.uid).select(name=u.name, amount=e.amount)
+agg = j.groupby(j.name).reduce(j.name, total=pw.reducers.sum(j.amount))
+pw.io.csv.write(agg, {out!r})
+pw.run()
+print("ROWS_PER_SEC", {n} / (time.time() - t0))
+"""
+
 _REGRESSION_SCRIPT = r"""
 import sys, time
 sys.path.insert(0, {repo!r})
@@ -862,6 +890,23 @@ def bench_dataflow(repo: str) -> dict:
         out["join_native_vs_python"] = round(
             out["join_rows_per_sec"] / join_py, 2
         )
+        # pre-tokenized sub-rung: same join, clock started after ingest
+        jp = _JOIN_PRETOK_SCRIPT.format(
+            repo=repo, users=uinp, events=einp,
+            out=os.path.join(tmp, "join_out_pretok.csv"), n=n_ev,
+        )
+        out["join_pretokenized_rows_per_sec"] = round(
+            _run_engine_script(
+                jp, {"PATHWAY_THREADS": "1"},
+                stats=stats, rung="join_pretokenized_rows_per_sec",
+            ),
+            1,
+        )
+        out["join_ingest_share"] = round(
+            1.0
+            - out["join_rows_per_sec"] / out["join_pretokenized_rows_per_sec"],
+            3,
+        )
 
         rinp = os.path.join(tmp, "reg.jsonl")
         _gen_regression_input(rinp, REGRESSION_ROWS)
@@ -906,58 +951,95 @@ def bench_dataflow(repo: str) -> dict:
 
 def main() -> None:
     repo = os.path.dirname(os.path.abspath(__file__))
+    # PATHWAY_BENCH_SKIP_DEVICE=1: run the engine ladder only, keeping
+    # every device-rung KEY present (null values + an explicit marker) —
+    # for CPU-only hosts where the chip rungs would take hours or are
+    # meaningless. The committed bench_out.json must always carry the
+    # complete metric set (BENCH_r05 was a truncated tail capture that
+    # lost the head keys; see write_bench_out below).
+    skip_device = os.environ.get("PATHWAY_BENCH_SKIP_DEVICE") == "1"
     # subprocess rungs first: the RAG-on-chip subprocess needs the device
     # before this process initializes its own client
-    rag_tpu = bench_rag_tpu(repo)
+    rag_tpu = (
+        {"rag_questions_per_sec_tpu": None}
+        if skip_device
+        else bench_rag_tpu(repo)
+    )
     dataflow = bench_dataflow(repo)
     dev = jax.devices()[0]
-    # config 5 FIRST: the 2B decoder needs the most contiguous HBM
-    try:
-        decode_rate = bench_lm_decode()
-    except Exception as e:  # noqa: BLE001 — stretch config, never fatal
-        decode_rate = None
-        print(f"# lm decode bench skipped: {e}", file=sys.stderr)
-    knn_p50 = bench_knn()  # before embed: HBM is clean for the 1M-doc matrix
-    knn_single, knn_device = bench_knn_single_dispatch()
-    embed_rate = bench_embed()
-    print(
-        json.dumps(
-            {
-                "metric": "embed_throughput_per_chip",
-                "value": round(embed_rate, 1),
-                "unit": "embeddings/sec",
-                "vs_baseline": round(embed_rate / EMBED_TARGET, 3),
-                "knn_p50_ms_1M_docs": round(knn_p50, 3),
-                # un-pipelined dispatch+readback: two sequential ~100 ms
-                # tunnel round trips on this host (a trivial 8-float
-                # kernel measures the same) — transport, not compute
-                "knn_p50_single_dispatch_ms": round(knn_single, 3),
-                # device-side compute from the jax.profiler trace: the
-                # number comparable to the reference's usearch latency
-                "knn_p50_device_ms": (
-                    round(knn_device, 3) if knn_device is not None else None
-                ),
-                # target ratio is defined on device compute only — when
-                # the trace is unavailable the ratio is null rather than
-                # silently switching to a different quantity
-                "knn_vs_target": (
-                    round(KNN_TARGET_MS / max(knn_device, 1e-9), 3)
-                    if knn_device is not None
-                    else None
-                ),
-                "knn_vs_target_pipelined": round(
-                    KNN_TARGET_MS / max(knn_p50, 1e-9), 3
-                ),
-                **dataflow,
-                **rag_tpu,
-                # config 5 stretch: Gemma-2B-shaped on-chip decode
-                "lm_decode_tokens_per_sec": (
-                    round(decode_rate, 1) if decode_rate else None
-                ),
-                "device": str(dev.platform),
-            }
-        )
+    decode_rate = knn_p50 = knn_single = knn_device = embed_rate = None
+    if not skip_device:
+        # config 5 FIRST: the 2B decoder needs the most contiguous HBM
+        try:
+            decode_rate = bench_lm_decode()
+        except Exception as e:  # noqa: BLE001 — stretch config, never fatal
+            print(f"# lm decode bench skipped: {e}", file=sys.stderr)
+        knn_p50 = bench_knn()  # before embed: HBM clean for the 1M-doc matrix
+        knn_single, knn_device = bench_knn_single_dispatch()
+        embed_rate = bench_embed()
+    result = {
+        "metric": "embed_throughput_per_chip",
+        "value": round(embed_rate, 1) if embed_rate is not None else None,
+        "unit": "embeddings/sec",
+        "vs_baseline": (
+            round(embed_rate / EMBED_TARGET, 3)
+            if embed_rate is not None
+            else None
+        ),
+        "embed_throughput_per_chip": (
+            round(embed_rate, 1) if embed_rate is not None else None
+        ),
+        "knn_p50_ms_1M_docs": (
+            round(knn_p50, 3) if knn_p50 is not None else None
+        ),
+        # un-pipelined dispatch+readback: two sequential ~100 ms
+        # tunnel round trips on a tunneled host (a trivial 8-float
+        # kernel measures the same) — transport, not compute
+        "knn_p50_single_dispatch_ms": (
+            round(knn_single, 3) if knn_single is not None else None
+        ),
+        # device-side compute from the jax.profiler trace: the
+        # number comparable to the reference's usearch latency
+        "knn_p50_device_ms": (
+            round(knn_device, 3) if knn_device is not None else None
+        ),
+        # target ratio is defined on device compute only — when
+        # the trace is unavailable the ratio is null rather than
+        # silently switching to a different quantity
+        "knn_vs_target": (
+            round(KNN_TARGET_MS / max(knn_device, 1e-9), 3)
+            if knn_device is not None
+            else None
+        ),
+        "knn_vs_target_pipelined": (
+            round(KNN_TARGET_MS / max(knn_p50, 1e-9), 3)
+            if knn_p50 is not None
+            else None
+        ),
+        **dataflow,
+        **rag_tpu,
+        # config 5 stretch: Gemma-2B-shaped on-chip decode
+        "lm_decode_tokens_per_sec": (
+            round(decode_rate, 1) if decode_rate else None
+        ),
+        "device": str(dev.platform),
+        "device_rungs": (
+            "skipped: PATHWAY_BENCH_SKIP_DEVICE=1 (CPU-only host)"
+            if skip_device
+            else "measured"
+        ),
+    }
+    print(json.dumps(result))
+    # the durable artifact: the COMPLETE metrics dict, written to a file
+    # so no stdout capture can truncate it (VERDICT weak-item 5: the
+    # r05 tail capture lost wordcount_*, knn_p50_* and embed_*)
+    out_path = os.environ.get(
+        "PATHWAY_BENCH_OUT", os.path.join(repo, "bench_out.json")
     )
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(f"# full metrics -> {out_path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

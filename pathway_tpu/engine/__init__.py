@@ -7,10 +7,12 @@ a DBSP-style minimal core:
 - one total-ordered timestamp domain (even milliseconds, matching
   src/engine/timestamp.rs:20-27) instead of Naiad product timestamps;
 - z-set (diff) collections flowing through a DAG of operator nodes;
-- a single-threaded pump per worker that finalizes one timestamp at a
-  time in topological order — progress tracking collapses to "the wave
-  for time t has fully drained", no distributed frontier protocol needed
-  on a single host;
+- frontier-based progress tracking (engine/frontier.py, the timely
+  progress/frontier.rs equivalent over a total order): every source
+  carries a watermark, and an operator is notified for time t as soon
+  as its input frontier passes t — out-of-order across operators,
+  in-order at each, with no global wave barrier; the process mesh
+  exchanges (time, batch) plus per-wire watermark announcements;
 - numeric columns batch onto the XLA plane (engine/vectorize.py), hot
   index/sort/join inner loops go through the C++ kernel
   (pathway_tpu/native) when available;

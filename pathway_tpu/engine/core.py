@@ -459,6 +459,10 @@ class Graph:
         self.nodes: list[Node] = []
         self.error_log = global_error_log()
         self.terminate_on_error = False
+        # the FrontierScheduler driving this graph, when one is attached
+        # (engine/frontier.py); operators may consult it for their input
+        # frontier (e.g. the iterate scope). None under the static pump.
+        self.scheduler = None
 
     def register(self, node: Node) -> int:
         self.nodes.append(node)

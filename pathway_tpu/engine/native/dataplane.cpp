@@ -28,6 +28,7 @@
 #include <cerrno>
 #include <charconv>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <mutex>
@@ -1314,18 +1315,42 @@ int64_t dp_build_rows(void* h, int64_t n, const uint64_t* in_tokens,
 
 namespace {
 
-// Python-repr-compatible float formatting: shortest round-trip via
-// to_chars, then ".0" appended for integral values (repr(5.0) == "5.0").
+// Python-repr-compatible float formatting: shortest round-trip, then
+// ".0" appended for integral values (repr(5.0) == "5.0"). libstdc++
+// only grew floating-point to_chars in GCC 11 (__cpp_lib_to_chars); on
+// older toolchains probe %.{1..17}g for the shortest representation
+// that parses back exactly — same output, keeps the plane buildable.
 inline void format_double(std::string& out, double v) {
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
     char buf[40];
     auto r = std::to_chars(buf, buf + sizeof(buf), v);
+    char* end = r.ptr;
+#else
+    char buf[40];
+    int n = snprintf(buf, sizeof buf, "%.17g", v);
+    for (int prec = 1; prec <= 16; ++prec) {
+        char probe[40];
+        int pn = snprintf(probe, sizeof probe, "%.*g", prec, v);
+        if (strtod(probe, nullptr) == v) {
+            std::memcpy(buf, probe, (size_t)pn + 1);
+            n = pn;
+            break;
+        }
+    }
+    // snprintf/%g honors LC_NUMERIC (to_chars never does): normalize a
+    // comma decimal point so embedding processes that setlocale() still
+    // produce well-formed CSV/repr output
+    for (int k = 0; k < n; ++k)
+        if (buf[k] == ',') buf[k] = '.';
+    char* end = buf + n;
+#endif
     bool plain = true;
-    for (char* q = buf; q < r.ptr; ++q)
+    for (char* q = buf; q < end; ++q)
         if (*q == '.' || *q == 'e' || *q == 'n' || *q == 'i') {
             plain = false;  // has '.', exponent, nan or inf
             break;
         }
-    out.append(buf, r.ptr);
+    out.append(buf, end);
     if (plain) out.append(".0");
 }
 

@@ -162,7 +162,14 @@ class ThreadConnector(Connector):
 
 
 class Runtime:
-    """Single-worker pump. Timestamps are even milliseconds from run start."""
+    """Per-worker pump. Timestamps are even milliseconds from run start.
+
+    Streaming and mesh execution are frontier-driven (engine/frontier.py):
+    every source owns a watermark, waves carry (time, batch), and an
+    operator fires for time t as soon as its input frontier passes t —
+    there is no global wave barrier. ``run_static`` keeps the exact
+    deterministic batch pump for debug computations.
+    """
 
     def __init__(self, graph: Graph, autocommit_ms: int = 2):
         self.graph = graph
@@ -176,6 +183,10 @@ class Runtime:
         self.stop_event: Any = None
         # inter-process data plane (parallel/process_mesh.py)
         self.mesh: Any = None
+        # session sequence for namespacing mesh control tags
+        self.session_seq = 0
+        # the live FrontierScheduler (set by run/run_mesh; tests inspect)
+        self.scheduler: Any = None
 
     def next_time(self) -> int:
         self.time += 2  # even-ms granule, reference timestamp.rs:20-27
@@ -184,8 +195,43 @@ class Runtime:
     def add_connector(self, connector: Connector) -> None:
         self.connectors.append(connector)
 
+    # ------------------------------------------------------ frontier pumps
+
+    def _make_scheduler(self):
+        from pathway_tpu.engine.frontier import FrontierScheduler
+
+        sched = FrontierScheduler(self.graph, monitors=self.monitors)
+        self.scheduler = sched
+        self.graph.scheduler = sched
+        return sched
+
+    def _kick_sources(self, sched) -> dict:
+        """Register kick sources for capability-holding operators
+        (iterate scopes with truncated convergence): the pump schedules
+        empty waves through their cones until they drop the capability."""
+        return {
+            node: sched.add_kick_source(node)
+            for node in self.graph.nodes
+            if hasattr(node, "_pending_convergence")
+        }
+
+    def _stage_kicks(self, sched, kicks: dict) -> None:
+        for node, tok in kicks.items():
+            if node._pending_convergence:
+                sched.stage(tok, self.next_time())
+
     def run(self) -> None:
-        """Pump until all connectors are done; then flush + end."""
+        """Streaming pump: poll until all connectors are done, then
+        flush + end.
+
+        Each connector is its own SOURCE: a poll that yields data
+        becomes a wave at a fresh timestamp of that source alone, and
+        only that source's downstream cone fires. A slow source
+        therefore delays nothing outside its own cone — operators
+        downstream of other sources keep processing newer timestamps
+        while the straggler catches up (frontier semantics; previously
+        every wave stepped the whole graph at one shared timestamp).
+        """
         for c in self.connectors:
             c.start()
         if not self.connectors:
@@ -193,25 +239,31 @@ class Runtime:
             self.graph.step(t)
             self.graph.end(t)
             return
+        sched = self._make_scheduler()
+        src = {c: sched.add_source(c.session.node) for c in self.connectors}
+        kicks = self._kick_sources(sched)
+        closed: set = set()
         ckpt_dirty = False
         while True:
             _time.sleep(self.autocommit_ms / 1000.0)
-            any_data = False
             for c in self.connectors:
                 entries = c.poll()
                 if entries:
-                    any_data = True
-                    c.session.node.push(entries)
-            if any_data:
-                t = self.next_time()
-                self.graph.step(t)
+                    sched.stage(src[c], self.next_time(), entries)
+            stopped = self.stop_event is not None and self.stop_event.is_set()
+            for c in self.connectors:
+                if (stopped or c.done) and src[c] not in closed:
+                    closed.add(src[c])
+                    sched.close(src[c])
+            self._stage_kicks(sched, kicks)
+            sched.advance_local(self.time)
+            if sched.pump():
                 ckpt_dirty = True
-                for m in self.monitors:
-                    m(t)
             # checkpoint on cadence whenever there is anything new to
-            # commit — processed waves OR offset-frontier advances (a
+            # commit — retired waves OR offset-frontier advances (a
             # quiet stream whose source finished a file still needs its
-            # frontier made durable)
+            # frontier made durable). The cut is at the global frontier:
+            # after a pump every staged wave at or below it has retired.
             if (
                 self.checkpointer is not None
                 and self.checkpointer.due()
@@ -219,32 +271,266 @@ class Runtime:
             ):
                 self.checkpointer.checkpoint(self.time)
                 ckpt_dirty = False
-            stopped = self.stop_event is not None and self.stop_event.is_set()
-            if stopped or all(c.done for c in self.connectors):
-                # final drain
-                final: bool = False
+            if len(closed) == len(self.connectors):
+                # final drain: anything staged between the last poll and
+                # the connector finishing
+                final = False
                 for c in self.connectors:
                     entries = c.poll()
                     if entries:
-                        c.session.node.push(entries)
+                        sched.stage(src[c], self.next_time(), entries)
                         final = True
-                t = self.next_time()
                 if final:
-                    self.graph.step(t)
+                    sched.advance_local(self.time)
+                    sched.pump()
+                t = self.next_time()
                 self.graph.end(t)
                 if self.checkpointer is not None:
                     self.checkpointer.checkpoint(t)
                     self.checkpointer.close()
                 break
 
+    # ---------------------------------------------------------- mesh pump
+
+    def _drain_mesh(self, sched, mesh, remote_tokens) -> bool:
+        """Pull watermark announcements + data buckets from the mesh
+        into the scheduler. The watermark snapshot is taken atomically
+        with (and logically before) the inbox drain, so a wire watermark
+        of W is never acted on before every bucket at or below W from
+        that peer has been staged (TCP frames from one peer arrive in
+        send order)."""
+        wm, buckets = mesh.take_frontier_updates()
+        staged = False
+        for (wire, time, peer, payload) in buckets:
+            if not isinstance(time, (int, float)):
+                # a peer already at the END BARRIER tags buckets with
+                # ('end', t): they belong to the keyed blocking
+                # exchange this process will run at its own graph.end
+                mesh.restore_bucket(wire, time, peer, payload)
+                continue
+            tok = remote_tokens.get((wire, peer))
+            if tok is None:
+                # another session's wire on the shared process-wide
+                # mesh: put it back for that session to claim (its
+                # enable_frontier_inbox sweep recovers keyed buckets)
+                mesh.restore_bucket(wire, time, peer, payload)
+                continue
+            sched.stage(tok, time, payload)
+            staged = True
+            if time > self.time:
+                # keep the local clock ahead of every observed remote
+                # time so fresh local waves never sort behind them
+                self.time = time + (time % 2)
+        for (wire, peer), value in wm.items():
+            tok = remote_tokens.get((wire, peer))
+            if tok is not None:
+                sched.advance(tok, value)
+        return staged
+
+    def _pump_mesh(self, sched, mesh, xnodes, sent: dict) -> bool:
+        """Fire until stable, in small chunks: after every few
+        notifications, announce each wire's advanced frontier (min over
+        the sources reaching its exchange node, bounded by in-flight
+        waves — nothing at or below it will ever be sent on the wire
+        again) and drain newly-arrived remote buckets/watermarks. The
+        chunking keeps this process's outgoing frontiers moving even
+        through a long grind of slow operator waves — peers gated on
+        these wires progress concurrently instead of freezing until
+        the grind ends."""
+        fired_any = False
+        while True:
+            fired = sched.pump(budget=8)
+            fired_any = fired_any or bool(fired)
+            moved = False
+            for x in xnodes:
+                f = sched.frontier_of_node(x)
+                if f > sent[x.wire_id]:
+                    sent[x.wire_id] = f
+                    mesh.send_wm(x.wire_id, f)
+                    moved = True
+            if self._drain_mesh(sched, mesh, self._remote_tokens):
+                moved = True
+            if not moved and not fired:
+                return fired_any
+
+    def _mesh_quiesce(self, sched, mesh, xnodes, sent, tag: str, rounds: int):
+        """Barrier-drain rounds until the mesh is globally quiescent:
+        each round flushes (at least) one more exchange stage — data and
+        watermarks sent before a peer's barrier frame are ordered before
+        it, so after `rounds` >= 2*depth+2 rounds nothing is in flight.
+        Returns the final allgather view {proc: local_time}."""
+        vals = None
+        for r in range(rounds):
+            vals = mesh.allgather(f"{tag}-r{r}", self.time)
+            self._drain_mesh(sched, mesh, self._remote_tokens)
+            self._pump_mesh(sched, mesh, xnodes, sent)
+        return vals
+
+    def run_mesh(
+        self, static_batches: list[tuple[int, InputNode, list[Entry]]] | None = None
+    ) -> None:
+        """Multi-process frontier pump: replaces the lockstep BSP wave
+        barrier (``run_lockstep``) with asynchronous progress tracking.
+
+        Every process pumps its OWN sources at its own pace; exchange
+        channels carry (time, batch) plus per-wire watermark
+        announcements, and a downstream operator fires for time t as
+        soon as its input frontier — local sources AND incoming wires —
+        passes t. A straggling process therefore delays only the
+        operators that causally consume its data; causally-independent
+        cones on every peer keep processing at full speed (reference:
+        timely's distributed progress protocol, progress/frontier.rs).
+
+        Checkpoints cut at globally fully-retired times: the cadence
+        owner (process 0) raises a FENCE; every process stops admitting
+        input, the mesh drains to quiescence over barrier rounds, and
+        all processes snapshot the same epoch — mutually consistent by
+        construction (no wave is half-absorbed anywhere).
+        """
+        from pathway_tpu.engine.frontier import DONE
+        from pathway_tpu.engine.workers import ProcessExchangeNode
+
+        mesh = self.mesh
+        assert mesh is not None
+        sched = self._make_scheduler()
+        sid = self.session_seq
+        for c in self.connectors:
+            c.start()
+        src = {c: sched.add_source(c.session.node) for c in self.connectors}
+        statics_by_node: dict[int, Any] = {}
+        for t, node, entries in sorted(
+            static_batches or [], key=lambda b: b[0]
+        ):
+            tok = statics_by_node.get(node.node_id)
+            if tok is None:
+                tok = statics_by_node[node.node_id] = sched.add_source(node)
+            sched.stage(tok, t, entries)
+            self.time = max(self.time, t + (t % 2))
+        for tok in statics_by_node.values():
+            sched.close(tok)
+        kicks = self._kick_sources(sched)
+        xnodes = [
+            n for n in self.graph.nodes if isinstance(n, ProcessExchangeNode)
+        ]
+        self._remote_tokens: dict[tuple[int, int], Any] = {}
+        for x in xnodes:
+            x.frontier_mode = True
+            for p in mesh.peers:
+                self._remote_tokens[(x.wire_id, p)] = sched.add_remote_source(
+                    x, p
+                )
+        mesh.enable_frontier_inbox()
+        wm_sent = {x.wire_id: -1 for x in xnodes}
+        rounds = 2 * sched.reach.exchange_depth() + 2
+        fences_handled = 0
+        fences_raised = 0
+        closed: set = set()
+        done_sent = False
+        ckpt_dirty = False
+        try:
+            while True:
+                if mesh._dead:
+                    raise ConnectionError(
+                        f"process {mesh.process_id}: peer(s) "
+                        f"{sorted(mesh._dead)} died mid-run"
+                    )
+                # 1. local ingestion: one fresh wave per source per poll
+                for c in self.connectors:
+                    entries = c.poll()
+                    if entries:
+                        sched.stage(src[c], self.next_time(), entries)
+                        ckpt_dirty = True
+                stopped = (
+                    self.stop_event is not None and self.stop_event.is_set()
+                )
+                for c in self.connectors:
+                    if (stopped or c.done) and src[c] not in closed:
+                        closed.add(src[c])
+                        sched.close(src[c])
+                self._stage_kicks(sched, kicks)
+                sched.advance_local(self.time)
+                # 2. remote ingestion + watermark announcements
+                self._drain_mesh(sched, mesh, self._remote_tokens)
+                # 3. fire everything the frontier allows; announce wires
+                if self._pump_mesh(sched, mesh, xnodes, wm_sent):
+                    ckpt_dirty = True
+                # 4. checkpoint fences (cadence owned by process 0)
+                if (
+                    mesh.process_id == 0
+                    and not done_sent
+                    and self.checkpointer is not None
+                    and self.checkpointer.due()
+                    and (ckpt_dirty or self.checkpointer.frontier_advanced())
+                ):
+                    fences_raised += 1
+                    mesh.send_flag(("fence", sid), fences_raised)
+                    mesh.set_flag(("fence", sid), fences_raised)
+                pending_fence = mesh.flag_value(("fence", sid), default=0)
+                while fences_handled < pending_fence:
+                    fences_handled += 1
+                    self._mesh_quiesce(
+                        sched, mesh, xnodes, wm_sent,
+                        f"s{sid}-fence-{fences_handled}", rounds,
+                    )
+                    if self.checkpointer is not None:
+                        self.checkpointer.checkpoint(self.time)
+                        ckpt_dirty = False
+                    pending_fence = mesh.flag_value(("fence", sid), default=0)
+                # 5. termination: local done -> announce; global done ->
+                # drain to quiescence and end together
+                local_done = len(closed) == len(self.connectors)
+                if local_done and not done_sent:
+                    final = False
+                    for c in self.connectors:
+                        entries = c.poll()
+                        if entries:
+                            sched.stage(src[c], self.next_time(), entries)
+                            final = True
+                    if final:
+                        sched.advance_local(self.time)
+                        self._pump_mesh(sched, mesh, xnodes, wm_sent)
+                    for tok in kicks.values():
+                        sched.close(tok)
+                    sched.advance_local(DONE)
+                    self._pump_mesh(sched, mesh, xnodes, wm_sent)
+                    done_sent = True
+                    mesh.send_flag(("done", sid), 1)
+                    mesh.set_flag(("done", sid), 1)
+                if done_sent and all(
+                    mesh.flag_of(("done", sid), p) for p in mesh.peers
+                ):
+                    # a fence raised just before a peer announced done is
+                    # ordered before its done flag: handle it first
+                    pending_fence = mesh.flag_value(("fence", sid), default=0)
+                    if fences_handled < pending_fence:
+                        continue
+                    vals = self._mesh_quiesce(
+                        sched, mesh, xnodes, wm_sent, f"s{sid}-end", rounds
+                    )
+                    t_end = max(max(vals.values()), self.time) + 2
+                    self.time = t_end
+                    mesh.frontier_inbox = False
+                    for x in xnodes:
+                        x.end_barrier = True
+                    self.graph.end(t_end)
+                    if self.checkpointer is not None:
+                        self.checkpointer.checkpoint(t_end)
+                        self.checkpointer.close()
+                    break
+                mesh.wait_frames(self.autocommit_ms / 1000.0)
+        finally:
+            mesh.frontier_inbox = False
+
     def run_lockstep(
         self, static_batches: list[tuple[int, InputNode, list[Entry]]] | None = None
     ) -> None:
-        """Multi-process pump: every process executes the same wave
-        sequence in lockstep (the exchange operators' per-wave barriers
-        depend on it). A per-round control exchange gives each process
-        the identical (any_data, all_done) view — the progress-protocol
-        stand-in — so wave times and termination agree everywhere."""
+        """DEPRECATED lockstep BSP pump (PATHWAY_MESH_BSP=1 fallback and
+        the measured baseline for docs/parallelism.md): every process
+        executes the same wave sequence in lockstep, so one slow worker
+        bounds the whole mesh's wave rate. Superseded by ``run_mesh``'s
+        frontier-based progress tracking. A per-round control exchange
+        gives each process the identical (any_data, all_done) view so
+        wave times and termination agree everywhere."""
         mesh = self.mesh
         assert mesh is not None
         for c in self.connectors:
@@ -380,10 +666,15 @@ class IterateNode(Node):
         self.inner_t = 0
         # body-closure static batches not yet released (outer-time gated)
         self._pending_statics = sorted(static_batches, key=lambda b: b[0])
-        # True when a limit-truncated convergence left feedback queued in
-        # the placeholders; the next wave resumes the loop even without
-        # new outer input
-        self._pending_convergence = False
+        # the sub-scope's frontier (engine/frontier.py ScopeFrontier):
+        # outer times released into the body + the inner round watermark.
+        # A non-quiescent scope holds its feedback capability — a limit-
+        # truncated convergence left deltas queued in the placeholders —
+        # and the runtime keeps scheduling waves through this node's
+        # cone (kick source) until the capability drops.
+        from pathway_tpu.engine.frontier import ScopeFrontier
+
+        self.scope = ScopeFrontier()
         self._ended = False
         # capture-stream read positions (per output name)
         self._read_pos = {name: 0 for name in output_names}
@@ -397,6 +688,22 @@ class IterateNode(Node):
 
     def set_output_node(self, name: str, node: InputNode) -> None:
         self.out_nodes[name] = node
+
+    # The feedback capability, expressed as scope-frontier state: True
+    # while a truncated convergence still holds deltas to push around
+    # the loop. Kept as a (settable) property so operator snapshots and
+    # the runtime's kick machinery read/write one source of truth.
+    @property
+    def _pending_convergence(self) -> bool:
+        return not self.scope.quiescent
+
+    @_pending_convergence.setter
+    def _pending_convergence(self, value: bool) -> None:
+        if value:
+            self.scope.hold()
+        else:
+            self.scope.drop()
+
 
     # ------------------------------------------------- operator snapshots
 
@@ -465,12 +772,18 @@ class IterateNode(Node):
     def _release_statics(self, time: int) -> bool:
         """Push body-closure static batches whose scripted time has come
         (outer and scripted times share the even-ms domain for static
-        runs; streaming wall-clock times release everything at once)."""
+        runs; streaming wall-clock times release everything at once).
+        Advances the sub-scope frontier's outer coordinate: releases are
+        keyed off the wave time, never past the node's input frontier —
+        data for an earlier outer time can no longer arrive once the
+        frontier passed it, so the release point is exactly the scope's
+        input frontier restricted to the scripted domain."""
         released = False
         while self._pending_statics and self._pending_statics[0][0] <= time:
             _t, node, entries = self._pending_statics.pop(0)
             node.push(list(entries) if type(entries) is list else entries)
             released = True
+        self.scope.release(time)
         return released
 
     def finish_time(self, time: int) -> None:
@@ -499,6 +812,7 @@ class IterateNode(Node):
         rounds = 0
         while True:
             self.inner_t += 2
+            self.scope.advance_round(self.inner_t)
             self.sub_graph.step(self.inner_t)
             rounds += 1
             quiescent = True
@@ -558,6 +872,7 @@ class IterateNode(Node):
             node.push(list(entries) if type(entries) is list else entries)
             released = True
         self.inner_t += 2
+        self.scope.advance_round(self.inner_t)
         for node in self.sub_graph.nodes:
             node.on_end(self.inner_t)
         # did end-flushing produce anything to process?

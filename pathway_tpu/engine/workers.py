@@ -371,14 +371,22 @@ def adapt_shard_state(node: Any, st: dict) -> dict:
 class ProcessExchangeNode(Node):
     """Inter-process exchange boundary: one per stateful-operator input.
 
-    Every process runs the same graph in lockstep waves; at this node the
-    wave's batch partitions by the operator's shard key across processes
-    (bucket p goes to process p over the TCP mesh), and the node BLOCKS
-    until every peer's bucket for this (node, round) arrives — a per-
-    operator barrier, the timely exchange pact's role. Emits the merged
-    local + received entries, which the downstream operator (optionally
-    thread-sharded on top) then owns exclusively: every key lives on
-    exactly one process.
+    The wave's batch partitions by the operator's shard key across
+    processes (bucket p goes to process p over the TCP mesh); the
+    downstream operator (optionally thread-sharded on top) owns its
+    shard exclusively: every key lives on exactly one process.
+
+    Two delivery protocols share the split logic:
+
+      * frontier mode (default, ``Runtime.run_mesh``): ``finish_time``
+        only SENDS — buckets cross the wire tagged with their
+        timestamp, and the receiving pump injects them below the peer's
+        replica of this node (``inject_remote``) once its input
+        frontier passes that time. No blocking, no per-wave barrier: a
+        slow peer delays only the operators consuming its wire.
+      * lockstep BSP (deprecated fallback, ``run_lockstep``): the node
+        BLOCKS until every peer's bucket for this (node, round)
+        arrives — the old global wave barrier.
 
     `route` maps (key, row) -> shard token; None routes everything to
     process 0 (operators with global state: buffers, gradual broadcast,
@@ -406,6 +414,9 @@ class ProcessExchangeNode(Node):
         # process-wide mesh — the lowering allocates it
         self.wire_id = wire_id
         self.round = 0
+        # frontier protocol switches (set by Runtime.run_mesh)
+        self.frontier_mode = False
+        self.end_barrier = False
 
     def persist_signature(self) -> str:
         return f"ProcessExchange/{self.mesh.n}/{int(self.route is None)}"
@@ -424,10 +435,10 @@ class ProcessExchangeNode(Node):
             return None
         return [batch.select(shards == p) for p in range(n)]
 
-    def finish_time(self, time: int) -> None:
-        batches, entries = self.take_segments()
+    def _split_wave(self, batches, entries):
+        """Partition one drained wave into per-process (entry, native)
+        buckets along the operator's shard key."""
         n = self.mesh.n
-        me = self.mesh.process_id
         buckets: list[list[Entry]] = [[] for _ in range(n)]
         nb_buckets: list[list] = [[] for _ in range(n)]
         for b in batches:
@@ -451,15 +462,61 @@ class ProcessExchangeNode(Node):
                 except Exception:  # noqa: BLE001 — owner re-evaluates + logs
                     p = 0
                 buckets[p].append(entry)
+        return buckets, nb_buckets
+
+    def inject_remote(self, time: int, payload: Any) -> None:
+        """Deliver a peer's bucket below this node (frontier mode): the
+        pump calls this once the wire's watermark admits `time`."""
+        if isinstance(payload, tuple):
+            ents, wires = payload
+            if wires:
+                from pathway_tpu.engine.native import dataplane as dp
+
+                for w in wires:
+                    self.emit(time, dp.NativeBatch.from_wire(w))
+            if ents:
+                self.emit(time, ents)
+        elif payload:  # legacy plain-entry frame
+            self.emit(time, payload)
+
+    def finish_time(self, time: int) -> None:
+        batches, entries = self.take_segments()
+        if self.frontier_mode and not self.end_barrier:
+            # frontier protocol: no blocking. Peer buckets cross the
+            # mesh tagged with their time and are injected below the
+            # peer's replica of this node once its operators' frontiers
+            # admit them; the local bucket emits downstream directly —
+            # the per-node scheduler stashes it at any operator whose
+            # frontier (which includes this wire's peers) still lags.
+            if not batches and not entries:
+                return
+            buckets, nb_buckets = self._split_wave(batches, entries)
+            me = self.mesh.process_id
+            for p in self.mesh.peers:
+                if buckets[p] or nb_buckets[p]:
+                    wires = [b.to_wire() for b in nb_buckets[p]]
+                    self.mesh.send_bucket(
+                        p, self.wire_id, time, (buckets[p], wires)
+                    )
+            for b in nb_buckets[me]:
+                self.emit(time, b)
+            if buckets[me]:
+                self.emit(time, buckets[me])
+            return
+        buckets, nb_buckets = self._split_wave(batches, entries)
+        me = self.mesh.process_id
+        # end barrier (frontier mode) reuses the blocking exchange once,
+        # at the negotiated end time every process steps together
+        rnd = ("end", time) if self.end_barrier else self.round
         for p in self.mesh.peers:
             wires = [b.to_wire() for b in nb_buckets[p]]
             self.mesh.send_bucket(
-                p, self.wire_id, self.round, (buckets[p], wires)
+                p, self.wire_id, rnd, (buckets[p], wires)
             )
         merged = list(buckets[me])
         local_batches = list(nb_buckets[me])
         for p in self.mesh.peers:
-            payload = self.mesh.recv_bucket(p, self.wire_id, self.round)
+            payload = self.mesh.recv_bucket(p, self.wire_id, rnd)
             if isinstance(payload, tuple):
                 ents, wires = payload
                 merged.extend(ents)

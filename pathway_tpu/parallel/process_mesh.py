@@ -66,6 +66,17 @@ class ProcessMesh:
         self._data: dict[tuple[int, int, int], list] = {}  # (node, round, proc)
         self._ctl: dict[tuple[int, int], tuple[bool, bool, int]] = {}  # (round, proc)
         self._nego: dict[tuple[str, int], Any] = {}  # (tag, proc) -> value
+        # frontier-mode state (engine/runtime.py run_mesh):
+        #   _inbox  — arrival-ordered (wire, time, peer) keys of buckets
+        #             awaiting the pump (payloads stay in _data);
+        #   _wm     — (wire, peer) -> that peer's announced watermark for
+        #             the wire: nothing at or below it will arrive again;
+        #   _flags  — (tag, peer) -> small monotone control values
+        #             (fence numbers, done markers).
+        self.frontier_inbox = False
+        self._inbox: list[tuple[int, int, int]] = []
+        self._wm: dict[tuple[int, int], Any] = {}
+        self._flags: dict[tuple[Any, int], Any] = {}
         self._dead: set[int] = set()
         self._closed = False
         self._listener = socket.socket()
@@ -139,9 +150,22 @@ class ProcessMesh:
                     if kind == "data":
                         node_id, rnd, entries = payload
                         self._data[(node_id, rnd, peer)] = entries
+                        if self.frontier_inbox:
+                            self._inbox.append((node_id, rnd, peer))
                     elif kind == "nego":
                         tag, value = payload
                         self._nego[(tag, peer)] = value
+                    elif kind == "wm":
+                        wire, value = payload
+                        key = (wire, peer)
+                        if value > self._wm.get(key, -1):
+                            self._wm[key] = value
+                    elif kind == "flag":
+                        tag, value = payload
+                        key = (tag, peer)
+                        old = self._flags.get(key)
+                        if old is None or value > old:
+                            self._flags[key] = value
                     else:  # ctl
                         rnd, has_data, done, t_hint = payload
                         self._ctl[(rnd, peer)] = (has_data, done, t_hint)
@@ -236,6 +260,82 @@ class ProcessMesh:
                     self._cv.wait(60.0)
                 out[p] = self._nego.pop((tag, p))
         return out
+
+    # ------------------------------------------------- frontier protocol
+
+    def enable_frontier_inbox(self) -> None:
+        """Start routing data frames to the inbox. Buckets that arrived
+        BEFORE the flag flipped (a peer's pump can outrun this one's
+        startup) are swept in, so nothing sent early is lost."""
+        with self._cv:
+            if not self.frontier_inbox:
+                self.frontier_inbox = True
+                pending = set(self._inbox)
+                self._inbox.extend(
+                    k for k in self._data if k not in pending
+                )
+
+    def take_frontier_updates(self):
+        """Atomically snapshot peer watermarks and drain the data inbox.
+
+        The watermark view is captured in the same critical section as
+        the inbox drain: because each peer's frames arrive in send order
+        and are stored under this lock, any watermark visible in the
+        snapshot has every bucket it covers already drained here — the
+        pump can trust the announcement."""
+        with self._cv:
+            wm = dict(self._wm)
+            keys, self._inbox = self._inbox, []
+            buckets = [
+                (wire, t, peer, self._data.pop((wire, t, peer)))
+                for (wire, t, peer) in keys
+                if (wire, t, peer) in self._data
+            ]
+        return wm, buckets
+
+    def restore_bucket(self, wire: int, rnd: Any, peer: int, payload: Any) -> None:
+        """Put a drained bucket back for keyed retrieval (a peer that
+        reached the end barrier first tags buckets with ('end', t);
+        they belong to recv_bucket, not the frontier pump)."""
+        with self._cv:
+            self._data[(wire, rnd, peer)] = payload
+            self._cv.notify_all()
+
+    def send_wm(self, wire: int, value: Any) -> None:
+        """Announce this process's watermark for an outgoing wire."""
+        for p in self.peers:
+            self._send(p, "wm", (wire, value))
+
+    def send_flag(self, tag: Any, value: Any) -> None:
+        """Broadcast a small monotone control value (fence/done)."""
+        for p in self.peers:
+            self._send(p, "flag", (tag, value))
+
+    def set_flag(self, tag: Any, value: Any) -> None:
+        """Record this process's own flag (so flag_value sees it too)."""
+        with self._cv:
+            key = (tag, self.process_id)
+            old = self._flags.get(key)
+            if old is None or value > old:
+                self._flags[key] = value
+
+    def flag_of(self, tag: Any, peer: int, default: Any = None) -> Any:
+        with self._cv:
+            return self._flags.get((tag, peer), default)
+
+    def flag_value(self, tag: Any, default: Any = None) -> Any:
+        """Max of the flag across every process that has set it."""
+        with self._cv:
+            vals = [
+                v for (t, _p), v in self._flags.items() if t == tag
+            ]
+        return max(vals) if vals else default
+
+    def wait_frames(self, timeout: float) -> None:
+        """Sleep until a new frame arrives (or the timeout elapses) —
+        the frontier pump's idle wait, so remote progress wakes it."""
+        with self._cv:
+            self._cv.wait(timeout)
 
     def close(self) -> None:
         self._closed = True

@@ -1064,10 +1064,22 @@ def attach_persistence(session: Any, config: Config) -> None:
                 return self.inner.done and not self._held
             return self.inner.done
 
+    fresh_start = manager.metadata.load() is None and all(
+        manager.journal.total_events(c.name) == 0 for c in session.connectors
+    )
     session.connectors = [
         PersistentConnector(c, c.name) for c in session.connectors
     ]
     session.checkpointer = manager
+    if fresh_start:
+        # bootstrap commit: a fresh run records epoch 1 (empty operator
+        # state, zero offsets) BEFORE any event flows, so a crash at any
+        # point leaves a committed metadata record to resume from — the
+        # reference likewise initializes its metadata storage at startup
+        # (state.rs MetadataAccessor::new). Only safe on a fresh start:
+        # with a journal tail pending replay, the writers' offsets would
+        # overstate what the (restored) operator state has consumed.
+        manager.checkpoint(0)
 
 
 # Backwards-compatible alias used by earlier tests/tools.

@@ -4,8 +4,12 @@
 Leg 1 (native): the default token-plane engine (C dataplane + numpy waves).
 Leg 2 (object): PATHWAY_TPU_NATIVE=0 — pure-Python object rows; tests that
 assert native-plane internals skip themselves via `dataplane.available()`.
+Leg 3 (workers-1x4): the worker-count invariance suite under BOTH
+PATHWAY_THREADS=1 and =4 in the same leg — sharded-operator exchange and
+the frontier scheduler's out-of-order firing must keep results
+worker-count invariant (pins frontier-reordering regressions).
 
-Writes TESTLEGS.json at the repo root: the artifact proving both legs ran
+Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
 a real, runnable thing, not a docstring claim).
 
@@ -23,13 +27,24 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# the worker-count invariance surface: sharded-state pipelines, the
+# frontier scheduler, and rescale (state re-partitioning across counts)
+INVARIANCE_PATHS = [
+    "tests/test_workers.py",
+    "tests/test_frontier.py",
+    "tests/test_rescale.py",
+    "tests/test_tok_tail.py",
+]
 
-def run_leg(name: str, env_extra: dict, extra: list[str]) -> dict:
+
+def run_leg(
+    name: str, env_extra: dict, extra: list[str], paths: list[str] | None = None
+) -> dict:
     env = dict(os.environ)
     env.update(env_extra)
     t0 = time.time()
     r = subprocess.run(
-        [sys.executable, "-m", "pytest", "tests/", "-q", *extra],
+        [sys.executable, "-m", "pytest", *(paths or ["tests/"]), "-q", *extra],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=3600,
     )
     tail = (r.stdout.strip().splitlines() or [""])[-1]
@@ -54,6 +69,13 @@ def main() -> int:
     legs = [
         run_leg("native", {}, extra),
         run_leg("object", {"PATHWAY_TPU_NATIVE": "0"}, extra),
+        # worker-count invariance at BOTH default thread counts in one
+        # leg: the suites flip PATHWAY_THREADS per pipeline internally,
+        # and the session default is ALSO varied so every other node in
+        # those files builds sharded vs unsharded — frontier reordering
+        # must not leak into results either way
+        run_leg("workers-t1", {"PATHWAY_THREADS": "1"}, extra, INVARIANCE_PATHS),
+        run_leg("workers-t4", {"PATHWAY_THREADS": "4"}, extra, INVARIANCE_PATHS),
     ]
     ok = all(l["rc"] == 0 and l["failed"] == 0 and l["passed"] > 0 for l in legs)
     dirty = bool(

@@ -1,0 +1,327 @@
+"""Frontier-based progress tracking (engine/frontier.py).
+
+Pins the semantics that replaced the global BSP wave barrier:
+
+  * reachability: every node knows exactly which sources gate it,
+    including the implicit iterate/transformer output edges;
+  * out-of-order ACROSS operators: a branch over settled sources
+    processes newer timestamps while a sibling branch's source lags;
+  * in-order AT each operator: stashed waves replay in timestamp order
+    the moment the operator's frontier catches up, and a merge point
+    (concat/join) never runs a timestamp its slow input could still
+    contribute to;
+  * straggler isolation end-to-end: one delayed source does not stall
+    causally-independent branches of a live pw pipeline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.core import (
+    CaptureNode,
+    ConcatNode,
+    Graph,
+    InputNode,
+    StatelessNode,
+)
+from pathway_tpu.engine.frontier import (
+    DONE,
+    FrontierScheduler,
+    ReachabilityIndex,
+)
+from pathway_tpu.internals.keys import key_for_values
+
+
+def _entry(i: int):
+    return (key_for_values(i), (i,), 1)
+
+
+def _ident(entries, _time):
+    return entries
+
+
+def _two_branch_graph():
+    """a -> ma -> cap_a ;  b -> mb -> cap_b ;  concat(ma, mb) -> cap_j."""
+    g = Graph()
+    a, b = InputNode(g), InputNode(g)
+    ma = StatelessNode(g, a, _ident)
+    mb = StatelessNode(g, b, _ident)
+    cap_a = CaptureNode(g, ma)
+    cap_b = CaptureNode(g, mb)
+    j = ConcatNode(g, [ma, mb])
+    cap_j = CaptureNode(g, j)
+    return g, a, b, ma, mb, cap_a, cap_b, j, cap_j
+
+
+def test_reachability_upstream_sets_and_orphans():
+    g, a, b, ma, mb, cap_a, cap_b, j, cap_j = _two_branch_graph()
+    orphan = InputNode(g)  # never registered: must auto-close
+    reach = ReachabilityIndex(g)
+    assert reach.cone(a.node_id) == {
+        a.node_id, ma.node_id, cap_a.node_id, j.node_id, cap_j.node_id
+    }
+    assert cap_b.node_id not in reach.cone(a.node_id)
+    assert orphan.node_id in reach.orphan_inputs()
+
+    sched = FrontierScheduler(g)
+    sa, sb = sched.add_source(a), sched.add_source(b)
+    sched.seal()
+    # the orphan auto-closed: frontiers that merge it read DONE, and the
+    # two-source nodes are gated by exactly their sources
+    assert sched.frontier_of_node(orphan) == DONE
+    assert sched.frontier_of_node(cap_a) == 0
+    sched.advance(sa, 10)
+    assert sched.frontier_of_node(cap_a) == 10
+    assert sched.frontier_of_node(cap_j) == 0  # still gated by b
+    sched.advance(sb, 4)
+    assert sched.frontier_of_node(cap_j) == 4
+
+
+def test_out_of_order_across_operators_in_order_at_each():
+    g, a, b, ma, mb, cap_a, cap_b, j, cap_j = _two_branch_graph()
+    sched = FrontierScheduler(g)
+    sa, sb = sched.add_source(a), sched.add_source(b)
+
+    # b is the straggler: its wave for t=2 exists but nothing newer is
+    # promised; a has settled through t=6
+    sched.stage(sa, 4, [_entry(40)])
+    sched.stage(sa, 6, [_entry(60)])
+    sched.stage(sb, 2, [_entry(20)])
+    sched.advance(sa, 6)
+    sched.pump()
+
+    # a's private branch ran ahead to t=6 while the merge point only
+    # consumed what both inputs had settled: b's t=2 wave fired (its
+    # own watermark admits it), but the a-waves at t=4/6 are parked AT
+    # the concat until b's frontier passes them
+    assert sched.completed_through[cap_a.node_id] == 6
+    assert sched.completed_through[cap_b.node_id] == 2
+    assert sched.completed_through[cap_j.node_id] == 2
+    assert [t for (t, _k, _r, _d) in cap_a.stream] == [4, 6]
+    assert [t for (t, _k, _r, _d) in cap_j.stream] == [2]
+
+    # the straggler catches up: parked waves replay in timestamp order
+    sched.stage(sb, 6, [_entry(21)])
+    sched.advance(sb, 6)
+    sched.pump()
+    assert sched.completed_through[cap_j.node_id] == 6
+    times_j = [t for (t, _k, _r, _d) in cap_j.stream]
+    assert times_j == sorted(times_j) == [2, 4, 6, 6]
+    # every row arrived exactly once
+    assert len(cap_j.state.rows) == 4
+
+
+def test_per_operator_watermarks_track_min_over_sources():
+    g, a, b, ma, mb, cap_a, cap_b, j, cap_j = _two_branch_graph()
+    sched = FrontierScheduler(g)
+    sa, sb = sched.add_source(a), sched.add_source(b)
+    sched.advance(sa, 8)
+    sched.advance(sb, 2)
+    assert sched.frontier_of_node(ma) == 8
+    assert sched.frontier_of_node(mb) == 2
+    assert sched.frontier_of_node(j) == 2
+    # an in-flight wave bounds the frontier below its timestamp even
+    # when the watermark is past it
+    sched.stage(sa, 4, [_entry(1)])
+    assert sched.frontier_of_node(j) == 2
+    assert sched.frontier_of_node(cap_a) == 3
+    sched.pump()
+    assert sched.frontier_of_node(cap_a) == 8
+    # closing a source empties its frontier contribution; the wave
+    # parked at the merge point delivers, then the bound lifts
+    sched.close(sb)
+    assert sched.frontier_of_node(j) == 3  # parked wave still in flight
+    sched.pump()
+    assert sched.frontier_of_node(j) == 8
+    sched.close(sa)
+    assert sched.frontier_of_node(j) == DONE
+    assert sched.global_frontier() == DONE
+    assert sched.fully_drained()
+
+
+def test_blocked_wave_does_not_lose_or_duplicate_rows():
+    """Waves parked at a blocked operator replay exactly once."""
+    g = Graph()
+    a, b = InputNode(g), InputNode(g)
+    j = ConcatNode(g, [a, b])
+    cap = CaptureNode(g, j)
+    sched = FrontierScheduler(g)
+    sa, sb = sched.add_source(a), sched.add_source(b)
+    for t in (2, 4, 6):
+        sched.stage(sa, t, [_entry(t)])
+    sched.advance(sa, 6)
+    sched.pump()
+    assert cap.stream == []  # everything parked at the concat
+    sched.advance(sb, DONE)
+    sched.pump()
+    assert [t for (t, _k, _r, _d) in cap.stream] == [2, 4, 6]
+    assert len(cap.state.rows) == 3
+
+
+def test_streaming_straggler_isolated_between_branches():
+    """Live pw pipeline, two python connectors: the slow source's
+    branch lags; the fast branch's outputs all arrive without waiting
+    for it (frontier semantics end-to-end through Runtime.run)."""
+    from pathway_tpu.io.python import ConnectorSubject
+
+    N_FAST = 40
+    arrivals: dict[str, list[float]] = {"fast": [], "slow": []}
+    lock = threading.Lock()
+
+    class Fast(ConnectorSubject):
+        def run(self):
+            for i in range(N_FAST):
+                self.next(k=f"f{i}")
+                _time.sleep(0.001)
+
+    class Slow(ConnectorSubject):
+        def run(self):
+            for i in range(4):
+                _time.sleep(0.05)  # 50 ms injected per-wave latency
+                self.next(k=f"s{i}")
+
+    fast = pw.io.python.read(
+        Fast(), schema=pw.schema_from_types(k=str), name="fast"
+    )
+    slow = pw.io.python.read(
+        Slow(), schema=pw.schema_from_types(k=str), name="slow"
+    )
+
+    def track(which):
+        def on_change(key, row, time, is_addition):
+            with lock:
+                arrivals[which].append(_time.perf_counter())
+        return on_change
+
+    pw.io.subscribe(
+        fast.groupby(fast.k).reduce(fast.k, n=pw.reducers.count()),
+        on_change=track("fast"),
+    )
+    pw.io.subscribe(
+        slow.groupby(slow.k).reduce(slow.k, n=pw.reducers.count()),
+        on_change=track("slow"),
+    )
+    pw.run()
+    assert len(arrivals["fast"]) == N_FAST
+    assert len(arrivals["slow"]) == 4
+    # the fast branch finished all its rows BEFORE the slow branch's
+    # last row: under a global wave barrier keyed to the slow source
+    # this ordering would be impossible
+    assert max(arrivals["fast"]) < max(arrivals["slow"])
+
+
+def test_streaming_temporal_buffer_terminates():
+    """Regression: a BufferNode holding a postponed row must not hang
+    the frontier pump — its `pending` attribute is operator STATE, not
+    an InputNode push inbox, and the scheduler must never stash it."""
+    from pathway_tpu.io.python import ConnectorSubject
+
+    class Src(ConnectorSubject):
+        def run(self):
+            for i in range(6):
+                self.next(t=i, v=i)
+                _time.sleep(0.002)
+
+    t = pw.io.python.read(
+        Src(), schema=pw.schema_from_types(t=int, v=int), name="src"
+    )
+    # exactly-once windowing lowers to a BufferNode: the last window
+    # stays postponed until end-of-stream flush
+    win = pw.temporal.windowby(
+        t, t.t,
+        window=pw.temporal.tumbling(duration=4),
+        behavior=pw.temporal.exactly_once_behavior(),
+    )
+    res = win.reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    got = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            got[row["start"]] = row["n"]
+
+    pw.io.subscribe(res, on_change=on_change)
+    pw.run()  # must terminate (pre-fix: infinite pump loop)
+    assert got == {0: 4, 4: 2}, got
+
+
+def test_iterate_scope_frontier_coordinates():
+    """The iterate sub-scope frontier tracks what actually happened:
+    outer times released into the body and the inner round watermark."""
+    from pathway_tpu.engine.runtime import IterateNode
+
+    t = pw.debug.table_from_markdown(
+        """
+        v | __time__
+        1 | 2
+        5 | 4
+        """
+    )
+
+    def body(t):
+        return {
+            "t": t.select(v=pw.if_else(t.v > 3, t.v - 1, t.v))
+        }
+
+    res = pw.iterate(body, t=t)
+    from pathway_tpu.internals.lowering import Session
+
+    session = Session()
+    cap = session.capture(res)
+    session.execute()
+    assert sorted(r[0] for r in cap.state.rows.values()) == [1, 3]
+    it_nodes = [
+        n for n in session.graph.nodes if isinstance(n, IterateNode)
+    ]
+    assert len(it_nodes) == 1
+    scope = it_nodes[0].scope
+    assert scope.quiescent  # fixpoint reached, capability dropped
+    assert scope.released_through >= 4  # both outer times entered
+    assert scope.inner == it_nodes[0].inner_t  # round watermark current
+    assert scope.inner > 0
+
+
+def test_streaming_per_source_waves_merge_exactly():
+    """Two live sources merging into one groupby: frontier scheduling
+    delivers exact counts (nothing dropped at the merge point)."""
+    from pathway_tpu.io.python import ConnectorSubject
+
+    class Src(ConnectorSubject):
+        def __init__(self, lo, hi, delay):
+            self.lo, self.hi, self.delay = lo, hi, delay
+
+        def run(self):
+            for i in range(self.lo, self.hi):
+                self.next(g=f"g{i % 3}", v=i)
+                _time.sleep(self.delay)
+
+    a = pw.io.python.read(
+        Src(0, 30, 0.001), schema=pw.schema_from_types(g=str, v=int), name="a"
+    )
+    b = pw.io.python.read(
+        Src(30, 45, 0.004), schema=pw.schema_from_types(g=str, v=int), name="b"
+    )
+    t = a.concat_reindex(b)
+    agg = t.groupby(t.g).reduce(
+        t.g, total=pw.reducers.sum(t.v), n=pw.reducers.count()
+    )
+    rows = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            rows[row["g"]] = (row["total"], row["n"])
+        elif rows.get(row["g"]) == (row["total"], row["n"]):
+            del rows[row["g"]]
+
+    pw.io.subscribe(agg, on_change=on_change)
+    pw.run()
+    expected = {}
+    for i in range(45):
+        g = f"g{i % 3}"
+        tot, n = expected.get(g, (0, 0))
+        expected[g] = (tot + i, n + 1)
+    assert rows == expected

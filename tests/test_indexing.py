@@ -420,4 +420,6 @@ def test_sort_bulk_load_not_quadratic():
     g.step(2)
     el = _time.perf_counter() - t0
     assert node.rows_out == n
-    assert el < 2.0, f"descending bulk load took {el:.2f}s"
+    # the quadratic path takes minutes at this size; the bound only needs
+    # to separate O(n log n) from O(n^2), with headroom for loaded CI
+    assert el < 8.0, f"descending bulk load took {el:.2f}s"

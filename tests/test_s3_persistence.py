@@ -45,8 +45,12 @@ SCRIPT = textwrap.dedent(
             fake = os.environ["PATHWAY_S3_FAKE_DIR"]
             deadline = time.time() + 30
             while time.time() < deadline:
-                # wait for a metadata.json OBJECT in the bucket
-                if any("metadata.json" in f for f in os.listdir(fake)):
+                # wait for a metadata.json OBJECT in the bucket (the
+                # bucket dir itself appears only once the backend
+                # constructs — don't die racing its creation)
+                if os.path.isdir(fake) and any(
+                    "metadata.json" in f for f in os.listdir(fake)
+                ):
                     os._exit(17)
                 time.sleep(0.01)
             os._exit(3)
