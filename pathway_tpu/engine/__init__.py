@@ -16,6 +16,11 @@ a DBSP-style minimal core:
 - numeric columns batch onto the XLA plane (engine/vectorize.py), hot
   index/sort/join inner loops go through the C++ kernel
   (pathway_tpu/native) when available;
+- device dispatches of the serving stages (embed/generate/KNN, batched
+  UDFs) route through the device plane (engine/device_plane.py):
+  shape-bucketed batch coalescing, double-buffered host->device
+  staging, frontier-driven stage overlap, donated persistent buffers
+  (docs/serving.md);
 - multi-chip scale-out shards every arrangement by the 128-bit row key;
   the exchange of numeric payloads is an ICI all_to_all
   (pathway_tpu/parallel/exchange.py), host control plane carries the

@@ -1,0 +1,416 @@
+"""Device-dispatch plane: bucketed batch coalescing, double-buffered
+host->device staging, and persistent donated buffers for the serving path.
+
+Every XLA-backed serving operator (the JaxEmbedder encoder, the JaxLMChat
+decoder, the KNN slab mirror, batched ``@pw.udf`` functions) routes its
+dispatches through one process-wide :class:`DevicePlane`. The plane owns
+four concerns the operators used to improvise separately:
+
+* **Shape-bucketed coalescing** — live-data waves are ragged; padding
+  every batch up to a power-of-two bucket (rows and sequence length)
+  means the jit cache sees a bounded set of shapes however the stream
+  arrives. :class:`BucketPolicy` is the single rounding rule, and every
+  :class:`DeviceProgram` records compilations per bucket so tests can
+  assert "N ragged waves inside one bucket = exactly one compile".
+
+* **Double-buffered staging** — dispatches run on a small pool of
+  dispatch threads, so the host-side prep of wave *t+1* (tokenization,
+  padding, ``device_put``) overlaps the device compute of wave *t*:
+  while one thread blocks on the device result, another is already
+  staging the next wave. ``stage()`` exposes the staging executor for
+  callers that want the prep/compute split explicit (bench loops).
+
+* **Frontier-driven stage coalescing** — :class:`WaveCoalescer` gathers
+  every concurrently in-flight request (the engine's async-apply
+  operator admits whole waves at once; under stage overlap, several
+  waves) and flushes them as one padded dispatch, off the event loop,
+  so a long generate never blocks the embed of a later wave.
+
+* **Donated persistent buffers** — ``lease()``/``restore()`` keep
+  big per-shape device buffers (the decoder's KV cache, the KNN doc
+  slab) alive across dispatches; programs registered with
+  ``donate_argnums`` hand the buffer back to XLA so the allocation is
+  reused in place instead of re-created per call.
+
+Everything here is backend-agnostic: on CPU the same code runs (donation
+is a no-op), which is what lets the compile-count regression guard run
+in tier-1 without TPU hardware.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable
+
+__all__ = [
+    "BucketPolicy",
+    "DeviceProgram",
+    "DevicePlane",
+    "WaveCoalescer",
+    "get_device_plane",
+]
+
+
+class BucketPolicy:
+    """The single shape-rounding rule of the serving path.
+
+    Rows round up to a power of two between ``min_rows`` and
+    ``max_rows``; sequence lengths round up to a power of two between
+    ``min_seq`` and the caller's cap (the model context). Distinct live
+    batch sizes therefore hit at most ``log2(max/min)`` jit entries per
+    program instead of one per size.
+    """
+
+    def __init__(self, min_rows: int = 8, max_rows: int = 4096, min_seq: int = 16):
+        if min_rows < 1 or max_rows < min_rows:
+            raise ValueError(f"bad row bucket range [{min_rows}, {max_rows}]")
+        self.min_rows = min_rows
+        self.max_rows = max_rows
+        self.min_seq = min_seq
+
+    @staticmethod
+    def _round_up(n: int, lo: int, hi: int) -> int:
+        b = lo
+        while b < n:
+            b *= 2
+        return min(b, hi)
+
+    def rows_bucket(self, n: int) -> int:
+        """Padded row count for a batch of n rows (n may exceed
+        max_rows; the caller splits such batches before padding)."""
+        if n > self.max_rows:
+            raise ValueError(
+                f"batch of {n} rows exceeds the {self.max_rows}-row bucket "
+                "cap; split before padding"
+            )
+        return self._round_up(max(n, 1), self.min_rows, self.max_rows)
+
+    def seq_bucket(self, longest: int, cap: int) -> int:
+        """Padded sequence length for rows whose longest is `longest`,
+        bounded by the model cap."""
+        return self._round_up(max(longest, 1), self.min_seq, cap)
+
+
+class DeviceProgram:
+    """One jitted program plus its per-bucket compile ledger.
+
+    Wraps ``jax.jit(fn, ...)``; each call passes the bucket key it
+    padded to, and the ledger records how many XLA compilations that
+    (program, bucket) pair has cost — read straight off the jit cache
+    (``_cache_size``), with a shape-signature fallback on runtimes that
+    hide it. The invariant the tier-1 guard pins: streaming ragged
+    batches inside one bucket never grows the ledger past 1.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        fn: Callable,
+        *,
+        donate_argnums: tuple[int, ...] = (),
+        static_argnames: tuple[str, ...] = (),
+    ):
+        import jax
+
+        self.name = name
+        self.donate_argnums = tuple(donate_argnums)
+        kw: dict[str, Any] = {}
+        if donate_argnums:
+            kw["donate_argnums"] = self.donate_argnums
+        if static_argnames:
+            kw["static_argnames"] = tuple(static_argnames)
+        self._jit = jax.jit(fn, **kw)
+        self._lock = threading.Lock()
+        # bucket key -> compilations charged to it
+        self.compile_counts: dict[Any, int] = {}
+        self._seen_sigs: set[Any] = set()
+
+    def jit_cache_size(self) -> int | None:
+        """Entries in the underlying jit cache — XLA's own ledger. Tests
+        cross-check it against `total_compiles` (our per-bucket ledger);
+        None on runtimes that hide the private accessor."""
+        try:
+            return int(self._jit._cache_size())
+        except Exception:  # noqa: BLE001 — private accessor
+            return None
+
+    @staticmethod
+    def _signature(args: tuple, kwargs: dict) -> Any:
+        def leaf(x: Any) -> Any:
+            shape = getattr(x, "shape", None)
+            if shape is not None:
+                return (tuple(shape), str(getattr(x, "dtype", "?")))
+            return x
+
+        import jax
+
+        flat, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        return (treedef, tuple(leaf(x) for x in flat))
+
+    def __call__(self, *args: Any, bucket: Any = None, **kwargs: Any) -> Any:
+        # bookkeeping only under the lock; the dispatch itself runs
+        # outside it so overlapping stages never serialize here
+        sig = self._signature(args, kwargs)
+        with self._lock:
+            if sig not in self._seen_sigs:
+                self._seen_sigs.add(sig)
+                self.compile_counts[bucket] = (
+                    self.compile_counts.get(bucket, 0) + 1
+                )
+        return self._jit(*args, **kwargs)
+
+    @property
+    def total_compiles(self) -> int:
+        return sum(self.compile_counts.values())
+
+
+class WaveCoalescer:
+    """Coalesces concurrently in-flight requests into one padded dispatch.
+
+    The engine's async-apply operator starts every row coroutine of a
+    wave before awaiting any (``asyncio.gather``), so each ``submit``
+    lands here and the flush scheduled behind them sees the whole wave —
+    and, under frontier stage overlap, rows of *several* admitted waves
+    at once. The flush itself runs on the plane's dispatch pool (never
+    on the event loop): a slow generate flush cannot stall the embed
+    coalescer of a later wave, which is what lets causally-independent
+    stages pipeline through the scheduler.
+
+    ``flush_fn(items) -> list[results]`` must return exactly
+    ``len(items)`` results in order.
+    """
+
+    def __init__(
+        self,
+        flush_fn: Callable[[list], list],
+        max_batch: int = 4096,
+        pool: ThreadPoolExecutor | None = None,
+    ):
+        self.flush_fn = flush_fn
+        self.max_batch = max_batch
+        self._pool = pool
+        self.pending: list[tuple[Any, Any]] = []  # (item, asyncio.Future)
+        self._scheduled = False
+        self.flushes = 0  # dispatch count (tests: coalescing actually happened)
+
+    async def submit(self, item: Any) -> Any:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        fut: Any = loop.create_future()
+        self.pending.append((item, fut))
+        if not self._scheduled:
+            self._scheduled = True
+            loop.call_soon(self._flush_cb, loop)
+        return await fut
+
+    # Called on the event loop. Splits pending into max_batch chunks and
+    # hands each to the dispatch pool; results resolve the row futures
+    # back on the loop. Without a pool (tests, teardown) the flush runs
+    # inline — same results, no overlap.
+    def _flush_cb(self, loop: Any) -> None:
+        self._scheduled = False
+        while self.pending:
+            batch, self.pending = (
+                self.pending[: self.max_batch],
+                self.pending[self.max_batch:],
+            )
+            items = [it for it, _f in batch]
+            futs = [f for _it, f in batch]
+            self.flushes += 1
+            if self._pool is None:
+                self._resolve(futs, *self._run(items))
+            else:
+                task = self._pool.submit(self._run, items)
+                task.add_done_callback(
+                    lambda t, futs=futs: loop.call_soon_threadsafe(
+                        self._resolve, futs, *t.result()
+                    )
+                )
+
+    def _run(self, items: list) -> tuple[list | None, Exception | None]:
+        try:
+            return self.flush_fn(items), None
+        except Exception as e:  # noqa: BLE001 — delivered per-row below
+            return None, e
+
+    @staticmethod
+    def _resolve(futs: list, values: list | None, err: Exception | None) -> None:
+        if err is None and (values is None or len(values) != len(futs)):
+            err = RuntimeError(
+                f"coalesced flush returned {0 if values is None else len(values)}"
+                f" results for {len(futs)} items"
+            )
+        for i, f in enumerate(futs):
+            if f.done():
+                continue
+            if err is not None:
+                f.set_exception(err)
+            else:
+                f.set_result(values[i])
+
+
+class DevicePlane:
+    """Process-wide device-dispatch plane (see module docstring)."""
+
+    def __init__(self, bucket_policy: BucketPolicy | None = None):
+        self.buckets = bucket_policy or BucketPolicy()
+        self.programs: dict[str, DeviceProgram] = {}
+        self._leases: dict[Any, list] = {}  # key -> pooled buffers
+        self._name_seq = 0
+        self._lock = threading.Lock()
+        self._dispatch_pool: ThreadPoolExecutor | None = None
+        self._staging_pool: ThreadPoolExecutor | None = None
+
+    # ----------------------------------------------------------- executors
+
+    @property
+    def dispatch_pool(self) -> ThreadPoolExecutor:
+        """Pool the coalescers flush on. More than one worker on purpose:
+        stage overlap needs a generate dispatch blocked on the device to
+        coexist with an embed dispatch staging its inputs."""
+        with self._lock:
+            if self._dispatch_pool is None:
+                self._dispatch_pool = ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="pw-device-dispatch"
+                )
+            return self._dispatch_pool
+
+    @property
+    def staging_pool(self) -> ThreadPoolExecutor:
+        """Single staging thread: host-side prep (tokenize/pad/device_put)
+        runs here IN ORDER while the caller's current dispatch computes —
+        the classic two-slot host->device double buffer."""
+        with self._lock:
+            if self._staging_pool is None:
+                self._staging_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="pw-device-staging"
+                )
+            return self._staging_pool
+
+    def stage(self, prep_fn: Callable, *args: Any) -> Future:
+        """Run host-side prep on the staging thread; returns a Future.
+        Submit wave t+1's prep before blocking on wave t's result and the
+        two overlap."""
+        return self.staging_pool.submit(prep_fn, *args)
+
+    # ------------------------------------------------------------ programs
+
+    def program(
+        self,
+        name: str,
+        fn: Callable | None = None,
+        *,
+        donate_argnums: tuple[int, ...] = (),
+        static_argnames: tuple[str, ...] = (),
+    ) -> DeviceProgram:
+        """Register-or-get the named program. The first caller supplies
+        `fn`; later callers may omit it."""
+        with self._lock:
+            prog = self.programs.get(name)
+            if prog is None:
+                if fn is None:
+                    raise KeyError(f"no device program named {name!r}")
+                prog = DeviceProgram(
+                    name,
+                    fn,
+                    donate_argnums=donate_argnums,
+                    static_argnames=static_argnames,
+                )
+                self.programs[name] = prog
+            return prog
+
+    def compile_counts(self) -> dict[tuple[str, Any], int]:
+        """{(program_name, bucket): compilations} across the plane — the
+        observable the no-recompile regression guard asserts on."""
+        out: dict[tuple[str, Any], int] = {}
+        for name, prog in self.programs.items():
+            for bucket, n in prog.compile_counts.items():
+                out[(name, bucket)] = n
+        return out
+
+    def coalescer(
+        self, flush_fn: Callable[[list], list], max_batch: int = 4096,
+        *, inline: bool = False,
+    ) -> WaveCoalescer:
+        return WaveCoalescer(
+            flush_fn, max_batch=max_batch,
+            pool=None if inline else self.dispatch_pool,
+        )
+
+    def unique_name(self, prefix: str) -> str:
+        """Collision-proof program name for per-instance registrations
+        (id()-based names would be recycled by the allocator and hand a
+        new instance a dead instance's compiled program)."""
+        with self._lock:
+            self._name_seq += 1
+            return f"{prefix}#{self._name_seq}"
+
+    # -------------------------------------------------- persistent buffers
+    #
+    # Each key holds a POOL of buffers, not a single slot: concurrent
+    # flush chunks of one stage may overlap, and a single slot would make
+    # the loser allocate fresh every dispatch and silently drop one
+    # restored buffer. The pool depth is bounded by the stage's maximum
+    # dispatch concurrency.
+
+    def lease(self, key: Any, make: Callable[[], Any]) -> Any:
+        """Take a persistent buffer for `key`, creating one on first use
+        (or when every pooled buffer is currently leased). The caller
+        passes it to a donating program and MUST hand the program's
+        returned buffer back via :meth:`restore` — a leased buffer is
+        consumed by XLA."""
+        with self._lock:
+            pool = self._leases.get(key)
+            buf = pool.pop() if pool else None
+        if buf is None:
+            buf = make()
+        return buf
+
+    def restore(self, key: Any, buf: Any) -> None:
+        with self._lock:
+            self._leases.setdefault(key, []).append(buf)
+
+    def drop_lease(self, key: Any) -> None:
+        with self._lock:
+            self._leases.pop(key, None)
+
+    def drop_program(self, name: str) -> None:
+        """Release a per-instance program and every lease pool keyed to it
+        (lease keys embed the program name). Instances registered through
+        :meth:`unique_name` call this from a finalizer — without it the
+        process-global plane would pin dead instances' compiled executables
+        and device buffers for the life of the process."""
+        with self._lock:
+            self.programs.pop(name, None)
+            for key in [
+                k for k in self._leases
+                if isinstance(k, tuple) and name in k
+            ]:
+                del self._leases[key]
+
+    # -------------------------------------------------------- batch padding
+
+    def pad_rows(self, mats: list, n_rows: int) -> tuple[list, int]:
+        """Pad each 2-d numpy array in `mats` with zero rows up to the
+        row bucket for `n_rows`; returns (padded, bucket)."""
+        import numpy as np
+
+        bucket = self.buckets.rows_bucket(n_rows)
+        if bucket == n_rows:
+            return list(mats), bucket
+        out = [np.pad(m, ((0, bucket - n_rows), (0, 0))) for m in mats]
+        return out, bucket
+
+
+_plane: DevicePlane | None = None
+_plane_lock = threading.Lock()
+
+
+def get_device_plane() -> DevicePlane:
+    global _plane
+    with _plane_lock:
+        if _plane is None:
+            _plane = DevicePlane()
+        return _plane

@@ -208,6 +208,18 @@ class FrontierScheduler:
         # between expensive waves, so a grinding UDF never dams up the
         # causally-unrelated work (and watermarks) behind it
         self._cost_ns: dict[int, float] = {}
+        # pumps that poll for deferred completions (Runtime.run and the
+        # frontier static pump) opt in; the mesh pump keeps synchronous
+        # async-apply semantics for now (its quiescence barriers assume
+        # a drained scheduler between rounds)
+        self.allow_async = False
+        # stage overlap: (slot, t) -> done() for waves an operator has
+        # CONSUMED but whose emission is still computing off-thread (a
+        # deferred device dispatch). A hold gates downstream frontiers
+        # exactly like an in-flight notification — but not the holding
+        # operator's own later timestamps, which is what lets wave t+1
+        # stage while wave t computes (see docs/serving.md).
+        self._async_waves: dict[tuple[int, float], Callable[[], bool]] = {}
 
     # ------------------------------------------------------------- sources
 
@@ -328,10 +340,38 @@ class FrontierScheduler:
         for slot, times in self._pending.items():
             if times and nid in self._desc_of(slot):
                 f = min(f, min(times) - 1)
+        for (slot, t) in self._async_waves:
+            if nid in self._desc_of(slot):
+                f = min(f, t - 1)
         return f
 
+    # -------------------------------------------------- async stage overlap
+
+    def hold_async(
+        self, node: Any, time: float, done_fn: Callable[[], bool]
+    ) -> None:
+        """Register a deferred wave: `node` consumed its input for `time`
+        and will emit once `done_fn()` turns true. Downstream frontiers
+        stay below `time` until then; the node itself may keep firing
+        later timestamps (pipelining)."""
+        self._async_waves[(2 * node.node_id, time)] = done_fn
+
+    def has_async(self) -> bool:
+        return bool(self._async_waves)
+
+    def _poll_async(self) -> int:
+        """Convert completed deferred waves into notifications: the node
+        fires again at the held time to emit its results."""
+        converted = 0
+        for (slot, t), done in list(self._async_waves.items()):
+            if done():
+                del self._async_waves[(slot, t)]
+                self._pending.setdefault(slot, {}).setdefault(t, _Pend())
+                converted += 1
+        return converted
+
     def fully_drained(self) -> bool:
-        return not any(self._pending.values())
+        return not any(self._pending.values()) and not self._async_waves
 
     def global_frontier(self) -> float:
         """Min over every source watermark and in-flight notification —
@@ -342,6 +382,8 @@ class FrontierScheduler:
         for times in self._pending.values():
             if times:
                 f = min(f, min(times) - 1)
+        for (_slot, t) in self._async_waves:
+            f = min(f, t - 1)
         return f
 
     # -------------------------------------------------------------- firing
@@ -404,6 +446,17 @@ class FrontierScheduler:
                 # an earlier (or same-time upstream) in-flight wave can
                 # still emit into this operator: deliver it first
                 return False
+        for (oslot, ot) in self._async_waves:
+            if oslot == slot:
+                # the operator's own deferred wave never gates its later
+                # timestamps — consuming wave t+1 while t computes is the
+                # double buffer; emissions still land in time order via
+                # the per-timestamp stash
+                continue
+            if ot > t:
+                continue
+            if nid in self._desc_of(oslot) and (ot < t or slot // 2 != oslot // 2):
+                return False
         # own earlier timestamps fire first (per-operator time order)
         own = self._pending.get(slot)
         if own and min(own) < t:
@@ -448,6 +501,11 @@ class FrontierScheduler:
         self.seal()
         fired = 0
         while budget is None or fired < budget:
+            # deferred waves that finished computing become ordinary
+            # notifications (the operator fires again at the held time
+            # to emit); polled per pass, never waited on — the pump
+            # returns to its caller when only in-flight work remains
+            self._poll_async()
             # drain the whole CHEAP tier, then fire exactly one
             # expensive wave. Causal order is enforced by _admissible,
             # not by global firing order, so a straggler's backlog of

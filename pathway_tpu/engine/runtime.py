@@ -9,6 +9,7 @@ finalizes one timestamp per wave, and end-of-stream flush.
 from __future__ import annotations
 
 import asyncio
+import os
 import queue
 import threading
 import time as _time
@@ -240,6 +241,7 @@ class Runtime:
             self.graph.end(t)
             return
         sched = self._make_scheduler()
+        sched.allow_async = True  # deferred device waves pipeline here
         src = {c: sched.add_source(c.session.node) for c in self.connectors}
         kicks = self._kick_sources(sched)
         closed: set = set()
@@ -268,6 +270,12 @@ class Runtime:
                 self.checkpointer is not None
                 and self.checkpointer.due()
                 and (ckpt_dirty or self.checkpointer.frontier_advanced())
+                # never cut while a deferred device wave is in flight:
+                # its input offsets are consumed but its results exist
+                # only in the (non-persisted) in-flight future — a crash
+                # after this cut would drop the wave. Holds resolve
+                # within a dispatch, so the cut lands next cadence.
+                and not sched.has_async()
             ):
                 self.checkpointer.checkpoint(self.time)
                 ckpt_dirty = False
@@ -283,6 +291,9 @@ class Runtime:
                 if final:
                     sched.advance_local(self.time)
                     sched.pump()
+                # deferred device waves may still be computing: pump
+                # until every hold resolves before ending the stream
+                self._drain(sched, "streaming drain")
                 t = self.next_time()
                 self.graph.end(t)
                 if self.checkpointer is not None:
@@ -596,8 +607,13 @@ class Runtime:
         """Batch mode: feed pre-timed batches, run each wave, then end.
 
         `batches` are (time, node, entries); times must use the even-ms
-        domain. All nodes step at every distinct time in order.
+        domain. Pipelines with deferrable device stages (async-apply
+        under stage overlap) run through the frontier scheduler so waves
+        at distinct timestamps pipeline across operators; everything
+        else keeps the exact deterministic lockstep pump.
         """
+        if self._wants_stage_overlap():
+            return self._run_static_frontier(batches)
         by_time: dict[int, list[tuple[InputNode, list[Entry]]]] = {}
         for t, node, entries in batches:
             by_time.setdefault(t, []).append((node, entries))
@@ -608,6 +624,93 @@ class Runtime:
             self.graph.step(t)
             last_t = t
         self.graph.end(last_t + 2)
+
+    # the longest a single deferred device wave may reasonably take
+    # (a cold 2B-decoder compile on a tunneled chip is minutes); past
+    # this the drain raises instead of hanging silently
+    _ASYNC_STALL_S = 900.0
+
+    def _drain(self, sched, what: str) -> None:
+        """Pump until fully drained; loud failure on both stall modes
+        (pending-but-inadmissible forever, and an async hold whose
+        future never resolves)."""
+        stalls = 0
+        last_progress = _time.monotonic()
+        while not sched.fully_drained():
+            if sched.pump():
+                stalls = 0
+                last_progress = _time.monotonic()
+            elif sched.has_async():
+                if _time.monotonic() - last_progress > self._ASYNC_STALL_S:
+                    raise RuntimeError(
+                        f"{what}: deferred device wave unresolved after "
+                        f"{self._ASYNC_STALL_S:.0f}s"
+                    )
+                _time.sleep(0.0005)
+            else:
+                stalls += 1
+                if stalls > 10_000:
+                    raise RuntimeError(f"{what} stalled with undrained waves")
+
+    def _wants_stage_overlap(self) -> bool:
+        if os.environ.get("PATHWAY_STAGE_OVERLAP", "1") == "0":
+            return False
+        return any(
+            isinstance(n, AsyncApplyNode) and n.is_async and n.overlap
+            for n in self.graph.nodes
+        )
+
+    def _run_static_frontier(
+        self, batches: list[tuple[int, InputNode, list[Entry]]]
+    ) -> None:
+        """Static batches through the frontier scheduler: each (time,
+        node) wave is staged on its source and operators fire per-
+        timestamp, so a deferred device dispatch of wave t (embed,
+        generate) overlaps the staging and compute of wave t+1 — the
+        serving pipeline the device plane is built around. Results are
+        identical to the lockstep pump (same per-operator time order);
+        only the interleaving differs.
+        """
+        sched = self._make_scheduler()
+        sched.allow_async = True
+        kicks = self._kick_sources(sched)
+        tokens: dict[int, Any] = {}
+        for t, node, entries in sorted(batches, key=lambda b: b[0]):
+            tok = tokens.get(node.node_id)
+            if tok is None:
+                tok = tokens[node.node_id] = sched.add_source(node)
+            sched.stage(tok, t, entries)
+            if t > self.time:
+                self.time = t + (t % 2)
+        for tok in tokens.values():
+            sched.close(tok)
+        stalls = 0
+        last_progress = _time.monotonic()
+        while True:
+            fired = sched.pump()
+            self._stage_kicks(sched, kicks)
+            sched.advance_local(self.time)
+            if sched.fully_drained():
+                if any(n._pending_convergence for n in kicks):
+                    continue  # truncated convergence: keep kicking
+                break
+            if fired:
+                stalls = 0
+                last_progress = _time.monotonic()
+            elif sched.has_async():
+                if _time.monotonic() - last_progress > self._ASYNC_STALL_S:
+                    raise RuntimeError(
+                        "static frontier pump: deferred device wave "
+                        f"unresolved after {self._ASYNC_STALL_S:.0f}s"
+                    )
+                _time.sleep(0.0005)  # a deferred wave is still computing
+            else:
+                stalls += 1
+                if stalls > 10_000:
+                    raise RuntimeError(
+                        "static frontier pump stalled with undrained waves"
+                    )
+        self.graph.end(self.next_time())
 
 
 class IterateNode(Node):
@@ -890,6 +993,15 @@ class AsyncApplyNode(Node):
     Insertions run the (async) function — concurrently within a wave via an
     event loop; results are memoized per key so retractions retract exactly
     the value the insertion produced, even for non-deterministic functions.
+
+    Stage overlap: under a frontier pump that allows it, an async wave is
+    DEFERRED — the batch is submitted to the loop and the node returns
+    without blocking, holding its outgoing watermark at the wave's time
+    via ``FrontierScheduler.hold_async``. The pump keeps firing other
+    admissible work (including this node's own later waves: that is the
+    double buffer — wave t+1 stages/tokenizes while wave t computes on
+    the device), and when the batch resolves the node fires again at the
+    held time to emit. Opt out with PATHWAY_STAGE_OVERLAP=0.
     """
 
     _state_routing = {"memo": "keytup"}  # memo keys are (key.value, row)
@@ -908,12 +1020,47 @@ class AsyncApplyNode(Node):
         self.is_async = is_async
         self.deterministic = deterministic
         self.memo: dict[tuple, Any] = {}
+        self.overlap = os.environ.get("PATHWAY_STAGE_OVERLAP", "1") != "0"
+        # time -> (entries, concurrent Future[results dict]) for deferred
+        # waves; never persisted — checkpoints cut at the global frontier,
+        # which a hold keeps below any half-done wave
+        self._inflight: dict[float, tuple[list, Any]] = {}
 
     def finish_time(self, time: int) -> None:
+        held = self._inflight.pop(time, None)
+        if held is not None:
+            # completion pass: the deferred batch resolved (the scheduler
+            # only re-fires a held time once its future is done)
+            entries, fut = held
+            try:
+                results = fut.result()
+            except Exception as e:  # noqa: BLE001 — per-row errors are
+                # already caught inside the batch; this is a belt for
+                # loop teardown races
+                self.log_error(f"async apply: {type(e).__name__}: {e}")
+                results = {}
+            self._emit_resolved(time, entries, results)
+            return
         entries = self.take_input()
         if not entries:
             return
         insertions = [(k, r) for k, r, d in entries if d > 0]
+        sched = self.graph.scheduler
+        if (
+            self.is_async
+            and self.overlap
+            and sched is not None
+            and getattr(sched, "allow_async", False)
+            # a retraction-only wave behind an in-flight one must chain
+            # through the same hold queue: its tokens may be exactly the
+            # values the earlier wave is still computing (emitting ERROR
+            # for them would poison downstream arrangements)
+            and (insertions or self._inflight)
+        ):
+            fut = _submit_async_batch(self.fn, insertions, self.graph)
+            self._inflight[time] = (entries, fut)
+            sched.hold_async(self, time, lambda t=time: self._hold_done(t))
+            return
         results: dict[tuple, Any] = {}
         if insertions:
             if self.is_async:
@@ -925,6 +1072,21 @@ class AsyncApplyNode(Node):
                     except Exception as e:  # noqa: BLE001
                         self.log_error(f"apply: {type(e).__name__}: {e}")
                         results[(k.value, freeze_row(r))] = ERROR
+        self._emit_resolved(time, entries, results)
+
+    def _hold_done(self, time: float) -> bool:
+        """A deferred wave releases only when its batch resolved AND it
+        is the EARLIEST in-flight wave: computes overlap freely, but
+        emissions (and with them the memo the retraction path reads)
+        stay in per-operator time order."""
+        held = self._inflight.get(time)
+        if held is None:
+            return True
+        return held[1].done() and min(self._inflight) >= time
+
+    def _emit_resolved(
+        self, time: int, entries: list[Entry], results: dict[tuple, Any]
+    ) -> None:
         out: list[Entry] = []
         for key, row, diff in entries:
             token = (key.value, freeze_row(row))
@@ -938,12 +1100,19 @@ class AsyncApplyNode(Node):
                 elif token in results:
                     value = results[token]
                 elif self.deterministic:
-                    # recompute for retraction — allowed for deterministic fns
-                    try:
-                        value = self.fn(key, row)
-                    except Exception as e:  # noqa: BLE001
-                        self.log_error(f"apply: {type(e).__name__}: {e}")
-                        value = ERROR
+                    # recompute for retraction — allowed for deterministic fns;
+                    # async fns (every batched=True UDF) must go through the
+                    # loop or the "value" would be a bare coroutine object
+                    if self.is_async:
+                        value = _run_async_batch(
+                            self.fn, [(key, row)], self.graph
+                        ).get(token, ERROR)
+                    else:
+                        try:
+                            value = self.fn(key, row)
+                        except Exception as e:  # noqa: BLE001
+                            self.log_error(f"apply: {type(e).__name__}: {e}")
+                            value = ERROR
                 else:
                     value = ERROR
             out.append((key, row + (value,), diff))
@@ -970,9 +1139,12 @@ def _get_async_loop() -> asyncio.AbstractEventLoop:
     return _async_loop
 
 
-def _run_async_batch(
+def _submit_async_batch(
     fn: Callable, insertions: list[tuple[Key, tuple]], graph: Graph
-) -> dict[tuple, Any]:
+):
+    """Start a wave's row coroutines on the loop; returns a concurrent
+    Future resolving to {(key, row): value}. The caller decides whether
+    to block (`_run_async_batch`) or defer (stage overlap)."""
     loop = _get_async_loop()
 
     async def one(k: Key, r: tuple) -> Any:
@@ -985,14 +1157,20 @@ def _run_async_batch(
             graph.log_error(f"async apply: {type(e).__name__}: {e}")
             return ERROR
 
-    async def batch() -> list[Any]:
-        return await asyncio.gather(*[one(k, r) for k, r in insertions])
+    async def batch() -> dict[tuple, Any]:
+        values = await asyncio.gather(*[one(k, r) for k, r in insertions])
+        return {
+            (k.value, freeze_row(r)): v
+            for (k, r), v in zip(insertions, values)
+        }
 
-    fut = asyncio.run_coroutine_threadsafe(batch(), loop)
-    values = fut.result()
-    return {
-        (k.value, freeze_row(r)): v for (k, r), v in zip(insertions, values)
-    }
+    return asyncio.run_coroutine_threadsafe(batch(), loop)
+
+
+def _run_async_batch(
+    fn: Callable, insertions: list[tuple[Key, tuple]], graph: Graph
+) -> dict[tuple, Any]:
+    return _submit_async_batch(fn, insertions, graph).result()
 
 
 class OutputNode(Node):

@@ -1,0 +1,59 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current JAX surface (``jax.shard_map`` became a
+top-level export, and its replication check was renamed ``check_vma``);
+older 0.4.x environments still ship ``shard_map`` under
+``jax.experimental.shard_map`` with the ``check_rep`` spelling. Rather
+than version-guarding every call site (engine exchange programs, the
+sharded KNN merge, the ring-attention tests), one shim resolves the
+canonical callable and installs it as ``jax.shard_map`` when the import
+runs under an old release — the rest of the tree keeps writing
+modern-idiom JAX.
+
+Imported (and ``install()``-ed) from the packages that already import
+jax at module scope (``ops``, ``parallel``, ``models``) — NOT from the
+top-level ``pathway_tpu`` package, which deliberately keeps jax out of
+its import graph so CPU-only engine users never pay the jax import.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+
+
+def _resolve_shard_map():
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn, True
+    from jax.experimental.shard_map import shard_map as legacy
+
+    return legacy, False
+
+
+_shard_map, _is_native = _resolve_shard_map()
+_accepts_check_vma = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+@functools.wraps(_shard_map)
+def shard_map(f=None, /, **kwargs):
+    """``jax.shard_map`` with the modern keyword surface on any version.
+
+    Accepts ``check_vma`` everywhere; on releases that predate the rename
+    it is forwarded as ``check_rep`` (same meaning: skip the replication/
+    varying-axes inference the program's collectives would fail)."""
+    if not _accepts_check_vma and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    if f is None:
+        return functools.partial(shard_map, **kwargs)
+    return _shard_map(f, **kwargs)
+
+
+def install() -> None:
+    """Make ``jax.shard_map`` resolvable (idempotent). Old releases get
+    the shim; new releases keep their native export untouched unless it
+    rejects ``check_vma`` (never the case in practice)."""
+    if not _is_native:
+        jax.shard_map = shard_map

@@ -235,6 +235,7 @@ class UDF:
         executor: Executor | None = None,
         cache_strategy: CacheStrategy | None = None,
         max_batch_size: int | None = None,
+        batched: bool = False,
     ):
         self.return_type = return_type
         self.deterministic = deterministic
@@ -242,6 +243,22 @@ class UDF:
         self.executor = executor or auto_executor()
         self.cache_strategy = cache_strategy
         self.max_batch_size = max_batch_size
+        # batched=True: __wrapped__ receives LISTS (one per argument,
+        # whole coalesced wave) and returns a list of per-row results —
+        # the path by which JAX-jitted functions get full device batches.
+        # Dispatch rides the device plane's wave coalescer + async-apply,
+        # so batches coalesce across concurrently admitted waves and a
+        # slow batch never blocks other stages (stage overlap).
+        self.batched = batched
+        if batched and cache_strategy is not None:
+            raise ValueError(
+                "batched=True UDFs do not compose with cache_strategy "
+                "(per-row caches would bypass the coalesced dispatch)"
+            )
+        # one coalescer PER CALL SIGNATURE (arity + kwarg names): call
+        # sites with different shapes must never share a flush, or the
+        # column transpose would silently truncate to the shortest row
+        self._coalescers: dict[Any, Any] = {}
         self._prepared: Callable | None = None
 
     def __wrapped__(self, *args: Any, **kwargs: Any) -> Any:
@@ -270,9 +287,57 @@ class UDF:
         except Exception:  # noqa: BLE001
             return Any
 
+    # ------------------------------------------------------- batched path
+
+    def _flush_batch(self, items: list[tuple[tuple, dict]]) -> list:
+        """Transpose a coalesced wave into per-argument lists and run the
+        wrapped function ONCE over the whole batch."""
+        args_cols = [list(col) for col in zip(*(it[0] for it in items))]
+        kw_keys = items[0][1].keys() if items else ()
+        kwargs_cols = {k: [it[1][k] for it in items] for k in kw_keys}
+        out = list(self.__wrapped__(*args_cols, **kwargs_cols))
+        if len(out) != len(items):
+            raise ValueError(
+                f"batched UDF returned {len(out)} results for "
+                f"{len(items)} rows"
+            )
+        return out
+
+    def _batched_expression(
+        self, args: tuple, kwargs: dict, rt: Any
+    ) -> ex.ColumnExpression:
+        if asyncio.iscoroutinefunction(self.__wrapped__):
+            raise ValueError(
+                "batched=True UDFs must be synchronous (the batch runs "
+                "on the device-plane dispatch pool, off the event loop)"
+            )
+        # the function signature is batch-in/batch-out: unwrap the row
+        # type from a list[T] annotation
+        if typing.get_origin(rt) is list and typing.get_args(rt):
+            rt = typing.get_args(rt)[0]
+        sig = (len(args), tuple(sorted(kwargs)))
+        coalescer = self._coalescers.get(sig)
+        if coalescer is None:
+            from pathway_tpu.engine.device_plane import get_device_plane
+
+            coalescer = self._coalescers[sig] = get_device_plane().coalescer(
+                self._flush_batch, max_batch=self.max_batch_size or 4096
+            )
+
+        async def per_row(*a: Any, **kw: Any) -> Any:
+            return await coalescer.submit((a, kw))
+
+        return ex.AsyncApplyExpression(
+            per_row, rt, *args,
+            propagate_none=self.propagate_none,
+            deterministic=self.deterministic, **kwargs,
+        )
+
     def __call__(self, *args: Any, **kwargs: Any) -> ex.ColumnExpression:
-        fn = self.func
         rt = self._return_type()
+        if self.batched:
+            return self._batched_expression(args, kwargs, rt)
+        fn = self.func
         is_coro = asyncio.iscoroutinefunction(self.__wrapped__)
         kind = self.executor.kind
         if kind == "auto":
@@ -360,8 +425,15 @@ def udf(
     executor: Executor | None = None,
     cache_strategy: CacheStrategy | None = None,
     max_batch_size: int | None = None,
+    batched: bool = False,
 ) -> Any:
-    """@pw.udf decorator (reference: udfs/__init__.py:290)."""
+    """@pw.udf decorator (reference: udfs/__init__.py:290).
+
+    ``batched=True`` flips the calling convention: the function receives
+    one LIST per argument holding a whole coalesced wave of rows and
+    returns a list of per-row results — the device-plane path by which a
+    JAX-jitted function sees full batches instead of row-at-a-time
+    calls. ``max_batch_size`` caps the coalesced batch."""
 
     def wrap(f: Callable) -> _FunctionUDF:
         return _FunctionUDF(
@@ -372,6 +444,7 @@ def udf(
             executor=executor,
             cache_strategy=cache_strategy,
             max_batch_size=max_batch_size,
+            batched=batched,
         )
 
     if fn is not None:

@@ -547,6 +547,32 @@ def generate(
     prompts: left-pad every prompt to a common length, pass the validity
     mask, and each row generates exactly what an unpadded single-prompt
     run would (mask-cumsum positions; pad slots never attend)."""
+    toks, _cache = generate_serving(
+        params, prompt_ids, init_kv_cache(cfg, prompt_ids.shape[0]),
+        n_steps, cfg, temperature=temperature, rng=rng,
+        prompt_mask=prompt_mask,
+    )
+    return toks
+
+
+def generate_serving(
+    params: Params,
+    prompt_ids: Array,  # [b, p]
+    cache: Params,  # KV cache for batch b (init_kv_cache shape)
+    n_steps: int,
+    cfg: TransformerConfig,
+    temperature: float = 0.0,
+    rng: Array | None = None,
+    prompt_mask: Array | None = None,
+) -> tuple[Array, Params]:
+    """`generate` for the serving loop: the KV cache is an ARGUMENT and
+    is returned, so a dispatch site can keep one persistent cache buffer
+    per batch bucket and jit with `donate_argnums` on it — XLA then
+    reuses the (hundreds of MB at Gemma shapes) allocation in place
+    across dispatches instead of re-allocating per call. Stale cache
+    contents from a previous wave are harmless: prefill rewrites
+    positions 0..p-1, decode writes p..p+n-1, and the attention masks
+    never read past the current position."""
     b, p = prompt_ids.shape
     if p + n_steps > cfg.max_len:
         raise ValueError(
@@ -554,7 +580,6 @@ def generate(
         )
     if temperature > 0.0 and rng is None:
         raise ValueError("sampled generation (temperature > 0) requires rng")
-    cache = init_kv_cache(cfg, b)
     first_logits, cache = prefill(params, prompt_ids, cache, cfg, prompt_mask)
     pad_len = (
         None
@@ -578,10 +603,10 @@ def generate(
         # emit the token being consumed this step; the carry holds the next
         return (cache, nxt, key), tok
 
-    (_, last_tok, _), toks = jax.lax.scan(
+    (cache, _last_tok, _), toks = jax.lax.scan(
         body, (cache, first_tok, key), jnp.arange(n_steps)
     )
-    return jnp.concatenate([prompt_ids, toks.T], axis=1)
+    return jnp.concatenate([prompt_ids, toks.T], axis=1), cache
 
 
 class TransformerLM:

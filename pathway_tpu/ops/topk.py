@@ -101,6 +101,30 @@ def quantize_docs(docs: Array) -> QuantizedDocs:
     return QuantizedDocs(values=q, scale=scale, full=docs.astype(jnp.bfloat16))
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update_quantized_docs(docs: QuantizedDocs, idx: Array, rows: Array) -> QuantizedDocs:
+    """Scatter fresh rows into a PERSISTENT quantized doc shard in place.
+
+    All three serving buffers (int8 scan matrix, dequant scales, bf16
+    rescore rows) are donated: XLA reuses the shard's allocation across
+    streaming refreshes instead of rebuilding the layout per update —
+    the device-plane donation lifecycle (docs/serving.md) applied to the
+    quantized KNN path. `rows` are the raw (row-normalized) vectors for
+    slots `idx`; quantization of the delta happens on-device. Duplicate
+    indices padded with a repeated real (idx, row) pair are idempotent,
+    so callers can pad update batches to a shape bucket.
+    """
+    r32 = rows.astype(jnp.float32)
+    maxabs = jnp.maximum(jnp.max(jnp.abs(r32), axis=1), 1e-12)
+    scale = maxabs / 127.0
+    q = jnp.clip(jnp.round(r32 / scale[:, None]), -127, 127).astype(jnp.int8)
+    return QuantizedDocs(
+        values=docs.values.at[idx].set(q),
+        scale=docs.scale.at[idx].set(scale),
+        full=docs.full.at[idx].set(rows.astype(jnp.bfloat16)),
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("k", "candidates"))
 def knn_search_quantized(
     queries: Array,

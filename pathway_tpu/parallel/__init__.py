@@ -8,6 +8,13 @@ the chip interconnect: records are bucketized by key hash in XLA and shuffled
 with `all_to_all` over the mesh (ICI intra-pod, DCN across pods).
 """
 
+# jax version shims (jax.shard_map on old releases) before any
+# submodule builds a sharded program
+from pathway_tpu.internals import jax_compat as _jax_compat
+
+_jax_compat.install()
+
+
 from pathway_tpu.parallel.mesh import (
     default_mesh,
     make_mesh,

@@ -99,6 +99,10 @@ class VectorSlabIndex(HostIndex):
         self._device_dirty = True
         self._device_docs = None
         self._device_valid = None
+        # slots whose vector/validity changed since the last mirror sync:
+        # small deltas scatter into the PERSISTENT device slab via a
+        # donated update program instead of re-uploading the whole mirror
+        self._dirty_slots: set[int] = set()
         self._filters = _FilterCache()
 
     def __getstate__(self):
@@ -107,6 +111,7 @@ class VectorSlabIndex(HostIndex):
         st["_device_docs"] = None
         st["_device_valid"] = None
         st["_device_dirty"] = True
+        st["_dirty_slots"] = set()  # no mirror to patch: full rebuild
         return st
 
     # ------------------------------------------------------------- mutation
@@ -151,8 +156,10 @@ class VectorSlabIndex(HostIndex):
             self.valid[slot] = True
             self.slot_of[key] = slot
             self.key_of[slot] = key
+            old_slot = slot
         self.metadata[key] = metadata
         self._device_dirty = True
+        self._dirty_slots.add(old_slot)
 
     def remove(self, key: Key) -> None:
         slot = self.slot_of.pop(key, None)
@@ -163,6 +170,7 @@ class VectorSlabIndex(HostIndex):
         self.metadata.pop(key, None)
         self.free.append(slot)
         self._device_dirty = True
+        self._dirty_slots.add(slot)
 
     def __len__(self) -> int:
         return len(self.slot_of)
@@ -170,14 +178,69 @@ class VectorSlabIndex(HostIndex):
     # -------------------------------------------------------------- search
 
     def _refresh_device(self) -> None:
+        """Sync the persistent device mirror with host state.
+
+        Small deltas (the streaming steady state: a few upserts per wave)
+        scatter into the EXISTING slab through a donated device program —
+        the [n, d] allocation is reused in place, and the host->device
+        payload is just the changed rows. The mirror is rebuilt wholesale
+        only when the padded slot bucket grew or most rows changed.
+        """
         import jax
         import jax.numpy as jnp
 
-        docs = self.vectors[: self._padded_slots()]
-        self._device_docs = jax.device_put(jnp.asarray(docs, jnp.bfloat16))
-        self._device_valid = jax.device_put(
-            jnp.asarray(self.valid[: self._padded_slots()])
+        from pathway_tpu.engine.device_plane import get_device_plane
+
+        plane = get_device_plane()
+        padded = self._padded_slots()
+        incremental = (
+            self._device_docs is not None
+            and int(self._device_docs.shape[0]) == padded
+            and self._dirty_slots
+            and len(self._dirty_slots) <= padded // 2
         )
+        if incremental:
+            prog = plane.program(
+                "knn_slab_update",
+                lambda docs, valid, idx, rows, vbits: (
+                    docs.at[idx].set(rows), valid.at[idx].set(vbits)
+                ),
+                donate_argnums=(0, 1),  # patch the slab in place
+            )
+            idx = np.fromiter(self._dirty_slots, np.int32)
+            # pad the update batch to a power-of-two bucket by REPEATING
+            # the first entry: duplicate scatter indices write the same
+            # value, so padding is idempotent and the jit cache sees a
+            # bounded set of update shapes
+            ub = plane.buckets.rows_bucket(min(len(idx), plane.buckets.max_rows))
+            if len(idx) > ub:  # huge delta past the cap: rebuild instead
+                incremental = False
+            else:
+                idx = np.concatenate([idx, np.full(ub - len(idx), idx[0], np.int32)])
+                rows = self.vectors[idx]
+                vbits = self.valid[idx]
+                try:
+                    self._device_docs, self._device_valid = prog(
+                        self._device_docs,
+                        self._device_valid,
+                        jnp.asarray(idx),
+                        jnp.asarray(rows, jnp.bfloat16),
+                        jnp.asarray(vbits),
+                        # dim in the key: the program is shared plane-wide,
+                        # and indexes of different dims compile separately
+                        bucket=(padded, ub, self.dim),
+                    )
+                except Exception:
+                    # donation already consumed the old slab — drop the
+                    # mirror so the next refresh rebuilds from host state
+                    # instead of touching a deleted buffer
+                    self._device_docs = self._device_valid = None
+                    raise
+        if not incremental:
+            docs = self.vectors[:padded]
+            self._device_docs = jax.device_put(jnp.asarray(docs, jnp.bfloat16))
+            self._device_valid = jax.device_put(jnp.asarray(self.valid[:padded]))
+        self._dirty_slots.clear()
         self._device_dirty = False
 
     def _padded_slots(self) -> int:
@@ -247,19 +310,35 @@ class VectorSlabIndex(HostIndex):
     def _topk_device(self, qmat: np.ndarray, k: int):
         import jax.numpy as jnp
 
+        from pathway_tpu.engine.device_plane import get_device_plane
         from pathway_tpu.ops.topk import knn_search_masked
 
         if self._device_dirty:
             self._refresh_device()
-        res = knn_search_masked(
-            jnp.asarray(qmat),
+        plane = get_device_plane()
+        # query batches are as ragged as the waves that carry them: pad
+        # to the row bucket so (slab, qbucket, k) bounds the jit cache.
+        # Batches past the bucket cap (bulk backfills) dispatch at their
+        # exact size — one-off shapes, not a streaming recompile loop.
+        n_q = qmat.shape[0]
+        if n_q > plane.buckets.max_rows:
+            qpad, qbucket = qmat.astype(np.float32), n_q
+        else:
+            (qpad,), qbucket = plane.pad_rows([qmat.astype(np.float32)], n_q)
+        prog = plane.program(
+            "knn_slab_search", knn_search_masked,
+            static_argnames=("k", "metric"),
+        )
+        res = prog(
+            jnp.asarray(qpad),
             self._device_docs,
             self._device_valid,
-            min(k, int(self._device_docs.shape[0])),
-            self.metric if self.metric != "cosine" else "cos",
+            k=min(k, int(self._device_docs.shape[0])),
+            metric=self.metric if self.metric != "cosine" else "cos",
+            bucket=(int(self._device_docs.shape[0]), qbucket, k, self.dim),
         )
-        idxs = np.asarray(res.indices)
-        dists = np.asarray(res.distances)
+        idxs = np.asarray(res.indices)[:n_q]
+        dists = np.asarray(res.distances)[:n_q]
         out = []
         for r in range(idxs.shape[0]):
             keep = np.isfinite(dists[r])

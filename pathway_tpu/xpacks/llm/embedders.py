@@ -15,6 +15,7 @@ instead of row-at-a-time.
 from __future__ import annotations
 
 import asyncio
+import weakref
 from typing import Any
 
 import numpy as np
@@ -149,69 +150,41 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         return self.model.encode(text or ".", **merged)
 
 
-class _MicroBatcher:
-    """Collects concurrently awaiting requests and flushes them as one batch.
-
-    The engine's async-apply operator starts every row's coroutine in a wave
-    before awaiting any (asyncio.gather), so each request appended here
-    yields once and the LAST scheduled flush sees the whole wave — one TPU
-    dispatch per wave per embedder, with `max_batch` as the device ceiling.
-    """
-
-    def __init__(self, flush_fn: Any, max_batch: int = 4096):
-        self.flush_fn = flush_fn
-        self.max_batch = max_batch
-        self.pending: list[tuple[str, asyncio.Future]] = []
-        self._scheduled = False
-
-    async def submit(self, text: str) -> Any:
-        loop = asyncio.get_running_loop()
-        fut: asyncio.Future = loop.create_future()
-        self.pending.append((text, fut))
-        if not self._scheduled:
-            self._scheduled = True
-            loop.call_soon(self._flush_cb)
-        return await fut
-
-    def _flush_cb(self) -> None:
-        self._scheduled = False
-        while self.pending:
-            batch, self.pending = (
-                self.pending[: self.max_batch],
-                self.pending[self.max_batch:],
-            )
-            texts = [t for t, _f in batch]
-            try:
-                vecs = self.flush_fn(texts)
-                for (_t, fut), vec in zip(batch, vecs):
-                    if not fut.done():
-                        fut.set_result(vec)
-            except Exception as e:  # noqa: BLE001
-                for _t, fut in batch:
-                    if not fut.done():
-                        fut.set_exception(e)
+# The wave batcher moved into the device plane: coalescing is a serving
+# concern shared by every XLA-backed stage (embed, generate, batched
+# UDFs). Kept under its historical name — callers (and the bench's phase
+# probes) patch `<udf>._batcher.flush_fn`.
+from pathway_tpu.engine.device_plane import (  # noqa: E402
+    WaveCoalescer as _MicroBatcher,
+    get_device_plane,
+)
 
 
 def bucket_len(longest: int, cap: int) -> int:
-    """Power-of-two-ish sequence bucket (>=16) so the jit cache sees few
+    """Power-of-two sequence bucket (>=16) so the jit cache sees few
     distinct shapes as lengths vary — shared by the embedder's right-pad
-    and the chat's left-pad batching."""
-    bucket = 16
-    while bucket < longest:
-        bucket *= 2
-    return min(bucket, cap)
+    and the chat's left-pad batching (the device plane's BucketPolicy)."""
+    return get_device_plane().buckets.seq_bucket(longest, cap)
 
 
-def pad_left_rows(rows: list, cap: int, pad_rows_to: int = 8):
+def pad_left_rows(
+    rows: list, cap: int, pad_rows_to: int | None = None,
+    n_rows: int | None = None,
+):
     """Left-pad variable-length token rows into (ids, mask) int32 arrays
     at a bucketed width (generation convention — real tokens end at the
     last column, so last-position logits are every row's next token).
-    The batch dimension pads to a multiple of `pad_rows_to` with all-
-    masked rows so arbitrary wave sizes hit few jit shapes — without it
-    every distinct concurrent-wave size recompiles the whole generate
-    program."""
+    The batch dimension pads with all-masked rows so arbitrary wave
+    sizes hit few jit shapes: to exactly `n_rows` (callers pass the
+    device plane's row bucket), to a multiple of `pad_rows_to`, or to
+    the plane's power-of-two bucket by default."""
     bucket = bucket_len(max((len(r) for r in rows), default=1) or 1, cap)
-    n = ((len(rows) + pad_rows_to - 1) // pad_rows_to) * pad_rows_to
+    if n_rows is not None:
+        n = n_rows
+    elif pad_rows_to is not None:
+        n = ((len(rows) + pad_rows_to - 1) // pad_rows_to) * pad_rows_to
+    else:
+        n = get_device_plane().buckets.rows_bucket(len(rows))
     ids = np.zeros((n, bucket), np.int32)
     mask = np.zeros((n, bucket), np.int32)
     for i, r in enumerate(rows):
@@ -238,7 +211,6 @@ class JaxEmbedder(BaseEmbedder):
         tokenizer: Any = None,
         *,
         max_batch: int = 4096,
-        pad_to_multiple: int = 16,
         cache_strategy: udfs.CacheStrategy | None = None,
     ):
         super().__init__(
@@ -265,29 +237,40 @@ class JaxEmbedder(BaseEmbedder):
         self.tokenizer = tokenizer or HashTokenizer(
             vocab_size=self.config.vocab_size, max_len=self.config.max_len
         )
-        self.pad_to_multiple = pad_to_multiple
-        self._encode = jax.jit(functools.partial(transformer.encode, cfg=self.config))
-        self._batcher = _MicroBatcher(self._encode_batch, max_batch=max_batch)
+        # the device plane owns the dispatch: bucketed shapes, compile
+        # ledger, off-loop flushes (a slow generate elsewhere never
+        # blocks this embedder's coalescer)
+        self._plane = get_device_plane()
+        self._encode = self._plane.program(
+            self._plane.unique_name("embed_encode"),
+            functools.partial(transformer.encode, cfg=self.config),
+        )
+        self._batcher = self._plane.coalescer(
+            self._encode_batch, max_batch=max_batch
+        )
+        # release the per-instance program when this embedder dies — the
+        # plane is process-global and would otherwise pin it forever
+        self._finalizer = weakref.finalize(
+            self, self._plane.drop_program, self._encode.name
+        )
 
     def _encode_batch(self, texts: list[str]) -> list[np.ndarray]:
         import jax.numpy as jnp
 
         ids, mask = self.tokenizer.batch([t or "." for t in texts])
-        # pad rows to a multiple so the jit cache sees few distinct shapes
-        m = self.pad_to_multiple
-        rows = ((ids.shape[0] + m - 1) // m) * m
-        if rows != ids.shape[0]:
-            pad = rows - ids.shape[0]
-            ids = np.pad(ids, ((0, pad), (0, 0)))
-            mask = np.pad(mask, ((0, pad), (0, 0)))
-        # pad seq to a power-of-two-ish bucket
+        # pad rows + seq up to the plane's power-of-two buckets: ragged
+        # live waves hit a bounded set of XLA programs
+        (ids, mask), rows = self._plane.pad_rows([ids, mask], ids.shape[0])
         seq = ids.shape[1]
         bucket = bucket_len(seq, self.config.max_len)
         if bucket != seq:
             ids = np.pad(ids, ((0, 0), (0, bucket - seq)))
             mask = np.pad(mask, ((0, 0), (0, bucket - seq)))
         out = np.asarray(
-            self._encode(self.params, jnp.asarray(ids), jnp.asarray(mask))
+            self._encode(
+                self.params, jnp.asarray(ids), jnp.asarray(mask),
+                bucket=(rows, bucket),
+            )
         )
         return [out[i] for i in range(len(texts))]
 

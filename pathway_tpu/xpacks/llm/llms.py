@@ -12,6 +12,7 @@ the reference delegates to HF torch pipelines.
 
 from __future__ import annotations
 
+import weakref
 from typing import Any
 
 import pathway_tpu as pw
@@ -200,9 +201,9 @@ class JaxLMChat(BaseChat):
 
         import jax
 
+        from pathway_tpu.engine.device_plane import get_device_plane
         from pathway_tpu.models import lm_config, transformer
         from pathway_tpu.models.tokenizer import HashTokenizer
-        from pathway_tpu.xpacks.llm.embedders import _MicroBatcher
 
         self.config = config or lm_config(
             vocab_size=32768, d_model=256, n_heads=8, n_layers=4, d_ff=1024,
@@ -221,40 +222,62 @@ class JaxLMChat(BaseChat):
             )
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
+        self.max_batch = max_batch
         # serving batcher: a wave of concurrent chat calls left-pads into
         # ONE generate dispatch (prompt_mask keeps per-row outputs equal
         # to unpadded runs); per-question dispatch would serialize on
-        # host->device submission latency
-        self._gen = jax.jit(
+        # host->device submission latency. The KV cache is a PERSISTENT
+        # donated buffer per row bucket (device_plane lease): XLA reuses
+        # the allocation across dispatches instead of re-allocating the
+        # cache every call.
+        self._plane = get_device_plane()
+        self._gen = self._plane.program(
+            self._plane.unique_name("lm_generate"),
             functools.partial(
-                transformer.generate,
+                transformer.generate_serving,
                 n_steps=self.max_new_tokens,
                 cfg=self.config,
                 temperature=self.temperature,
-            )
+            ),
+            donate_argnums=(2,),  # the KV cache rides the lease cycle
         )
-        self._batcher = _MicroBatcher(self._generate_batch, max_batch=max_batch)
+        self._batcher = self._plane.coalescer(
+            self._generate_batch, max_batch=max_batch
+        )
+        # the plane is process-global: without this, every dead chat
+        # instance would pin its compiled program + KV-cache pools forever
+        self._finalizer = weakref.finalize(
+            self, self._plane.drop_program, self._gen.name
+        )
 
     def _generate_batch(self, prompts: list[str]) -> list[str]:
         import jax
         import jax.numpy as jnp
         import numpy as np
 
+        from pathway_tpu.models import transformer
         from pathway_tpu.xpacks.llm.embedders import pad_left_rows
 
         budget = self.config.max_len - self.max_new_tokens
         rows = [self.tokenizer.tokenize(p)[-budget:] for p in prompts]
-        ids, mask = pad_left_rows(rows, budget)
+        n = min(self._plane.buckets.rows_bucket(len(rows)), self.max_batch)
+        n = max(n, len(rows))
+        ids, mask = pad_left_rows(rows, budget, n_rows=n)
         bucket = ids.shape[1]
         kwargs = {}
         if self.temperature > 0.0:
             kwargs["rng"] = jax.random.PRNGKey(abs(hash(tuple(prompts))) % (1 << 31))
-        out = np.asarray(
-            self._gen(
-                self.params, jnp.asarray(ids),
-                prompt_mask=jnp.asarray(mask), **kwargs,
-            )
+        cache_key = ("lm_kv_cache", self._gen.name, n)
+        cache = self._plane.lease(
+            cache_key, lambda: transformer.init_kv_cache(self.config, n)
         )
+        out, cache = self._gen(
+            self.params, jnp.asarray(ids), cache,
+            prompt_mask=jnp.asarray(mask),
+            bucket=(n, bucket), **kwargs,
+        )
+        self._plane.restore(cache_key, cache)
+        out = np.asarray(out)
         return [
             " ".join(f"<{int(t)}>" for t in out[i, bucket:])
             for i in range(len(rows))
