@@ -40,8 +40,11 @@ in tier-1 without TPU hardware.
 from __future__ import annotations
 
 import threading
+import time as _time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable
+
+from pathway_tpu.engine import faults
 
 __all__ = [
     "BucketPolicy",
@@ -93,7 +96,8 @@ class BucketPolicy:
 
 
 class DeviceProgram:
-    """One jitted program plus its per-bucket compile ledger.
+    """One jitted program plus its per-bucket compile ledger and
+    quarantine state.
 
     Wraps ``jax.jit(fn, ...)``; each call passes the bucket key it
     padded to, and the ledger records how many XLA compilations that
@@ -101,7 +105,21 @@ class DeviceProgram:
     (``_cache_size``), with a shape-signature fallback on runtimes that
     hide it. The invariant the tier-1 guard pins: streaming ragged
     batches inside one bucket never grows the ledger past 1.
+
+    **Graceful degradation**: a dispatch that fails (XLA error, device
+    loss, or an injected ``device.dispatch.{name}`` fault) *quarantines*
+    the (program, bucket) entry and the wave falls back to the HOST
+    path — the un-jitted function, op-by-op, slower but correct. While
+    quarantined, calls for that bucket go straight to the host path;
+    after an exponentially growing cooldown (``PROBE_BASE_S`` doubling
+    up to ``PROBE_CAP_S``) one call is admitted as a re-probe, and a
+    successful probe lifts the quarantine.
     """
+
+    # re-probe backoff for quarantined buckets (class-level so tests and
+    # drills can compress the clock)
+    PROBE_BASE_S = 0.5
+    PROBE_CAP_S = 30.0
 
     def __init__(
         self,
@@ -114,6 +132,7 @@ class DeviceProgram:
         import jax
 
         self.name = name
+        self._fn = fn  # the host-path fallback: same math, no XLA program
         self.donate_argnums = tuple(donate_argnums)
         kw: dict[str, Any] = {}
         if donate_argnums:
@@ -125,6 +144,9 @@ class DeviceProgram:
         # bucket key -> compilations charged to it
         self.compile_counts: dict[Any, int] = {}
         self._seen_sigs: set[Any] = set()
+        # bucket key -> {"failures": n, "reopen_at": t, "last_error": str}
+        self.quarantine: dict[Any, dict[str, Any]] = {}
+        self.host_fallbacks = 0  # dispatches served by the host path
 
     def jit_cache_size(self) -> int | None:
         """Entries in the underlying jit cache — XLA's own ledger. Tests
@@ -149,16 +171,72 @@ class DeviceProgram:
         return (treedef, tuple(leaf(x) for x in flat))
 
     def __call__(self, *args: Any, bucket: Any = None, **kwargs: Any) -> Any:
+        if self.quarantine and not self._admit_probe(bucket):
+            # quarantined bucket, cooldown still running: host path
+            with self._lock:
+                self.host_fallbacks += 1
+            return self._fn(*args, **kwargs)
         # bookkeeping only under the lock; the dispatch itself runs
         # outside it so overlapping stages never serialize here
         sig = self._signature(args, kwargs)
         with self._lock:
-            if sig not in self._seen_sigs:
+            fresh_sig = sig not in self._seen_sigs
+            if fresh_sig:
                 self._seen_sigs.add(sig)
                 self.compile_counts[bucket] = (
                     self.compile_counts.get(bucket, 0) + 1
                 )
-        return self._jit(*args, **kwargs)
+        try:
+            faults.check(f"device.dispatch.{self.name}")
+            out = self._jit(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — any dispatch failure degrades
+            with self._lock:
+                if fresh_sig:
+                    # the compile never happened; let a successful
+                    # re-probe charge the ledger instead
+                    self._seen_sigs.discard(sig)
+                    n = self.compile_counts.get(bucket, 0) - 1
+                    if n > 0:
+                        self.compile_counts[bucket] = n
+                    else:
+                        self.compile_counts.pop(bucket, None)
+                q = self.quarantine.setdefault(
+                    bucket, {"failures": 0, "reopen_at": 0.0, "last_error": ""}
+                )
+                q["failures"] += 1
+                q["last_error"] = f"{type(e).__name__}: {e}"
+                q["reopen_at"] = _time.monotonic() + self._cooldown(
+                    q["failures"]
+                )
+                self.host_fallbacks += 1
+            return self._fn(*args, **kwargs)
+        with self._lock:
+            if bucket in self.quarantine:
+                self.quarantine.pop(bucket, None)  # probe succeeded
+        return out
+
+    def _cooldown(self, failures: int) -> float:
+        """Doubling re-probe cooldown, saturating at PROBE_CAP_S. The
+        exponent is clamped: a bucket failing for hours reaches failure
+        counts where an unclamped ``2 ** failures`` overflows — crashing
+        the wave the host fallback exists to save."""
+        return min(
+            self.PROBE_BASE_S * 2 ** min(failures - 1, 32), self.PROBE_CAP_S
+        )
+
+    def _admit_probe(self, bucket: Any) -> bool:
+        """True when the bucket is healthy, or quarantined but due for a
+        re-probe (which is then claimed: the cooldown moves forward so
+        concurrent callers don't stampede the device)."""
+        with self._lock:
+            q = self.quarantine.get(bucket)
+            if q is None:
+                return True
+            now = _time.monotonic()
+            if now < q["reopen_at"]:
+                return False
+            q["reopen_at"] = now + self._cooldown(q["failures"])
+            return True
 
     @property
     def total_compiles(self) -> int:
@@ -323,11 +401,32 @@ class DevicePlane:
 
     def compile_counts(self) -> dict[tuple[str, Any], int]:
         """{(program_name, bucket): compilations} across the plane — the
-        observable the no-recompile regression guard asserts on."""
+        observable the no-recompile regression guard asserts on.
+        Snapshotted under each program's lock: dispatch-pool threads
+        mutate the ledgers (incl. pops on failed dispatches)."""
         out: dict[tuple[str, Any], int] = {}
-        for name, prog in self.programs.items():
-            for bucket, n in prog.compile_counts.items():
+        with self._lock:
+            progs = list(self.programs.items())
+        for name, prog in progs:
+            with prog._lock:
+                items = list(prog.compile_counts.items())
+            for bucket, n in items:
                 out[(name, bucket)] = n
+        return out
+
+    def quarantined(self) -> dict[tuple[str, Any], dict[str, Any]]:
+        """{(program_name, bucket): quarantine record} for every entry
+        currently degraded to the host path (see DeviceProgram).
+        Snapshotted under each program's lock — the failure/re-probe
+        paths insert and pop entries from dispatch-pool threads."""
+        out: dict[tuple[str, Any], dict[str, Any]] = {}
+        with self._lock:
+            progs = list(self.programs.items())
+        for name, prog in progs:
+            with prog._lock:
+                items = [(b, dict(q)) for b, q in prog.quarantine.items()]
+            for bucket, q in items:
+                out[(name, bucket)] = q
         return out
 
     def coalescer(

@@ -15,6 +15,7 @@ import threading
 import time as _time
 from typing import Any, Callable, Iterable, Sequence
 
+from pathway_tpu.engine import faults
 from pathway_tpu.engine.core import (
     CaptureNode,
     Entry,
@@ -172,6 +173,12 @@ class Runtime:
     deterministic batch pump for debug computations.
     """
 
+    # hard ceiling on one checkpoint-fence/end quiesce (_mesh_quiesce):
+    # a genuinely livelocked mesh fails loudly with a state dump instead
+    # of hanging forever; generous because a legitimate wave mid-fence
+    # may be arbitrarily slow (first-touch XLA compile)
+    _QUIESCE_TIMEOUT_S = 120.0
+
     def __init__(self, graph: Graph, autocommit_ms: int = 2):
         self.graph = graph
         self.autocommit_ms = max(2, autocommit_ms - autocommit_ms % 2)
@@ -261,6 +268,9 @@ class Runtime:
             sched.advance_local(self.time)
             if sched.pump():
                 ckpt_dirty = True
+                # chaos drills: die hard right after a wave retired, with
+                # its input offsets consumed but no checkpoint cut yet
+                faults.crash("runtime.wave")
             # checkpoint on cadence whenever there is anything new to
             # commit — retired waves OR offset-frontier advances (a
             # quiet stream whose source finished a file still needs its
@@ -351,7 +361,13 @@ class Runtime:
         fired_any = False
         while True:
             fired = sched.pump(budget=8)
-            fired_any = fired_any or bool(fired)
+            if fired:
+                fired_any = True
+                # chaos drills: one worker dies right after waves retired
+                # — whether this pump serves the main loop or a fence
+                # quiesce round. Peers observe the death on their wires
+                # and abort with WorkerLost for the supervisor to restart.
+                faults.crash("runtime.mesh.wave")
             moved = False
             for x in xnodes:
                 f = sched.frontier_of_node(x)
@@ -365,17 +381,86 @@ class Runtime:
                 return fired_any
 
     def _mesh_quiesce(self, sched, mesh, xnodes, sent, tag: str, rounds: int):
-        """Barrier-drain rounds until the mesh is globally quiescent:
-        each round flushes (at least) one more exchange stage — data and
-        watermarks sent before a peer's barrier frame are ordered before
-        it, so after `rounds` >= 2*depth+2 rounds nothing is in flight.
+        """Barrier-drain rounds until the mesh is PROVABLY quiescent.
+
+        Each round: advance the local clock over everything staged so
+        far (a remote bucket above the step-1 watermark must become
+        admissible, or it would sit stashed forever), allgather
+        (local_time, fully_drained, data_frames_sent), sync the local
+        clock to the mesh-wide max (announcements are capped by the
+        local clock, and nothing advances it inside a fence — without
+        the sync a peer's wave stashed above a slow process's clock
+        livelocks the mesh), then drain+pump.
+        The loop ends — identically on every process, because the
+        decision reads only the allgathered view — once
+
+          * at least ``rounds`` (= 2*exchange_depth+2) rounds ran, AND
+          * every process entered the round fully drained, AND
+          * no process's data-frame counter moved since the previous
+            round (frames sent before a peer's barrier frame are
+            ordered before it, so an unchanged counter means nothing
+            is in flight anywhere).
+
+        A fixed round count alone is NOT enough: a wave can lawfully
+        stay stashed across many rounds while watermarks catch up, and
+        a checkpoint cut with a stashed wave commits its input offsets
+        without its effects — the recovered run silently loses it (the
+        chaos drill's supervised-mesh case caught exactly this).
         Returns the final allgather view {proc: local_time}."""
-        vals = None
-        for r in range(rounds):
-            vals = mesh.allgather(f"{tag}-r{r}", self.time)
+        prev_sent: dict | None = None
+        r = 0
+        deadline = _time.monotonic() + self._QUIESCE_TIMEOUT_S
+        while True:
+            sched.advance_local(self.time)
+            view = mesh.allgather(
+                f"{tag}-r{r}",
+                (self.time, sched.fully_drained(), mesh.data_frames_sent),
+            )
+            # clock sync: my wire announcements are capped by my local-
+            # source watermark = my clock, and with no connector polls
+            # inside the fence the clock is FROZEN. A peer wave stashed
+            # above it (its clock ran ahead and its bucket routed only
+            # to itself) would wait on my announcement forever — the
+            # mesh livelocks. Jumping to the mesh-wide max is safe for
+            # the same reason _drain_mesh's bump on observed bucket
+            # times is: every future local wave is stamped via
+            # next_time() strictly above self.time.
+            tmax = max(v[0] for v in view.values())
+            if tmax > self.time:
+                self.time = tmax
             self._drain_mesh(sched, mesh, self._remote_tokens)
+            sched.advance_local(self.time)  # drained buckets moved the clock
             self._pump_mesh(sched, mesh, xnodes, sent)
-        return vals
+            drained = all(v[1] for v in view.values())
+            sent_now = {p: v[2] for p, v in view.items()}
+            if r + 1 >= rounds and drained and sent_now == prev_sent:
+                return {p: v[0] for p, v in view.items()}
+            prev_sent = sent_now
+            r += 1
+            if _time.monotonic() > deadline:
+                # wall-clock, not round-count: rounds are cheap on a
+                # localhost mesh, and a legitimately slow wave (huge
+                # first-touch compile) must not trip a spurious failure
+                pend = {
+                    slot: sorted(times)[:4]
+                    for slot, times in sched._pending.items()
+                    if times
+                }
+                # poison the wires BEFORE raising: peers are blocked in
+                # the next round's allgather (which has no deadline of
+                # its own) — closing our sockets flips us to dead on
+                # their side, so they abort with WorkerLost instead of
+                # hanging if this process survives the error
+                try:
+                    mesh.close()
+                except Exception:  # noqa: BLE001 — best-effort poison
+                    pass
+                raise RuntimeError(
+                    f"mesh quiesce {tag!r} failed to converge after "
+                    f"{self._QUIESCE_TIMEOUT_S:.0f}s ({r} rounds): "
+                    f"time={self.time} pending={pend} "
+                    f"async={sorted(sched._async_waves)} view={view}"
+                )
 
     def run_mesh(
         self, static_batches: list[tuple[int, InputNode, list[Entry]]] | None = None
@@ -400,6 +485,7 @@ class Runtime:
         """
         from pathway_tpu.engine.frontier import DONE
         from pathway_tpu.engine.workers import ProcessExchangeNode
+        from pathway_tpu.parallel.process_mesh import WorkerLost
 
         mesh = self.mesh
         assert mesh is not None
@@ -441,9 +527,16 @@ class Runtime:
         try:
             while True:
                 if mesh._dead:
-                    raise ConnectionError(
+                    # supervised recovery: abort THIS wave cleanly (no
+                    # partial checkpoint — the last committed epoch stays
+                    # the resume point) and surface a typed error the
+                    # supervisor restarts the whole mesh on. Every peer
+                    # observes the death on its own wires, so the mesh
+                    # drains instead of hanging on a barrier.
+                    raise WorkerLost(
                         f"process {mesh.process_id}: peer(s) "
-                        f"{sorted(mesh._dead)} died mid-run"
+                        f"{sorted(mesh._dead)} died mid-run; resume from "
+                        "the last committed checkpoint"
                     )
                 # 1. local ingestion: one fresh wave per source per poll
                 for c in self.connectors:
@@ -463,6 +556,8 @@ class Runtime:
                 # 2. remote ingestion + watermark announcements
                 self._drain_mesh(sched, mesh, self._remote_tokens)
                 # 3. fire everything the frontier allows; announce wires
+                # (the runtime.mesh.wave crash point probes inside
+                # _pump_mesh, so fence-quiesce waves count too)
                 if self._pump_mesh(sched, mesh, xnodes, wm_sent):
                     ckpt_dirty = True
                 # 4. checkpoint fences (cadence owned by process 0)
@@ -483,6 +578,14 @@ class Runtime:
                         sched, mesh, xnodes, wm_sent,
                         f"s{sid}-fence-{fences_handled}", rounds,
                     )
+                    if not sched.fully_drained():
+                        # committing here would persist input offsets for
+                        # waves whose effects are still in flight — the
+                        # recovered run would silently drop them
+                        raise RuntimeError(
+                            f"process {mesh.process_id}: checkpoint fence "
+                            f"{fences_handled} reached with undrained waves"
+                        )
                     if self.checkpointer is not None:
                         self.checkpointer.checkpoint(self.time)
                         ckpt_dirty = False
@@ -1175,7 +1278,11 @@ def _run_async_batch(
 
 class OutputNode(Node):
     """Sink: formats consolidated batches and hands them to a writer callback
-    with retries (reference: output_table dataflow.rs:3542, OUTPUT_RETRIES=5)."""
+    with retries (reference: output_table dataflow.rs:3542, OUTPUT_RETRIES=5).
+
+    The retry loop rides the unified ``pw.io.RetryPolicy`` (same default
+    timings as the old hand-rolled loop: 5 attempts, 10 ms apart), which
+    makes every sink fault-injectable at ``io.retry.sink``."""
 
     RETRIES = 5
 
@@ -1187,6 +1294,7 @@ class OutputNode(Node):
         flush: Callable[[], None] | None = None,
         close: Callable[[], None] | None = None,
         write_native: Callable[[int, Any], None] | None = None,
+        retry_policy: Any = None,
     ):
         super().__init__(graph, [inp])
         self.write_batch = write_batch
@@ -1197,19 +1305,34 @@ class OutputNode(Node):
         # it get materialized entries as before
         self.write_native = write_native
         self._closed = False
+        if retry_policy is None:
+            # lazy import: pathway_tpu.io's package init imports modules
+            # that import this one
+            from pathway_tpu.io._retry import RetryPolicy
+
+            retry_policy = RetryPolicy(
+                "sink",
+                max_attempts=self.RETRIES,
+                initial_delay_ms=10,
+                backoff_factor=1.0,
+                jitter_ms=0,
+                breaker_threshold=None,
+            )
+        self.retry_policy = retry_policy
 
     def _write_retrying(self, fn, time: int, payload) -> None:
-        last_err: Exception | None = None
-        for _attempt in range(self.RETRIES):
-            try:
-                fn(time, payload)
-                if self.flush is not None:
-                    self.flush()
-                return
-            except Exception as e:  # noqa: BLE001
-                last_err = e
-                _time.sleep(0.01)
-        self.log_error(f"output failed after {self.RETRIES} retries: {last_err}")
+        def attempt() -> None:
+            fn(time, payload)
+            if self.flush is not None:
+                self.flush()
+
+        try:
+            self.retry_policy.call(attempt)
+        except Exception as e:  # noqa: BLE001 — a sink must not kill the pump
+            self.log_error(
+                f"output failed after "
+                f"{self.retry_policy.max_attempts} retries: {e}"
+            )
 
     def finish_time(self, time: int) -> None:
         if self.write_native is not None:

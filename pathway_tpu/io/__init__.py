@@ -8,6 +8,7 @@ descriptor/api layer is real).
 """
 
 from pathway_tpu.io import csv, fs, jsonlines, null, plaintext, python
+from pathway_tpu.io._retry import CircuitOpen, RetryPolicy
 from pathway_tpu.io._subscribe import subscribe
 
 # service-backed families (gated on their client libs)
@@ -36,6 +37,7 @@ from pathway_tpu.io import (  # noqa: E402
 
 __all__ = [
     "csv", "fs", "jsonlines", "null", "plaintext", "python", "subscribe",
+    "RetryPolicy", "CircuitOpen",
     "kafka", "redpanda", "s3", "s3_csv", "minio", "deltalake", "sqlite",
     "nats", "postgres", "elasticsearch", "mongodb", "debezium", "bigquery",
     "pubsub", "pyfilesystem", "logstash", "http", "gdrive", "slack", "airbyte",

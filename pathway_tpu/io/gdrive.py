@@ -9,12 +9,16 @@ deletions. Raises a clear ImportError when the client stack is missing.
 
 from __future__ import annotations
 
+import logging
 import time as _time
 from typing import Any
 
 from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.json import Json
 from pathway_tpu.io._external import require_module
+from pathway_tpu.io._retry import CircuitOpen, RetryPolicy
+
+_LOG = logging.getLogger("pathway_tpu.io.gdrive")
 
 _EXPORT_MIME = {
     "application/vnd.google-apps.document": "text/plain",
@@ -51,6 +55,24 @@ def read(
         [file_name_pattern]
         if isinstance(file_name_pattern, str)
         else list(file_name_pattern or [])
+    )
+    connector_name = name or f"gdrive:{object_id}"
+    # unified download policy: bounded in-poll retries, and a circuit
+    # breaker so a dead API (auth revoked, quota) stops hammering every
+    # poll — the open transition is surfaced as ONE loud warning
+    retry = RetryPolicy(
+        connector_name,
+        max_attempts=3,
+        initial_delay_ms=500,
+        max_delay_ms=5_000,
+        breaker_threshold=5,
+        breaker_reset_ms=60_000,
+        on_breaker_open=lambda p: _LOG.warning(
+            "connector %r: circuit breaker OPEN after repeated download "
+            "failures (last: %s); downloads fail fast for a cooldown, "
+            "then a single probe re-tests the API",
+            p.name, p.last_error,
+        ),
     )
 
     class GDriveSubject(ConnectorSubject):
@@ -134,15 +156,29 @@ def read(
                 out = [f]
             return out
 
+        def _download_once(self, drive: Any, f: dict) -> bytes:
+            mime = f.get("mimeType", "")
+            if mime in _EXPORT_MIME:
+                return drive.files().export(
+                    fileId=f["id"], mimeType=_EXPORT_MIME[mime]
+                ).execute()
+            return drive.files().get_media(fileId=f["id"]).execute()
+
         def _download(self, drive: Any, f: dict) -> bytes | None:
+            # a failed file is NOT marked seen, so the next poll retries
+            # it — but never silently: every give-up is logged with the
+            # connector name, and a run of failures opens the breaker
             try:
-                mime = f.get("mimeType", "")
-                if mime in _EXPORT_MIME:
-                    return drive.files().export(
-                        fileId=f["id"], mimeType=_EXPORT_MIME[mime]
-                    ).execute()
-                return drive.files().get_media(fileId=f["id"]).execute()
-            except Exception:  # noqa: BLE001 — transient API failure: retry next poll
+                return retry.call(self._download_once, drive, f)
+            except CircuitOpen:
+                return None  # breaker already warned; skip until re-probe
+            except Exception as e:  # noqa: BLE001 — poll loop must survive
+                _LOG.warning(
+                    "connector %r: download of %r failed after "
+                    "%d attempts: %s",
+                    connector_name, f.get("name") or f.get("id"),
+                    retry.max_attempts, e,
+                )
                 return None
 
     return python_read(

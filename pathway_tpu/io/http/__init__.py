@@ -198,28 +198,37 @@ def write(
     format: str = "json",  # noqa: A002
     headers: dict[str, str] | None = None,
     n_retries: int = 0,
+    retry_policy: Any = None,
     **kwargs: Any,
 ) -> None:
+    """Per-row HTTP egress. Retries ride the unified ``pw.io.RetryPolicy``
+    — pass one via ``retry_policy`` (wins over ``n_retries``), or set
+    ``n_retries`` to get a policy with the legacy fixed 0.5 s spacing."""
     import requests as _requests
 
+    from pathway_tpu.io._retry import RetryPolicy
+
     names = table._column_names()
+    if retry_policy is None:
+        retry_policy = RetryPolicy(
+            f"http:{url}",
+            max_attempts=n_retries + 1,
+            initial_delay_ms=500,
+            backoff_factor=1.0,
+            jitter_ms=0,
+            breaker_threshold=None,
+        )
 
     def write_batch(time: int, entries: list) -> None:
         for _key, row, diff in entries:
             payload = dict(zip(names, row))
             payload["time"] = time
             payload["diff"] = diff
-            for attempt in range(n_retries + 1):
-                try:
-                    _requests.request(
-                        method, url, json=_json.loads(Json.dumps(payload)),
-                        headers=headers, timeout=30,
-                    )
-                    break
-                except Exception:  # noqa: BLE001
-                    if attempt == n_retries:
-                        raise
-                    _time.sleep(0.5)
+            retry_policy.call(
+                _requests.request,
+                method, url, json=_json.loads(Json.dumps(payload)),
+                headers=headers, timeout=30,
+            )
 
     G.add_sink("output", table, write_batch=write_batch)
 

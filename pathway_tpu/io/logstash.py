@@ -10,8 +10,6 @@ from __future__ import annotations
 
 from typing import Any
 
-from pathway_tpu.io.http import write as http_write
-
 
 def write(
     table: Any,
@@ -22,13 +20,20 @@ def write(
     request_timeout_ms: int | None = None,
 ) -> None:
     """Sends the stream of updates from the table to the HTTP input of
-    Logstash as flat JSON objects with `time` and `diff` fields."""
+    Logstash as flat JSON objects with `time` and `diff` fields.
+
+    ``retry_policy`` takes a :class:`pw.io.RetryPolicy` governing the
+    per-request retries (backoff, jitter, circuit breaker); when omitted,
+    ``n_retries`` builds the legacy fixed-spacing policy."""
+    from pathway_tpu.io.http import write as http_write
+
     http_write(
         table,
         endpoint,
         method="POST",
         format="json",
         n_retries=n_retries,
+        retry_policy=retry_policy,
         connect_timeout_ms=connect_timeout_ms,
         request_timeout_ms=request_timeout_ms,
     )

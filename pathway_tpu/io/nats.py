@@ -29,9 +29,11 @@ import threading
 import time as _time
 from typing import Any, Iterable
 
+from pathway_tpu.engine import faults
 from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._retry import RetryPolicy
 
 
 class NatsError(RuntimeError):
@@ -226,12 +228,26 @@ def read(
 
     class NatsSubject(ConnectorSubject):
         def run(self) -> None:
-            backoff = 0.2
+            # unified reconnect policy (same 0.2s->5s exponential timings
+            # the old hand-rolled loop used, now capped, jittered, and
+            # fault-injectable at io.retry.{name}); max_attempts=None:
+            # a streaming subject reconnects forever
+            policy = RetryPolicy(
+                name or f"nats:{topic}",
+                max_attempts=None,
+                initial_delay_ms=200,
+                backoff_factor=2.0,
+                max_delay_ms=5_000,
+                jitter_ms=100,
+                breaker_threshold=None,
+            )
+            delays = policy.backoffs()
             while True:
                 try:
+                    faults.check(f"io.retry.{policy.name}")
                     conn = NatsConnection(uri, name=name or "pathway-reader")
                     conn.subscribe(topic, queue_group=queue_group)
-                    backoff = 0.2
+                    delays = policy.backoffs()  # connected: reset backoff
                     while True:
                         msg = conn.next_message()
                         if msg is None:
@@ -241,8 +257,7 @@ def read(
                 except (ConnectionError, socket.timeout, OSError):
                     if terminate_on_disconnect:
                         return
-                    _time.sleep(backoff)
-                    backoff = min(backoff * 2, 5.0)
+                    _time.sleep(next(delays))
 
         def _deliver(self, payload: bytes) -> None:
             if format == "raw":

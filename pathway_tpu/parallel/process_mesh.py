@@ -28,7 +28,16 @@ import threading
 import time
 from typing import Any
 
+from pathway_tpu.engine import faults
+
 _LEN = struct.Struct("<Q")
+
+
+class WorkerLost(ConnectionError):
+    """A mesh peer's socket closed mid-run. Every barrier and the frontier
+    pump raise this instead of hanging; a supervisor
+    (parallel/supervisor.py) treats it — and the worker's own death — as
+    'restart the mesh, resume from the last committed checkpoint'."""
 
 
 class ProcessMesh:
@@ -78,6 +87,10 @@ class ProcessMesh:
         self._wm: dict[tuple[int, int], Any] = {}
         self._flags: dict[tuple[Any, int], Any] = {}
         self._dead: set[int] = set()
+        # monotone count of data frames this process ever sent: the
+        # quiesce protocol's "nothing new in flight" witness
+        # (engine/runtime.py _mesh_quiesce)
+        self.data_frames_sent = 0
         self._closed = False
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -179,6 +192,9 @@ class ProcessMesh:
                     self._cv.notify_all()
 
     def _send(self, peer: int, kind: str, payload: Any) -> None:
+        # injected wire failure: surfaces to the caller exactly like a
+        # peer socket error would (the supervisor path, not a hang)
+        faults.check("mesh.send")
         body = pickle.dumps((kind, payload), protocol=4)
         with self._send_locks[peer]:
             self._send_socks[peer].sendall(_LEN.pack(len(body)) + body)
@@ -186,6 +202,7 @@ class ProcessMesh:
     # ------------------------------------------------------------ exchange
 
     def send_bucket(self, peer: int, node_id: int, rnd: int, entries: list) -> None:
+        self.data_frames_sent += 1
         self._send(peer, "data", (node_id, rnd, entries))
 
     def recv_bucket(self, peer: int, node_id: int, rnd: int) -> list:
@@ -198,7 +215,7 @@ class ProcessMesh:
         with self._cv:
             while key not in self._data:
                 if peer in self._dead:
-                    raise ConnectionError(
+                    raise WorkerLost(
                         f"process {self.process_id}: peer {peer} died "
                         f"(waiting for node {node_id} round {rnd})"
                     )
@@ -231,7 +248,7 @@ class ProcessMesh:
             for p in self.peers:
                 while (rnd, p) not in self._ctl:
                     if p in self._dead:
-                        raise ConnectionError(
+                        raise WorkerLost(
                             f"process {self.process_id}: peer {p} died "
                             f"(control round {rnd})"
                         )
@@ -253,7 +270,7 @@ class ProcessMesh:
             for p in self.peers:
                 while (tag, p) not in self._nego:
                     if p in self._dead:
-                        raise ConnectionError(
+                        raise WorkerLost(
                             f"process {self.process_id}: peer {p} died "
                             f"(negotiating {tag!r})"
                         )
@@ -367,4 +384,4 @@ def get_mesh() -> ProcessMesh | None:
     return _MESH
 
 
-__all__ = ["ProcessMesh", "get_mesh"]
+__all__ = ["ProcessMesh", "WorkerLost", "get_mesh"]

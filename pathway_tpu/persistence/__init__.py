@@ -37,6 +37,7 @@ import os
 import time as _time
 from typing import Any
 
+from pathway_tpu.engine import faults
 from pathway_tpu.internals.keys import Key
 from pathway_tpu.persistence import codec
 
@@ -494,6 +495,17 @@ class _SegmentWriter:
     def append(self, key_value: int, row: tuple, diff: int) -> None:
         self._f.write(codec.encode_record((key_value, row, diff)))
         self.count += 1
+        if faults.fire("persistence.journal.torn"):
+            # an OS-level crash that loses the tail of a flushed-but-not-
+            # fsynced segment: leave a partial trailing frame and die.
+            # Reopen drops the torn tail (valid_prefix_len) and seekable
+            # sources re-journal the lost events from their own re-read.
+            self._f.flush()
+            pos = self._f.tell()
+            self._f.close()
+            with open(self.path, "r+b") as tf:
+                tf.truncate(max(pos - 7, len(codec.MAGIC)))
+            faults.hard_crash()
 
     def flush(self, sync: bool = False) -> None:
         self._f.flush()
@@ -521,8 +533,18 @@ class MetadataStore:
         try:
             with open(self.path) as f:
                 return _json.load(f)
-        except (OSError, ValueError):
+        except OSError:
             return None
+        except ValueError as e:
+            # the commit path is fsync-then-atomic-rename, so a crash never
+            # leaves this file torn; unparsable content means external
+            # corruption — silently treating it as "no checkpoint" would
+            # cold-start and drop committed state. Fail loudly instead.
+            raise RuntimeError(
+                f"persistence metadata {self.path} is corrupt ({e}); "
+                "restore it from a copy or clear the persistence "
+                "directory to cold-start"
+            ) from e
 
     _UNSET = object()
 
@@ -534,6 +556,7 @@ class MetadataStore:
         finalized_time: int,
         prev: "dict | None | object" = _UNSET,
         frontiers: dict | None = None,
+        op_snapshots: list[str] | None = None,
     ) -> None:
         record = {
             "epoch": epoch,
@@ -546,6 +569,11 @@ class MetadataStore:
             "frontiers": frontiers or {},
             "committed_at": _time.time(),
         }
+        if op_snapshots is not None:
+            # manifest of operator snapshots this epoch WROTE: restore
+            # distinguishes "stateless node" (absent here) from "snapshot
+            # file lost" (listed but unreadable -> fall back an epoch)
+            record["op_snapshots"] = op_snapshots
         # keep the PREVIOUS epoch's record: multi-process recovery may
         # need to roll back one epoch when peers crashed between each
         # other's commits (coordinated-recovery min-epoch negotiation).
@@ -558,10 +586,18 @@ class MetadataStore:
             record["history"] = [
                 {k: prev[k] for k in
                  ("epoch", "offsets", "signature", "finalized_time",
-                  "frontiers")
+                  "frontiers", "op_snapshots")
                  if k in prev}
             ]
-        _fsync_write(self.path, _json.dumps(record).encode())
+        blob = _json.dumps(record).encode()
+        if faults.fire("persistence.metadata.torn"):
+            # the crash the atomic rename protects against: half the
+            # record reaches the tmp file and the process dies before the
+            # rename — recovery must find the PREVIOUS record intact
+            with open(self.path + ".tmp", "wb") as f:
+                f.write(blob[: len(blob) // 2])
+            faults.hard_crash()
+        _fsync_write(self.path, blob)
 
     def clear(self) -> None:
         try:
@@ -734,27 +770,38 @@ class CheckpointManager:
                     "resume from this epoch. Clear the persistence "
                     "directories to restart."
                 )
-        if meta.get("signature") == self.signature and self.config.operator_snapshots:
-            # Phase 1 — read + validate every snapshot before touching any
-            # node: a corrupt/unreadable file falls back cleanly to journal
-            # replay because nothing has been mutated yet.
-            restored: list[tuple[Any, dict]] = []
-            readable = True
-            try:
-                for node in self.session.graph.nodes:
-                    st = self.ops.read(_persistent_id(node), int(meta["epoch"]))
-                    if st is not None:
-                        # worker-count changes re-partition here, BEFORE
-                        # any node mutates — RescaleUnsupported falls back
-                        # to journal replay cleanly
-                        restored.append((node, _adapt_shard_state(node, st)))
-            except Exception as e:  # noqa: BLE001
-                readable = False
-                self.session.graph.log_error(f"operator snapshot unreadable: {e}")
-            if readable:
+        # Candidate epochs, newest first. A negotiated epoch (multi-process
+        # rollback) is exact — peers agreed on it, no deeper fallback; the
+        # single-process default may fall back one epoch when the newest
+        # snapshots turn out lost/corrupt (compaction keeps TWO epochs of
+        # snapshots and journal back to the previous epoch's offsets for
+        # exactly this degradation rung).
+        candidates = [meta]
+        if epoch is None:
+            candidates += list(meta.get("history", []))
+        if self.config.operator_snapshots:
+            for i, rec in enumerate(candidates):
+                if rec.get("signature") != self.signature:
+                    continue
+                offs = {k: int(v) for k, v in rec["offsets"].items()}
+                if i > 0 and any(
+                    self.journal.head_offset(n) > o for n, o in offs.items()
+                ):
+                    continue  # journal no longer covers this epoch
+                restored = self._read_epoch_snapshots(rec)
+                if restored is None:
+                    continue
+                if i > 0:
+                    # logged only now that this epoch's snapshots READ —
+                    # claiming a fallback that then fails its own phase-1
+                    # validation would mislead recovery forensics
+                    self.session.graph.log_error(
+                        f"epoch {meta.get('epoch')} snapshots unusable; "
+                        f"falling back to epoch {rec.get('epoch')}"
+                    )
                 # Phase 2 — apply. A failure here leaves earlier nodes
-                # mutated; falling back to journal replay would double-count
-                # their state, so fail loudly instead.
+                # mutated; falling back to journal replay would double-
+                # count their state, so fail loudly instead.
                 applied = 0
                 try:
                     for node, st in restored:
@@ -767,24 +814,26 @@ class CheckpointManager:
                         "incompatible with this pipeline. Clear the "
                         "persistence directory or revert the change."
                     ) from e
-                self.epoch = int(meta["epoch"])
+                self.epoch = int(rec["epoch"])
                 self.restored = True
-                self._restored_offsets = offsets
-                self.restored_frontiers = dict(meta.get("frontiers", {}))
-                if epoch is not None:
-                    # rollback: rewrite the on-disk record to the agreed
-                    # epoch NOW, else the next commit would chain its
-                    # history and journal-compaction floor off the stale
-                    # pre-crash record (unrecoverable on a second crash)
+                self._restored_offsets = offs
+                self.restored_frontiers = dict(rec.get("frontiers", {}))
+                if epoch is not None or i > 0:
+                    # rollback OR history fallback: rewrite the on-disk
+                    # record to the epoch actually restored NOW, else the
+                    # next commit would chain its history and journal-
+                    # compaction floor off the stale newer record
+                    # (unrecoverable on a second crash)
                     self.metadata.commit(
                         self.epoch,
-                        offsets,
-                        str(meta.get("signature")),
-                        int(meta.get("finalized_time", 0)),
+                        offs,
+                        str(rec.get("signature")),
+                        int(rec.get("finalized_time", 0)),
                         prev=None,
                         frontiers=self.restored_frontiers,
+                        op_snapshots=rec.get("op_snapshots"),
                     )
-                return offsets
+                return offs
         # fall back to full journal replay — only sound if the head exists
         for name in offsets:
             head = self.journal.head_offset(name)
@@ -803,6 +852,38 @@ class CheckpointManager:
             self.metadata.clear()
             self.epoch = 0
         return {name: 0 for name in offsets}
+
+    def _read_epoch_snapshots(
+        self, rec: dict
+    ) -> list[tuple[Any, dict]] | None:
+        """Phase 1 of restore: read + validate every snapshot of `rec`'s
+        epoch before touching any node, so failure falls back cleanly
+        (nothing has been mutated). Returns None when the epoch is
+        unusable: a snapshot is corrupt, un-adaptable, or listed in the
+        epoch's manifest but missing on disk."""
+        epoch = int(rec["epoch"])
+        manifest = rec.get("op_snapshots")
+        restored: list[tuple[Any, dict]] = []
+        try:
+            for node in self.session.graph.nodes:
+                pid = _persistent_id(node)
+                st = self.ops.read(pid, epoch)
+                if st is None:
+                    if manifest is not None and pid in manifest:
+                        raise RuntimeError(
+                            f"operator snapshot {pid}.{epoch} is listed in "
+                            "the epoch manifest but missing on disk"
+                        )
+                    continue  # stateless node: never snapshotted
+                # worker-count changes re-partition here, BEFORE any node
+                # mutates — RescaleUnsupported falls back cleanly
+                restored.append((node, _adapt_shard_state(node, st)))
+        except Exception as e:  # noqa: BLE001
+            self.session.graph.log_error(
+                f"operator snapshot unreadable (epoch {epoch}): {e}"
+            )
+            return None
+        return restored
 
     # --------------------------------------------------------- journaling
 
@@ -852,19 +933,33 @@ class CheckpointManager:
         # 2. operator snapshots for the next epoch
         epoch = self.epoch + 1
         wrote_ops = False
+        op_manifest: list[str] = []
         if self.config.operator_snapshots:
             wrote_ops = True
             for node in self.session.graph.nodes:
                 st = node.persist_state()
                 if st is not None:
-                    self.ops.write(_persistent_id(node), epoch, st)
+                    pid = _persistent_id(node)
+                    op_manifest.append(pid)
+                    if faults.fire("persistence.snapshot.skip"):
+                        # injected lost-snapshot: the file never lands but
+                        # the manifest still lists it — restore must
+                        # detect the hole and fall back an epoch
+                        continue
+                    self.ops.write(pid, epoch, st)
+        # crash window A: snapshots written, metadata not committed —
+        # recovery must resume from the PREVIOUS epoch untouched
+        faults.crash("persistence.checkpoint.pre_commit")
         # 3. metadata commit (the linearization point)
         prev_record = self.metadata.load()
         self.metadata.commit(
             epoch, offsets, self.signature, finalized_time, prev=prev_record,
-            frontiers=frontiers,
+            frontiers=frontiers, op_snapshots=sorted(op_manifest),
         )
         self.epoch = epoch
+        # crash window B: committed but not compacted — recovery resumes
+        # from THIS epoch; stale epoch-(N-1) artifacts are inert
+        faults.crash("persistence.checkpoint.post_commit")
         # 4. compaction — keep TWO epochs of snapshots and the journal
         # back to the previous epoch's offsets, so multi-process recovery
         # can roll back one epoch when peers crashed between commits

@@ -1,0 +1,364 @@
+#!/usr/bin/env python
+"""Crash-recovery equivalence drills: the exactly-once claim, regression-tested.
+
+For a matrix of seeded fault schedules × fault kinds, this harness runs
+the SAME streaming pipeline (a journaled python source → groupby counts →
+a batched device-plane UDF → subscribe sink) three ways:
+
+  1. fault-free baseline (``PATHWAY_FAULTS=0``),
+  2. with an injected fault — crash mid-wave, torn metadata commit,
+     truncated journal segment, lost operator snapshot, flapping
+     connector reads, failing device dispatches,
+  3. (for crash kinds) a recovery generation that resumes from the same
+     persistence directory.
+
+and asserts the **consolidated final output table is byte-identical** to
+the baseline's — the persistence layer's exactly-once contract, the
+connector retry policy, and the device plane's degradation ladder, all
+proven against deterministic failures (engine/faults.py).
+
+Usage::
+
+    python scripts/chaos_drill.py --quick          # 4 kinds x 1 seed (CI leg)
+    python scripts/chaos_drill.py                  # 6 kinds x 3 seeds
+    python scripts/chaos_drill.py --kinds torn_metadata --seeds 0,1,2
+    python scripts/chaos_drill.py --json /tmp/chaos.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CRASH_EXIT = 17  # engine/faults.py CRASH_EXIT_CODE
+
+# --------------------------------------------------------------- workload
+#
+# One pipeline exercising every failure domain: a paced seekable source
+# whose reads go through pw.io.RetryPolicy (connector domain), journaled
+# persistence with operator snapshots (persistence domain), a groupby
+# (operator state), and a batched UDF dispatching through a DevicePlane
+# program (device domain). Deliveries append to a jsonl the harness
+# consolidates across crash generations.
+
+WORKLOAD = textwrap.dedent(
+    """
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import pathway_tpu as pw
+    from pathway_tpu.engine.device_plane import DeviceProgram, get_device_plane
+    from pathway_tpu.io import RetryPolicy
+    from pathway_tpu.io.python import ConnectorSubject
+
+    PDIR, OUT, N_EVENTS = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    SPEC = os.environ.get("PATHWAY_FAULTS", "0")
+
+    DeviceProgram.PROBE_BASE_S = 0.01  # drill-speed re-probe backoff
+    plane = get_device_plane()
+    prog = plane.program("chaos_double", lambda x: x * 2 + 1)
+
+    @pw.udf(batched=True, deterministic=True)
+    def boost(vs: list[int]) -> list[int]:
+        arr = np.asarray(vs, dtype=np.int32)
+        b = plane.buckets.rows_bucket(len(arr))
+        out = prog(np.pad(arr, (0, b - len(arr))), bucket=b)
+        return [int(x) for x in np.asarray(out)[: len(arr)]]
+
+    src_policy = RetryPolicy(
+        "chaos-src", max_attempts=10, initial_delay_ms=1,
+        backoff_factor=1.0, jitter_ms=0, breaker_threshold=None,
+    )
+
+    def committed_offset() -> int:
+        try:
+            with open(os.path.join(PDIR, "metadata.json")) as f:
+                return int(json.load(f).get("offsets", {{}}).get("words", 0))
+        except Exception:
+            return 0
+
+    class Words(ConnectorSubject):
+        def run(self):
+            import time
+            for i in range(N_EVENTS):
+                # the injectable read: io.retry.chaos-src faults land
+                # here and the unified policy absorbs them
+                w = src_policy.call(lambda i=i: f"w{{i % 7}}")
+                self.next(word=w)
+                time.sleep(0.004)
+                if i % 10 == 9:
+                    # deterministic mid-run epochs: stall until a commit
+                    # covers everything emitted so far (in-flight device
+                    # holds resolve, the cadence checkpoint cuts). Time-
+                    # based gaps are flaky on slow CI boxes — the commit
+                    # count then varies and seeded @hit schedules miss.
+                    deadline = time.monotonic() + 5.0
+                    while (
+                        committed_offset() < i + 1
+                        and time.monotonic() < deadline
+                    ):
+                        time.sleep(0.002)
+
+    t = pw.io.python.read(
+        Words(), schema=pw.schema_from_types(word=str), name="words"
+    )
+    counts = t.groupby(t.word).reduce(
+        t.word, count=pw.reducers.count()
+    )
+    counts = counts.select(
+        counts.word, counts.count, boosted=boost(counts.count)
+    )
+    sink = open(OUT, "a")
+    # newline guard: a previous generation's hard crash may have left a
+    # torn final line; without this, the first record of THIS generation
+    # would concatenate onto it and both would be lost
+    sink.write("\\n")
+    def on_change(key, row, time, is_addition):
+        sink.write(json.dumps({{
+            "w": row["word"], "c": row["count"], "b": row["boosted"],
+            "add": is_addition,
+        }}) + "\\n")
+        sink.flush()
+    pw.io.subscribe(counts, on_change=on_change)
+    pw.run(persistence_config=pw.persistence.Config(
+        pw.persistence.Backend.filesystem(PDIR)))
+
+    # a non-crash fault schedule must actually have exercised its domain
+    if "io.retry.chaos-src" in SPEC:
+        assert src_policy.retries_total > 0, "flap schedule never flapped"
+    if "device.dispatch" in SPEC:
+        assert prog.host_fallbacks > 0, "device schedule never degraded"
+    """
+)
+
+
+# ------------------------------------------------------------ fault kinds
+#
+# Hit numbers are seeded so each seed crashes at a different wave /
+# commit / journal offset; all stay comfortably inside the run's hit
+# budget (~25+ pumped waves, N_EVENTS journal appends, and — thanks to
+# the source's wait-for-commit pacing — at least N_EVENTS/10 + 2
+# checkpoint commits).
+
+KINDS = {
+    "crash_mid_wave": lambda seed: f"seed={seed};runtime.wave@{3 + 3 * seed}",
+    "torn_metadata": lambda seed: (
+        f"seed={seed};persistence.metadata.torn@{2 + seed}"
+    ),
+    "torn_journal": lambda seed: (
+        f"seed={seed};persistence.journal.torn@{10 + 9 * seed}"
+    ),
+    # crash right AFTER a mid-run commit, then the harness deletes one of
+    # that epoch's snapshot files: restore must catch the manifest hole
+    # and fall back to the history epoch
+    "lost_snapshot": lambda seed: (
+        f"seed={seed};persistence.checkpoint.post_commit@{2 + seed}"
+    ),
+    "connector_flap": lambda seed: f"seed={seed};io.retry.chaos-src~0.25",
+    "device_dispatch": lambda seed: (
+        f"seed={seed};device.dispatch.chaos_double@1+2"
+    ),
+}
+CRASH_KINDS = {"crash_mid_wave", "torn_metadata", "torn_journal", "lost_snapshot"}
+QUICK_KINDS = ["crash_mid_wave", "torn_metadata", "connector_flap", "device_dispatch"]
+MAX_GENERATIONS = 4  # a schedule may land a crash in the recovery window
+
+
+def _run_workload(pdir: str, out: str, spec: str, n_events: int) -> int:
+    r = subprocess.run(
+        [sys.executable, "-c", WORKLOAD.format(repo=REPO),
+         pdir, out, str(n_events)],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PATHWAY_FAULTS": spec},
+    )
+    if r.returncode not in (0, CRASH_EXIT):
+        raise RuntimeError(
+            f"workload failed rc={r.returncode} (spec={spec!r}):\n"
+            + r.stderr[-3000:]
+        )
+    return r.returncode
+
+
+def consolidate(deliveries_path: str) -> bytes:
+    """Canonical bytes of the final output table: consolidate the
+    add/remove delivery stream (possibly spanning crash generations)
+    into final rows, sorted, compact JSON."""
+    state: dict[str, tuple] = {}
+    if os.path.exists(deliveries_path):
+        with open(deliveries_path) as f:
+            for line in f:
+                if not line.strip():
+                    continue  # generation-boundary newline guard
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue  # torn line from a hard crash
+                if ev["add"]:
+                    state[ev["w"]] = (ev["c"], ev["b"])
+                elif state.get(ev["w"]) == (ev["c"], ev["b"]):
+                    del state[ev["w"]]
+    rows = sorted((w, c, b) for w, (c, b) in state.items())
+    return json.dumps(rows, separators=(",", ":")).encode()
+
+
+def _tamper_lost_snapshot(pdir: str, seed: int) -> str:
+    """Simulate a lost operator-snapshot file: delete one snapshot of the
+    newest committed epoch (seed picks which). Restore must detect the
+    manifest hole and fall back one epoch."""
+    with open(os.path.join(pdir, "metadata.json")) as f:
+        epoch = int(json.load(f)["epoch"])
+    op_dir = os.path.join(pdir, "operator")
+    files = sorted(
+        fn for fn in os.listdir(op_dir) if fn.endswith(f".{epoch}.state")
+    )
+    if not files:
+        return f"epoch {epoch} had no snapshots to lose"
+    victim = files[seed % len(files)]
+    os.unlink(os.path.join(op_dir, victim))
+    return f"deleted {victim} (epoch {epoch})"
+
+
+def run_case(kind: str, seed: int, n_events: int, workdir: str) -> dict:
+    """One drill: fault run (+ recovery generations) in a fresh
+    persistence dir; returns the case record incl. canonical output."""
+    pdir = os.path.join(workdir, f"{kind}-s{seed}-pdir")
+    out = os.path.join(workdir, f"{kind}-s{seed}-deliveries.jsonl")
+    spec = KINDS[kind](seed)
+    t0 = time.monotonic()
+    rc = _run_workload(pdir, out, spec, n_events)
+    generations = 1
+    note = ""
+    if kind in CRASH_KINDS:
+        assert rc == CRASH_EXIT, (
+            f"{kind} seed {seed}: schedule {spec!r} never crashed (rc={rc})"
+        )
+        if kind == "lost_snapshot":
+            note = _tamper_lost_snapshot(pdir, seed)
+        # recovery generations run fault-free (a hit-count schedule would
+        # deterministically re-fire the same crash); a crash landing in
+        # an earlier recovery window is itself recovered from
+        while rc == CRASH_EXIT:
+            if generations > MAX_GENERATIONS:
+                raise AssertionError(f"{kind} seed {seed}: kept crashing")
+            rc = _run_workload(pdir, out, "0", n_events)
+            generations += 1
+    assert rc == 0, f"{kind} seed {seed}: final generation rc={rc}"
+    return {
+        "kind": kind,
+        "seed": seed,
+        "spec": spec,
+        "generations": generations,
+        "seconds": round(time.monotonic() - t0, 2),
+        "note": note,
+        "output": consolidate(out).decode(),
+    }
+
+
+def run_matrix(
+    kinds: list[str], seeds: list[int], n_events: int = 50,
+    workdir: str | None = None,
+) -> dict:
+    own = workdir is None
+    if own:
+        workdir = tempfile.mkdtemp(prefix="pathway-chaos-")
+    assert workdir is not None
+    try:
+        return _run_matrix(kinds, seeds, n_events, workdir)
+    finally:
+        if own:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _run_matrix(
+    kinds: list[str], seeds: list[int], n_events: int, workdir: str
+) -> dict:
+    t0 = time.monotonic()
+    base_pdir = os.path.join(workdir, "baseline-pdir")
+    base_out = os.path.join(workdir, "baseline-deliveries.jsonl")
+    rc = _run_workload(base_pdir, base_out, "0", n_events)
+    assert rc == 0, f"baseline rc={rc}"
+    baseline = consolidate(base_out)
+    assert baseline != b"[]", "baseline produced no output"
+    cases = []
+    failures = []
+    for kind in kinds:
+        for seed in seeds:
+            case = run_case(kind, seed, n_events, workdir)
+            case["equivalent"] = case["output"].encode() == baseline
+            cases.append(case)
+            if not case["equivalent"]:
+                failures.append(
+                    f"{kind} seed {seed}: output diverged from baseline\n"
+                    f"  baseline: {baseline.decode()}\n"
+                    f"  got:      {case['output']}"
+                )
+            status = "OK " if case["equivalent"] else "FAIL"
+            print(
+                f"[{status}] {kind:16s} seed={seed} "
+                f"gen={case['generations']} {case['seconds']:.1f}s "
+                f"spec={case['spec']!r}"
+                + (f" ({case['note']})" if case["note"] else "")
+            )
+    report = {
+        "ok": not failures,
+        "baseline": baseline.decode(),
+        "kinds": kinds,
+        "seeds": seeds,
+        "n_events": n_events,
+        "cases": cases,
+        "seconds": round(time.monotonic() - t0, 1),
+    }
+    if failures:
+        report["failures"] = failures
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="4 kinds x 1 seed (the tier-1 CI leg, <=60s)")
+    ap.add_argument("--kinds", default=None,
+                    help=f"comma list from {sorted(KINDS)}")
+    ap.add_argument("--seeds", default=None, help="comma list of ints")
+    ap.add_argument("--events", type=int, default=50)
+    ap.add_argument("--json", dest="json_out", default=None)
+    args = ap.parse_args()
+    if args.quick:
+        kinds = QUICK_KINDS
+        seeds = [0]
+    else:
+        kinds = sorted(KINDS)
+        seeds = [0, 1, 2]
+    if args.kinds:
+        kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
+        for k in kinds:
+            if k not in KINDS:
+                ap.error(f"unknown kind {k!r} (have {sorted(KINDS)})")
+    if args.seeds:
+        seeds = [int(s) for s in args.seeds.split(",")]
+    report = run_matrix(kinds, seeds, n_events=args.events)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=2)
+    print(
+        f"chaos drill: {len(report['cases'])} cases, "
+        f"{'ALL EQUIVALENT' if report['ok'] else 'FAILURES'} "
+        f"in {report['seconds']}s"
+    )
+    if not report["ok"]:
+        for f_ in report["failures"]:
+            print(f_, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

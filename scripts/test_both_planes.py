@@ -8,6 +8,10 @@ Leg 3 (workers-1x4): the worker-count invariance suite under BOTH
 PATHWAY_THREADS=1 and =4 in the same leg — sharded-operator exchange and
 the frontier scheduler's out-of-order firing must keep results
 worker-count invariant (pins frontier-reordering regressions).
+Leg 4 (chaos-quick): the fast crash-recovery equivalence drill
+(scripts/chaos_drill.py --quick, 4 fault kinds x 1 seed) — a crashed,
+torn, flapped, or degraded run must recover to output byte-identical to
+the fault-free baseline (docs/robustness.md).
 
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
@@ -60,7 +64,48 @@ def run_leg(
         "seconds": round(time.time() - t0, 1),
         "summary": tail,
     }
+    # name the failures: later legs overwrite the pytest cache, so the
+    # record here is the only trace of WHICH test failed in this leg
+    fails = re.findall(r"^(?:FAILED|ERROR) (\S+)", r.stdout, re.MULTILINE)
+    if fails:
+        leg["failures"] = fails
     print(f"[{name}] {tail}")
+    for t in fails:
+        print(f"[{name}]   FAILED {t}")
+    return leg
+
+
+def run_chaos_leg() -> dict:
+    """The --quick equivalence drill as its own leg: subprocess-driven
+    (the drill spawns workload processes itself), JSON-report parsed."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PATHWAY_FAULTS": "0"}
+    report_path = os.path.join(REPO, ".chaos_quick_report.json")
+    t0 = time.time()
+    r = subprocess.run(
+        [sys.executable, "scripts/chaos_drill.py", "--quick",
+         "--json", report_path],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    cases = equivalent = 0
+    try:
+        with open(report_path) as fh:
+            rep = json.load(fh)
+        cases = len(rep.get("cases", []))
+        equivalent = sum(1 for c in rep["cases"] if c.get("equivalent"))
+        os.unlink(report_path)
+    except (OSError, ValueError, KeyError):
+        pass
+    tail = (r.stdout.strip().splitlines() or [""])[-1]
+    leg = {
+        "leg": "chaos-quick",
+        "rc": r.returncode,
+        "passed": equivalent,
+        "skipped": 0,
+        "failed": cases - equivalent,
+        "seconds": round(time.time() - t0, 1),
+        "summary": tail,
+    }
+    print(f"[chaos-quick] {tail}")
     return leg
 
 
@@ -76,6 +121,7 @@ def main() -> int:
         # must not leak into results either way
         run_leg("workers-t1", {"PATHWAY_THREADS": "1"}, extra, INVARIANCE_PATHS),
         run_leg("workers-t4", {"PATHWAY_THREADS": "4"}, extra, INVARIANCE_PATHS),
+        run_chaos_leg(),
     ]
     ok = all(l["rc"] == 0 and l["failed"] == 0 and l["passed"] > 0 for l in legs)
     dirty = bool(

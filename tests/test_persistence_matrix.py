@@ -1,24 +1,37 @@
 """Persistence matrix: codec round-trips for every engine value type,
 checkpoint contents across operator kinds, snapshot isolation between
-named pipelines, and journal compaction invariants (reference tier-2:
-persistence integration tests)."""
+named pipelines, journal compaction invariants, and the corruption-mode
+matrix — truncated journal segments, torn metadata commits, and
+snapshot/metadata epoch mismatches each recover (or fail loudly per the
+documented fallback ladder in docs/robustness.md)."""
 
 from __future__ import annotations
+
+import os
 
 import pytest
 
 import pathway_tpu as pw
+from pathway_tpu.engine import faults
 from pathway_tpu.internals.keys import key_for_values
 from pathway_tpu.internals.lowering import Session
 from pathway_tpu.internals.parse_graph import G
-from pathway_tpu.persistence import Backend, CheckpointManager, Config
+from pathway_tpu.persistence import (
+    Backend,
+    CheckpointManager,
+    Config,
+    MetadataStore,
+    SegmentedJournal,
+)
 
 
 @pytest.fixture(autouse=True)
 def _fresh_graph():
     G.clear()
+    faults.reset()
     yield
     G.clear()
+    faults.reset()
 
 
 # ----------------------------------------------------------------- codec
@@ -179,3 +192,166 @@ def test_snapshot_files_created_and_reusable(tmp_path):
         m2.restore()
         assert m2.restored
         assert {tuple(r) for r in cap.state.rows.values()} == {("a", 2)}
+
+
+# ------------------------------------------------------ corruption modes
+#
+# Each failure mode from the recovery contract's fallback ladder
+# (docs/robustness.md): the layer must either recover to correct state or
+# refuse loudly — never silently drop or double-count committed events.
+
+
+class _SimulatedCrash(BaseException):
+    """Stands in for faults.hard_crash's os._exit in-process."""
+
+
+@pytest.fixture()
+def _crash_raises(monkeypatch):
+    def boom():
+        raise _SimulatedCrash()
+
+    monkeypatch.setattr(faults, "hard_crash", boom)
+
+
+def test_truncated_journal_tail_drops_torn_records_only(tmp_path):
+    """An OS crash can lose the tail of a flushed-but-not-fsynced segment
+    mid-record. Readers must stop at the valid prefix, and a reopening
+    writer must truncate the torn frame BEFORE appending — otherwise new
+    events land beyond where every reader stops, silently unreadable."""
+    j = SegmentedJournal(str(tmp_path))
+    w = j.open_segment("src", 0)
+    for i in range(5):
+        w.append(i, (f"row{i}",), 1)
+    w.flush(sync=True)
+    w.close()
+    path = os.path.join(str(tmp_path), "src.0.seg")
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 3)  # torn mid-record
+    got = j.load_from("src", 0)
+    assert [kv for (_off, kv, _row, _d) in got] == [0, 1, 2, 3]
+    # reopen + append: the torn tail is dropped, the new record is readable
+    w2 = j.open_segment("src", 0)
+    w2.append(99, ("replayed",), 1)
+    w2.flush(sync=True)
+    w2.close()
+    got = j.load_from("src", 0)
+    assert [kv for (_off, kv, _row, _d) in got] == [0, 1, 2, 3, 99]
+    assert [off for (off, *_rest) in got] == list(range(5))
+
+
+def test_journal_torn_fault_injection_matches_real_crash(tmp_path, _crash_raises):
+    """The persistence.journal.torn injection point must produce exactly
+    the damage the recovery path is built for: a torn trailing frame."""
+    faults.install("persistence.journal.torn@3")
+    j = SegmentedJournal(str(tmp_path))
+    w = j.open_segment("src", 0)
+    with pytest.raises(_SimulatedCrash):
+        for i in range(5):
+            w.append(i, (f"row{i}",), 1)
+    # the third record's frame is torn: only the first two survive a read
+    assert [kv for (_off, kv, _r, _d) in j.load_from("src", 0)] == [0, 1]
+
+
+def test_torn_metadata_commit_preserves_previous_record(tmp_path, _crash_raises):
+    """A crash between the tmp-file write and the atomic rename must leave
+    the previous epoch's record untouched — recovery resumes from it."""
+    store = MetadataStore(str(tmp_path))
+    store.commit(1, {"src": 10}, "sig", 5, prev=None)
+    faults.install("persistence.metadata.torn@1")
+    with pytest.raises(_SimulatedCrash):
+        store.commit(2, {"src": 20}, "sig", 9, prev=store.load())
+    # the torn half-record sits in the tmp file, never renamed over
+    assert os.path.exists(store.path + ".tmp")
+    rec = MetadataStore(str(tmp_path)).load()
+    assert rec is not None
+    assert rec["epoch"] == 1 and rec["offsets"] == {"src": 10}
+
+
+def test_corrupt_metadata_content_fails_loudly(tmp_path):
+    """metadata.json is written fsync-then-rename, so torn content can
+    only mean external corruption. Treating it as 'no checkpoint' would
+    silently cold-start and drop committed state — it must raise."""
+    store = MetadataStore(str(tmp_path))
+    store.commit(1, {"src": 10}, "sig", 5, prev=None)
+    with open(store.path, "w") as f:
+        f.write('{"epoch": 1, "offsets": {')
+    with pytest.raises(RuntimeError, match="corrupt"):
+        MetadataStore(str(tmp_path)).load()
+
+
+def _two_epoch_checkpoint(tmp_path):
+    """A groupby pipeline checkpointed twice: epoch 2 current, epoch 1 in
+    the metadata history (compaction keeps both epochs' snapshots)."""
+
+    def build():
+        return (
+            pw.debug.table_from_rows(
+                pw.schema_from_types(g=str, v=int),
+                [("a", 1), ("b", 2), ("a", 3)],
+            )
+            .groupby(pw.this.g)
+            .reduce(g=pw.this.g, s=pw.reducers.sum(pw.this.v))
+        )
+
+    root = str(tmp_path / "p")
+    s = Session()
+    s.capture(build())
+    s.execute()
+    m = CheckpointManager(s, Config(Backend.filesystem(root)))
+    m.checkpoint(finalized_time=10)
+    m.checkpoint(finalized_time=20)
+    meta = m.metadata.load()
+    assert meta["epoch"] == 2 and meta["history"][0]["epoch"] == 1
+    assert meta["op_snapshots"], "manifest must list the stateful nodes"
+    return build, root, meta
+
+
+def _restore_fresh(build, root):
+    G.clear()
+    s = Session()
+    cap = s.capture(build())
+    m = CheckpointManager(s, Config(Backend.filesystem(root)))
+    m.restore()
+    return cap, m
+
+
+def test_missing_manifest_snapshot_falls_back_one_epoch(tmp_path):
+    """Epoch N's metadata lists a snapshot that is gone from disk (the
+    mismatch a torn multi-file checkpoint push leaves behind): restore
+    must detect the manifest hole and fall back to epoch N-1 — and
+    rewrite the on-disk record so the next commit chains off the epoch
+    actually restored."""
+    build, root, meta = _two_epoch_checkpoint(tmp_path)
+    victim = meta["op_snapshots"][0]
+    os.unlink(os.path.join(root, "operator", f"{victim}.2.state"))
+    cap, m = _restore_fresh(build, root)
+    assert m.restored and m.epoch == 1
+    assert MetadataStore(root).load()["epoch"] == 1
+    assert {tuple(r) for r in cap.state.rows.values()} == {("a", 4), ("b", 2)}
+
+
+def test_corrupt_snapshot_content_falls_back_one_epoch(tmp_path):
+    """A snapshot file that exists but fails its record CRC is as gone as
+    a missing one: phase-1 validation rejects the epoch before any node
+    state mutates, and restore falls back to the history epoch."""
+    build, root, meta = _two_epoch_checkpoint(tmp_path)
+    victim = meta["op_snapshots"][0]
+    with open(os.path.join(root, "operator", f"{victim}.2.state"), "wb") as f:
+        f.write(b"\x00garbage, not a typed-binary record")
+    cap, m = _restore_fresh(build, root)
+    assert m.restored and m.epoch == 1
+    assert {tuple(r) for r in cap.state.rows.values()} == {("a", 4), ("b", 2)}
+
+
+def test_every_epoch_unusable_degrades_to_journal_replay(tmp_path):
+    """Both snapshot epochs corrupt: the last rung of the ladder is full
+    journal replay (a recompute for journal-less static pipelines) — the
+    restore reports NOT restored rather than applying bad state."""
+    build, root, meta = _two_epoch_checkpoint(tmp_path)
+    op_dir = os.path.join(root, "operator")
+    for fn in os.listdir(op_dir):
+        with open(os.path.join(op_dir, fn), "wb") as f:
+            f.write(b"corrupt")
+    _cap, m = _restore_fresh(build, root)
+    assert not m.restored and m.epoch == 0
