@@ -475,6 +475,101 @@ assert seen[0] >= N_Q, seen[0]
 print("ROWS_PER_SEC", {n} / (time.time() - t0))
 """
 
+# Iterate-scope rungs (PR 5): incremental pagerank through pw.iterate on
+# the token-resident nested scope (engine/runtime.py IterateNode,
+# docs/iterate.md). The graph is a disjoint-cluster forest so the warm
+# 1-edge update exercises the O(affected) re-convergence claim: only the
+# touched cluster's fixpoint re-runs, measured as pagerank_update_ms.
+# Cold rate counts input edges over the full cold fixpoint (exact float
+# convergence, no iteration-limit truncation).
+_PAGERANK_SCRIPT = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import pathway_tpu as pw
+from pathway_tpu.stdlib.graphs import pagerank
+
+N_C, K, DEG = {n_clusters}, {k}, {deg}
+rng = np.random.default_rng(17)
+rows, seen = [], set()
+for c in range(N_C):
+    base = c * K
+    for i in range(K):
+        for _ in range(DEG):
+            u, v = base + i, base + int(rng.integers(0, K))
+            if u == v or (u, v) in seen:
+                continue
+            seen.add((u, v))
+            rows.append(("v%06d" % u, "v%06d" % v, 2, 1))
+N_E = len(rows)
+# warm update at t=4: one fresh edge INSIDE cluster 0 — every other
+# cluster's fixpoint is untouched and must emit nothing
+rows.append(("x_new_src", "v000000", 4, 1))
+wall = {{}}
+t0 = time.time()
+edges0 = pw.debug.table_from_rows(
+    pw.schema_from_types(u=str, v=str), rows, is_stream=True)
+edges = edges0.with_id_from(pw.this.u, pw.this.v)
+ranks = pagerank(edges, steps=5000)
+pw.io.subscribe(
+    ranks, on_time_end=lambda t: wall.__setitem__(t, time.perf_counter()))
+pw.run()
+total = time.time() - t0
+ts = sorted(wall)
+assert len(ts) == 2, ts  # cold wave + update wave, fully converged each
+update_ms = (wall[ts[-1]] - wall[ts[-2]]) * 1000.0
+print("PAGERANK", N_E / total, update_ms)
+"""
+
+
+def _run_pagerank_once(repo: str, env_extra: dict) -> tuple[float, float]:
+    env = dict(os.environ)
+    env.update(env_extra)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", _XLA_CACHE)
+    script = _PAGERANK_SCRIPT.format(repo=repo, n_clusters=50, k=40, deg=6)
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, env=env, timeout=1800,
+    )
+    for line in r.stdout.splitlines():
+        if line.startswith("PAGERANK"):
+            _tag, rate, upd = line.split()
+            return float(rate), float(upd)
+    raise RuntimeError(
+        f"pagerank bench failed: {r.stdout[-500:]} {r.stderr[-2000:]}"
+    )
+
+
+def bench_pagerank(repo: str, stats: dict) -> dict:
+    out: dict = {}
+    for leg, env_extra in (
+        ("", {"PATHWAY_THREADS": "1"}),
+        ("_python", {"PATHWAY_THREADS": "1", "PATHWAY_TPU_NATIVE": "0"}),
+    ):
+        trials = [
+            _run_pagerank_once(repo, env_extra) for _ in range(_ENGINE_TRIALS)
+        ]
+        rates = [t[0] for t in trials]
+        upds = [t[1] for t in trials]
+        out[f"pagerank{leg}_rows_per_sec"] = round(float(np.median(rates)), 1)
+        out[f"pagerank{leg}_update_ms"] = round(float(np.median(upds)), 1)
+        stats[f"pagerank{leg}_rows_per_sec"] = {
+            "median": round(float(np.median(rates)), 1),
+            "best": round(max(rates), 1),
+            "trials": [round(x, 1) for x in rates],
+        }
+        stats[f"pagerank{leg}_update_ms"] = {
+            "median": round(float(np.median(upds)), 1),
+            "best": round(min(upds), 1),
+            "trials": [round(x, 1) for x in upds],
+        }
+    out["pagerank_native_vs_python"] = round(
+        out["pagerank_rows_per_sec"] / out["pagerank_python_rows_per_sec"], 2
+    )
+    return out
+
+
 _WINDOW_SCRIPT = r"""
 import sys, time
 sys.path.insert(0, {repo!r})
@@ -1031,6 +1126,9 @@ def bench_dataflow(repo: str) -> dict:
             ),
             1,
         )
+    # iterate-scope rungs (pw.iterate pagerank: cold fixpoint + warm
+    # 1-edge re-convergence), native-vs-object split included
+    out.update(bench_pagerank(repo, stats))
     out["stats"] = stats
     return out
 

@@ -55,6 +55,18 @@ def _nb_type():
     return NativeBatch
 
 
+def iterate_native_on() -> bool:
+    """Token-resident iterate scope gate: the data plane is up AND the
+    PATHWAY_ITERATE_NATIVE kill switch (bit-identical A/B vs the object
+    plumbing; docs/iterate.md) is not set to 0."""
+    import os
+
+    return (
+        _nb_type() is not None
+        and os.environ.get("PATHWAY_ITERATE_NATIVE", "1") != "0"
+    )
+
+
 # ------------------------------------------------------------------ hashing
 
 
@@ -1120,6 +1132,41 @@ def _kv_cols(kvs) -> tuple[np.ndarray, np.ndarray]:
     return lo, hi
 
 
+def nks_decode(nstate, tab) -> KeyedState:
+    """Decode a NativeKeyedState (key128 -> token) into the object-form
+    KeyedState (Key -> row) — the shared demote/snapshot conversion of
+    the token-resident iterate scope (capture states, fed mirrors)."""
+    ks = KeyedState()
+    lo, hi, tok = nstate.items_arrays()
+    tl = tok.tolist()
+    for i, kv in enumerate(_kvs_of(lo, hi)):
+        ks.rows[Key(kv)] = tab.row(tl[i])
+    return ks
+
+
+def nks_encode(rows: dict, tab):
+    """Encode {Key: row} into a fresh NativeKeyedState (restore path);
+    None when any row is not plane-representable (caller demotes)."""
+    from pathway_tpu.engine import native as _nat
+
+    items = list(rows.items())
+    n = len(items)
+    lo = np.empty(n, np.uint64)
+    hi = np.empty(n, np.uint64)
+    tok = np.empty(n, np.uint64)
+    for i, (key, row) in enumerate(items):
+        t = tab.intern_row(row)
+        if t is None:
+            return None
+        kv = key.value
+        lo[i] = kv & _MASK64
+        hi[i] = kv >> 64
+        tok[i] = t
+    st = _nat.NativeKeyedState()
+    st.update(lo, hi, tok, np.ones(n, np.int64))
+    return st
+
+
 class _Key128Set:
     """Set of 128-bit keys as numpy void16 cells: O(1) amortized bulk
     adds, vectorized membership, bigints only on demand (demote/
@@ -1508,10 +1555,18 @@ class ReindexNode(Node):
         inp: Node,
         key_fn: Callable[[Key, tuple], Key],
         native_cols: list[int] | None = None,
+        native_key_col: int | None = None,
+        native_salt: int | None = None,
     ):
         super().__init__(graph, [inp])
         self.key_fn = key_fn
         self.native_cols = native_cols
+        # with_id(<pointer column>): the new key IS the column's key128 —
+        # bulk-decoded in C (dp_decode_key_col), rows whose column holds a
+        # non-Key value fall back to the exact per-row path
+        self.native_key_col = native_key_col
+        # concat_reindex's per-input salt: new key = blake(key, salt) in C
+        self.native_salt = native_salt
 
     def _rekey_object(self, entries: list[Entry]) -> list[Entry]:
         out: list[Entry] = []
@@ -1524,8 +1579,29 @@ class ReindexNode(Node):
             out.append((nk, row, diff))
         return out
 
+    def _rekey_batch(self, dp, b):
+        """(lo, hi, fallback_mask) for one batch, or None (materialize)."""
+        if self.native_salt is not None:
+            lo, hi = dp.rekey_salt(b.key_lo, b.key_hi, self.native_salt)
+            return lo, hi, np.zeros(len(b), bool)
+        if self.native_key_col is not None:
+            res = dp.decode_key_col(b.tab, b.token, self.native_key_col)
+            if res is None:
+                return None
+            lo, hi, st = res
+            return lo, hi, st != 0
+        res = dp.rekey(b.tab, b.token, self.native_cols)
+        if res is None:
+            return None
+        lo, hi = res
+        return lo, hi, (lo == 0) & (hi == 0)  # ERROR in key columns
+
     def finish_time(self, time: int) -> None:
-        if self.native_cols is None or _nb_type() is None:
+        if (
+            self.native_cols is None
+            and self.native_key_col is None
+            and self.native_salt is None
+        ) or _nb_type() is None:
             entries = self.take_input()
             if entries:
                 self.emit(time, consolidate(self._rekey_object(entries)))
@@ -1536,12 +1612,11 @@ class ReindexNode(Node):
         out_entries = self._rekey_object(entries) if entries else []
         out_batches = []
         for b in batches:
-            res = dp.rekey(b.tab, b.token, self.native_cols)
+            res = self._rekey_batch(dp, b)
             if res is None:
                 out_entries.extend(self._rekey_object(b.materialize()))
                 continue
-            lo, hi = res
-            bad = (lo == 0) & (hi == 0)
+            lo, hi, bad = res
             if bad.any():
                 out_entries.extend(self._rekey_object(b.select(bad).materialize()))
                 good = ~bad
@@ -2795,6 +2870,15 @@ class GroupByNode(Node):
     def _emit_agg(self, time, g_ids, totals, isum, fsum, cnts, flags) -> None:
         plan_mode = self._plan is not None
         out: list[Entry] = []
+        # plan mode emits token-resident: the retract-old/insert-new pairs
+        # leave as ONE NativeBatch (rows interned, never decoded), so a
+        # groupby inside a hot loop — the iterate scope's per-round
+        # aggregations — feeds downstream joins without any object rows.
+        # The suppression rule stays delta_emit's Python rows_equal, so
+        # emission CONTENT is bit-identical to the object transport.
+        kvs: list = []
+        toks: list = []
+        diffs: list = []
         for j in range(len(g_ids)):
             if plan_mode:
                 gkey, gvals = self._group_info(int(g_ids[j]))
@@ -2822,7 +2906,35 @@ class GroupByNode(Node):
                             if c else None
                         )
                 new = tuple(gvals) + tuple(vals)
+            if not plan_mode:
+                delta_emit(self.emitted, out, gkey, new)
+                continue
+            pos = len(out)
             delta_emit(self.emitted, out, gkey, new)
+            kpos = len(kvs)
+            for key, row, d in out[pos:]:
+                t = self._tab.intern_row(row)
+                if t is None:
+                    # exotic value: the whole group's pair stays object
+                    del kvs[kpos:], toks[kpos:], diffs[kpos:]
+                    break
+                kvs.append(key.value)
+                toks.append(t)
+                diffs.append(d)
+            else:
+                del out[pos:]
+        n = len(kvs)
+        if n:
+            self.emit(
+                time,
+                self._dp.NativeBatch(
+                    self._tab,
+                    np.fromiter((kv & _MASK64 for kv in kvs), np.uint64, n),
+                    np.fromiter((kv >> 64 for kv in kvs), np.uint64, n),
+                    np.fromiter(toks, np.uint64, n),
+                    np.fromiter(diffs, np.int64, n),
+                ),
+            )
         self.emit(time, out)
 
     def _finish_native_batch(self, time: int, batch) -> bool:
@@ -3630,22 +3742,164 @@ class SortNode(Node):
 
 
 class CaptureNode(Node):
-    """Accumulates the full update stream and final state (debug/capture)."""
+    """Accumulates the full update stream and final state (debug/capture).
+
+    ``token_resident=True`` (the iterate scope's capture streams) keeps the
+    log on the token plane: native waves append WHOLE as ``(time,
+    NativeBatch)`` items beside plain ``(time, key, row, diff)`` tuples —
+    the reader (IterateNode) consumes both kinds as one z-set — and the
+    final state lives in a C keyed store (key128 -> token). Object rows
+    arriving on a token log are interned in place; a plane-unrepresentable
+    row demotes the capture (log materialized in order, positions remapped
+    through the ``on_demote(cap, bounds)`` hook so the owning scope stays
+    consistent). Operator snapshots always export the OBJECT form."""
 
     _persist_attrs = ("stream", "state")
 
-    def __init__(self, graph: Graph, inp: Node):
+    def __init__(self, graph: Graph, inp: Node, token_resident: bool = False):
         super().__init__(graph, [inp])
-        self.stream: list[tuple[int, Key, tuple, int]] = []
+        self.stream: list = []  # 4-tuples and/or (time, NativeBatch) items
         self.state = KeyedState()
+        self._tok = bool(token_resident) and _nb_type() is not None
+        self.on_demote: Callable | None = None
+        if self._tok:
+            from pathway_tpu.engine import native as _nat
+
+            self._nat = _nat
+            self._dp = _tok_plane()
+            self._tab = self._dp.default_table()
+            self._nstate = _nat.NativeKeyedState()
 
     def finish_time(self, time: int) -> None:
-        entries = self.take_input()
-        if not entries:
+        if not self._tok:
+            entries = self.take_input()
+            if not entries:
+                return
+            for key, row, diff in entries:
+                self.stream.append((time, key, row, diff))
+            self.state.update(entries)
             return
-        for key, row, diff in entries:
-            self.stream.append((time, key, row, diff))
-        self.state.update(entries)
+        # token log: drain the raw buffer in ARRIVAL order (the log is the
+        # scope's update history; take_segments would split the kinds)
+        buf = self.buffers[0]
+        if not buf:
+            return
+        self.buffers[0] = []
+        self._nseg[0] = 0
+        rows = 0
+        i = 0
+        n_items = len(buf)
+        while i < n_items:
+            seg = buf[i]
+            if type(seg) is tuple:
+                j = i
+                while j < n_items and type(buf[j]) is tuple:
+                    j += 1
+                chunk = buf[i:j]
+                if self._append_obj(time, chunk):
+                    rows += len(chunk)
+                    i = j
+                    continue
+                # plane-unrepresentable row: demote, replay the tail
+                # (this chunk included — none of it reached the log)
+                self.demote()
+                tail: list[Entry] = []
+                for seg2 in buf[i:]:
+                    if type(seg2) is tuple:
+                        tail.append(seg2)
+                    else:
+                        tail.extend(seg2.materialize())
+                for key, row, d in tail:
+                    self.stream.append((time, key, row, d))
+                self.state.update(tail)
+                self.rows_in += rows + len(tail)
+                return
+            rows += len(seg)
+            self.stream.append((time, seg))
+            self._nstate.update(seg.key_lo, seg.key_hi, seg.token, seg.diff)
+            i += 1
+        self.rows_in += rows
+
+    def _append_obj(self, time: int, entries: list[Entry]) -> bool:
+        """Intern a run of object entries onto the token log (+ keyed
+        state). False (and no log/state mutation) when a row is not
+        plane-representable — the caller demotes and replays."""
+        n = len(entries)
+        lo = np.empty(n, np.uint64)
+        hi = np.empty(n, np.uint64)
+        tok = np.empty(n, np.uint64)
+        diff = np.empty(n, np.int64)
+        for i, (key, row, d) in enumerate(entries):
+            t = self._tab.intern_row(row)
+            if t is None:
+                return False
+            kv = key.value
+            lo[i] = kv & _MASK64
+            hi[i] = kv >> 64
+            tok[i] = t
+            diff[i] = d
+        for key, row, d in entries:
+            self.stream.append((time, key, row, d))
+        self._nstate.update(lo, hi, tok, diff)
+        return True
+
+    # --------------------------------------------------- plane transitions
+
+    def _log_object_form(self) -> tuple[list, list[int]]:
+        """The log with native items expanded to 4-tuples, in order, plus
+        ``bounds``: old item index i -> its new index (len+1 entries)."""
+        new: list = []
+        bounds = [0]
+        for item in self.stream:
+            if len(item) == 4:
+                new.append(item)
+            else:
+                t, nb = item
+                new.extend((t, k, r, d) for (k, r, d) in nb.materialize())
+            bounds.append(len(new))
+        return new, bounds
+
+    def _state_object_form(self) -> KeyedState:
+        return nks_decode(self._nstate, self._tab)
+
+    def demote(self) -> list[int]:
+        """One-way switch to the object plane; returns the position-bounds
+        map and notifies the owner (iterate) via ``on_demote``."""
+        if not self._tok:
+            return list(range(len(self.stream) + 1))
+        self._tok = False
+        self.stream, bounds = self._log_object_form()
+        st = self._state_object_form()
+        st.rows.update(self.state.rows)  # object rows seen mid-demotion
+        self.state = st
+        self._nstate = None
+        if self.on_demote is not None:
+            self.on_demote(self, bounds)
+        return bounds
+
+    # ------------------------------------------------- snapshots (object)
+
+    def persist_state(self) -> dict:
+        if not self._tok:
+            return {"stream": self.stream, "state": self.state}
+        stream, _bounds = self._log_object_form()
+        return {"stream": stream, "state": self._state_object_form()}
+
+    def restore_state(self, st: dict) -> None:
+        self.stream = st["stream"]
+        self.state = st["state"]
+        if not self._tok:
+            return
+        nst = nks_encode(st["state"].rows, self._tab)
+        if nst is None:
+            # snapshot holds plane-unrepresentable rows: stay object
+            self._tok = False
+            self._nstate = None
+            if self.on_demote is not None:
+                self.on_demote(self, list(range(len(self.stream) + 1)))
+            return
+        self._nstate = nst
+        self.state = KeyedState()  # token mode: the C store is the state
 
 
 class SubscribeNode(Node):

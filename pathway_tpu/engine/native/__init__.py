@@ -120,6 +120,12 @@ def _load() -> ctypes.CDLL | None:
             return None
         lib.zs_consolidate.restype = ctypes.c_int64
         lib.zs_consolidate.argtypes = [ctypes.c_int64, u64p, u64p, u64p, i64p]
+        lib.zs_difference.restype = ctypes.c_int64
+        lib.zs_difference.argtypes = [
+            ctypes.c_int64, u64p, u64p, u64p, i64p,
+            ctypes.c_int64, u64p, u64p, u64p, i64p,
+            u64p, u64p, u64p, i64p,
+        ]
         lib.zs_keyed_new.restype = ctypes.c_void_p
         lib.zs_keyed_free.argtypes = [ctypes.c_void_p]
         lib.zs_keyed_update.argtypes = [
@@ -192,6 +198,27 @@ def consolidate_tokens(
     lib = _load()
     assert lib is not None
     return lib.zs_consolidate(len(key_lo), key_lo, key_hi, token, diff)
+
+
+def difference_tokens(a, b):
+    """Consolidated z-set difference A ⊖ B over (lo, hi, tok, diff) column
+    quads — the iterate scope's feedback subtraction (C ⊖ P) in one C
+    pass. Returns (lo, hi, tok, diff) of the non-zero remainder."""
+    lib = _load()
+    assert lib is not None
+    a_lo, a_hi, a_tok, a_diff = (np.ascontiguousarray(x) for x in a)
+    b_lo, b_hi, b_tok, b_diff = (np.ascontiguousarray(x) for x in b)
+    cap = max(len(a_lo) + len(b_lo), 1)
+    out_lo = np.empty(cap, np.uint64)
+    out_hi = np.empty(cap, np.uint64)
+    out_tok = np.empty(cap, np.uint64)
+    out_diff = np.empty(cap, np.int64)
+    m = lib.zs_difference(
+        len(a_lo), a_lo, a_hi, a_tok, a_diff,
+        len(b_lo), b_lo, b_hi, b_tok, b_diff,
+        out_lo, out_hi, out_tok, out_diff,
+    )
+    return out_lo[:m], out_hi[:m], out_tok[:m], out_diff[:m]
 
 
 class NativeKeyedState:

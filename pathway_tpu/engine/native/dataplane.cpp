@@ -1216,6 +1216,22 @@ int64_t dp_rekey(void* h, int64_t n, const uint64_t* tokens,
     return 0;
 }
 
+// Salted re-key: new key128 = blake2b-128 of (TAG_KEY piece of the row's
+// current key || TAG_INT piece of salt) — byte-identical to Python
+// hash_values(key, salt), the concat_reindex per-input disambiguation.
+void dp_rekey_salt(int64_t n, const uint64_t* key_lo, const uint64_t* key_hi,
+                   int64_t salt, uint64_t* out_lo, uint64_t* out_hi) {
+    std::string kb;
+    kb.reserve(32);
+    for (int64_t i = 0; i < n; ++i) {
+        kb.clear();
+        piece_key(kb, key_lo[i], key_hi[i]);
+        piece_int(kb, salt);
+        blake2b_128(reinterpret_cast<const uint8_t*>(kb.data()), kb.size(),
+                    &out_lo[i], &out_hi[i]);
+    }
+}
+
 // Shard by record key: key128 % n (identical to Python `key.value % n`).
 void dp_route_key(int64_t n, const uint64_t* key_lo, const uint64_t* key_hi,
                   int64_t n_shards, int64_t* out_shard) {

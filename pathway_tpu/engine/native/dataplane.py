@@ -122,6 +122,9 @@ def _load() -> ctypes.CDLL | None:
             i64p, c.c_uint8,
         ]
         lib.dp_route_key.argtypes = [c.c_int64, u64p, u64p, c.c_int64, i64p]
+        lib.dp_rekey_salt.argtypes = [
+            c.c_int64, u64p, u64p, c.c_int64, u64p, u64p,
+        ]
         lib.dp_rekey.restype = c.c_int64
         lib.dp_rekey.argtypes = [
             c.c_void_p, c.c_int64, u64p, i64p, c.c_int64, c.c_uint8,
@@ -279,7 +282,13 @@ def encode_row(row: tuple) -> bytes | None:
 
 
 class InternTable:
-    """Process-side handle on a C++ intern table + a token->row cache."""
+    """Process-side handle on a C++ intern table + a token->row cache.
+
+    ``stat_intern_rows`` / ``stat_materialize_rows`` are monotone plane-
+    boundary counters: every Python-row intern and every token decode into
+    Python entries bumps them. The iterate scope samples them around its
+    boundary plumbing to PROVE a fixpoint round never round-trips rows
+    through Python objects (tests/test_iterate_native.py)."""
 
     def __init__(self) -> None:
         lib = _load()
@@ -287,6 +296,8 @@ class InternTable:
         self._lib = lib
         self._h = lib.dp_tab_new()
         self._row_cache: dict[int, tuple] = {}
+        self.stat_intern_rows = 0
+        self.stat_materialize_rows = 0
 
     def __del__(self) -> None:
         if getattr(self, "_h", None):
@@ -300,6 +311,7 @@ class InternTable:
         return self._lib.dp_tab_intern(self._h, data, len(data))
 
     def intern_row(self, row: tuple) -> int | None:
+        self.stat_intern_rows += 1
         b = encode_row(row)
         if b is None:
             return None
@@ -370,6 +382,7 @@ class NativeBatch:
     def materialize(self) -> list[tuple]:
         """Decode to [(Key, row, diff)] — the Python-object boundary."""
         tab = self.tab
+        tab.stat_materialize_rows += len(self.token)
         lo = self.key_lo
         hi = self.key_hi
         tok = self.token
@@ -447,7 +460,11 @@ class NativeBatch:
 
     def to_wire(self) -> tuple:
         """Compact picklable form for cross-process exchange: tokens are
-        rewritten to dense local ids + a unique-row blob."""
+        rewritten to dense local ids + a unique-row blob. The flat arrays
+        stay numpy ndarrays so pickle protocol 5 ships their buffers
+        out-of-band (process_mesh's zero-copy frames); ``from_wire``
+        accepts the older bytes fields too, keeping the wire compatible
+        across a supervisor restart mid-upgrade."""
         lib = _load()
         tok = self.token.copy()
         n = len(tok)
@@ -463,24 +480,35 @@ class NativeBatch:
             blob_cap = max(-n_u, blob_cap * 2)
         used = int(ulen[:n_u].sum()) if n_u else 0
         return (
-            self.key_lo.tobytes(),
-            self.key_hi.tobytes(),
-            tok.tobytes(),
-            self.diff.tobytes(),
+            np.ascontiguousarray(self.key_lo, np.uint64),
+            np.ascontiguousarray(self.key_hi, np.uint64),
+            tok,
+            np.ascontiguousarray(self.diff, np.int64),
             blob.raw[:used],
-            ulen[:n_u].tobytes(),
+            np.ascontiguousarray(ulen[:n_u]),
         )
+
+    @staticmethod
+    def _wire_col(field, dtype) -> np.ndarray:
+        """One wire field as a fresh contiguous array: ndarray fields
+        (protocol-5 wire) copy out of the receive buffer; bytes fields
+        (legacy wire) decode as before."""
+        if isinstance(field, np.ndarray):
+            return np.ascontiguousarray(field, dtype).copy()
+        return np.frombuffer(field, dtype).copy()
 
     @staticmethod
     def from_wire(w: tuple, tab: InternTable | None = None) -> "NativeBatch":
         lib = _load()
         tab = tab or default_table()
-        lo = np.frombuffer(w[0], np.uint64).copy()
-        hi = np.frombuffer(w[1], np.uint64).copy()
-        tok = np.frombuffer(w[2], np.uint64).copy()
-        diff = np.frombuffer(w[3], np.int64).copy()
-        ulen = np.frombuffer(w[5], np.int64).copy()
-        rc = lib.dp_import_tokens(tab._h, len(tok), tok, w[4], ulen, len(ulen))
+        col = NativeBatch._wire_col
+        lo = col(w[0], np.uint64)
+        hi = col(w[1], np.uint64)
+        tok = col(w[2], np.uint64)
+        diff = col(w[3], np.int64)
+        ulen = col(w[5], np.int64)
+        blob = w[4] if isinstance(w[4], bytes) else bytes(w[4])
+        rc = lib.dp_import_tokens(tab._h, len(tok), tok, blob, ulen, len(ulen))
         if rc != 0:
             raise ValueError("corrupt native wire batch")
         return NativeBatch(tab, lo, hi, tok, diff)
@@ -752,6 +780,20 @@ def rekey(tab: InternTable, tokens: np.ndarray, col_idx: list[int]):
     )
     if rc != 0:
         return None
+    return lo, hi
+
+
+def rekey_salt(key_lo: np.ndarray, key_hi: np.ndarray, salt: int):
+    """New keys = blake2b-128 of (key piece, int salt piece) per row —
+    byte-identical to hash_values(key, salt) (concat_reindex)."""
+    lib = _load()
+    n = len(key_lo)
+    lo = np.empty(n, np.uint64)
+    hi = np.empty(n, np.uint64)
+    lib.dp_rekey_salt(
+        n, np.ascontiguousarray(key_lo), np.ascontiguousarray(key_hi),
+        salt, lo, hi,
+    )
     return lo, hi
 
 

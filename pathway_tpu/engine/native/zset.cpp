@@ -87,6 +87,38 @@ int64_t zs_consolidate(int64_t n, uint64_t* key_lo, uint64_t* key_hi,
     return m;
 }
 
+// Z-set difference A ⊖ B in one pass: sums A's diffs, subtracts B's,
+// compacts non-zero entries into the OUT arrays (order unspecified).
+// The iterate scope's per-round feedback identity (capture wave delta
+// minus this round's external push, engine/runtime.py IterateNode) is
+// exactly this kernel; out arrays must hold n_a + n_b entries.
+int64_t zs_difference(int64_t n_a, const uint64_t* a_lo, const uint64_t* a_hi,
+                      const uint64_t* a_tok, const int64_t* a_diff,
+                      int64_t n_b, const uint64_t* b_lo, const uint64_t* b_hi,
+                      const uint64_t* b_tok, const int64_t* b_diff,
+                      uint64_t* out_lo, uint64_t* out_hi, uint64_t* out_tok,
+                      int64_t* out_diff) {
+    std::unordered_map<std::pair<Key128, uint64_t>, int64_t, PairHash, PairEq>
+        acc;
+    acc.reserve(static_cast<size_t>(n_a + n_b));
+    for (int64_t i = 0; i < n_a; ++i) {
+        acc[{Key128{a_lo[i], a_hi[i]}, a_tok[i]}] += a_diff[i];
+    }
+    for (int64_t i = 0; i < n_b; ++i) {
+        acc[{Key128{b_lo[i], b_hi[i]}, b_tok[i]}] -= b_diff[i];
+    }
+    int64_t m = 0;
+    for (const auto& kv : acc) {
+        if (kv.second == 0) continue;
+        out_lo[m] = kv.first.first.lo;
+        out_hi[m] = kv.first.first.hi;
+        out_tok[m] = kv.first.second;
+        out_diff[m] = kv.second;
+        ++m;
+    }
+    return m;
+}
+
 // ------------------------------------------------------------ keyed state
 
 void* zs_keyed_new() { return new KeyedState(); }

@@ -15,6 +15,8 @@ import threading
 import time as _time
 from typing import Any, Callable, Iterable, Sequence
 
+import numpy as np
+
 from pathway_tpu.engine import faults
 from pathway_tpu.engine.core import (
     CaptureNode,
@@ -23,8 +25,15 @@ from pathway_tpu.engine.core import (
     InputNode,
     KeyedState,
     Node,
+    _kv_cols,
+    _kvs_of,
+    _tok_plane,
+    _wave_arrays,
     consolidate,
     freeze_row,
+    iterate_native_on,
+    nks_decode,
+    nks_encode,
 )
 from pathway_tpu.internals.errors import ERROR
 from pathway_tpu.internals.keys import Key, key_for_values, sequential_key
@@ -891,6 +900,39 @@ class IterateNode(Node):
         # bodies are key-preserving — the reference requires the returned
         # iterated table to keep the input universe)
         self._fed = {name: KeyedState() for name in iterated_names}
+        # Token plane (docs/iterate.md): the whole feedback loop —
+        # translate, capture wave deltas, the C ⊖ P subtraction
+        # (zs_difference) and per-round consolidation — runs on NativeBatch
+        # flat arrays, matching the reference's typed nested-scope iterate
+        # (dataflow.rs:3737). PATHWAY_ITERATE_NATIVE=0 kill switch keeps
+        # today's object plumbing for bit-identical A/B; the object code
+        # below doubles as the permanent demotion fallback (exotic rows).
+        self._tok = iterate_native_on()
+        self._ext: dict | None = None
+        self._out_start: dict | None = None
+        # boundary round-trip audit (tests/test_iterate_native.py): rows
+        # this node's own plumbing interned/materialized, sampled from the
+        # InternTable counter hooks, plus rows the WHOLE scope (body
+        # operators included) decoded back to Python per round
+        self.plane_stats = {
+            "boundary_intern_rows": 0,
+            "boundary_materialize_rows": 0,
+            "scope_materialize_rows": 0,
+            "rounds": 0,
+        }
+        if self._tok:
+            from pathway_tpu.engine import native as _nat
+
+            self._nat = _nat
+            self._dp = _tok_plane()
+            self._tab = self._dp.default_table()
+            self._fed_tok: dict | None = {
+                name: _nat.NativeKeyedState() for name in iterated_names
+            }
+            for cap in captures.values():
+                cap.on_demote = self._capture_demoted
+        else:
+            self._fed_tok = None
 
     def set_output_node(self, name: str, node: InputNode) -> None:
         self.out_nodes[name] = node
@@ -911,23 +953,103 @@ class IterateNode(Node):
             self.scope.drop()
 
 
+    # --------------------------------------------------- plane transitions
+
+    def _capture_demoted(self, cap: "CaptureNode", bounds: list[int]) -> None:
+        """A capture fell off the token plane mid-run (body emitted a
+        plane-unrepresentable row): remap this scope's read positions
+        through the materialization bounds and demote the whole scope —
+        mixed-plane feedback bookkeeping is not worth its complexity."""
+        for name, c in self.captures.items():
+            if c is cap:
+                self._remap_positions(name, bounds)
+        self._demote_scope()
+
+    def _remap_positions(self, name: str, bounds: list[int]) -> None:
+        last = len(bounds) - 1
+        pos = self._read_pos.get(name, 0)
+        self._read_pos[name] = bounds[min(pos, last)]
+        if self._out_start is not None and name in self._out_start:
+            self._out_start[name] = bounds[min(self._out_start[name], last)]
+
+    def _demote_scope(self) -> None:
+        """One-way switch of the whole iterate scope to the object
+        plumbing: captures materialize their logs (positions remapped),
+        the fed mirrors decode, and any mid-wave external batches fall
+        back to entry lists. Correctness never depends on the plane."""
+        if not self._tok:
+            return
+        self._tok = False
+        for name, cap in self.captures.items():
+            if getattr(cap, "_tok", False):
+                cap.on_demote = None
+                self._remap_positions(name, cap.demote())
+        if self._fed_tok is not None:
+            for name, st in self._fed_tok.items():
+                self._fed[name] = nks_decode(st, self._tab)
+            self._fed_tok = None
+        if self._ext:
+            for name, v in list(self._ext.items()):
+                if v is not None and type(v) is not list:
+                    self._ext[name] = v.materialize()
+
+    def _boundary(self, fn):
+        """Run one piece of this node's own boundary plumbing with the
+        InternTable round-trip counters sampled around it (the audit the
+        acceptance test reads: zero on an all-native pipeline)."""
+        tab = self._tab
+        i0 = tab.stat_intern_rows
+        m0 = tab.stat_materialize_rows
+        try:
+            return fn()
+        finally:
+            st = self.plane_stats
+            st["boundary_intern_rows"] += tab.stat_intern_rows - i0
+            st["boundary_materialize_rows"] += tab.stat_materialize_rows - m0
+
     # ------------------------------------------------- operator snapshots
 
     def persist_state(self) -> dict:
+        # snapshots always export the OBJECT form (portable across the
+        # kill switch and process restarts): fed mirrors decode, and read
+        # positions are mapped onto each capture log's object form — the
+        # same expansion CaptureNode.persist_state performs, so the pair
+        # stays consistent.
+        if self._tok:
+            read_pos = dict(self._read_pos)
+            fed = {}
+            for name, cap in self.captures.items():
+                if getattr(cap, "_tok", False):
+                    _stream, bounds = cap._log_object_form()
+                    last = len(bounds) - 1
+                    if name in read_pos:
+                        read_pos[name] = bounds[min(read_pos[name], last)]
+            for name, st in (self._fed_tok or {}).items():
+                fed[name] = nks_decode(st, self._tab)
+        else:
+            read_pos = self._read_pos
+            fed = self._fed
         return {
             "inner_t": self.inner_t,
             "pending_statics": self._pending_statics_state(),
             "pending_convergence": self._pending_convergence,
-            "read_pos": self._read_pos,
-            "fed": self._fed,
+            "read_pos": read_pos,
+            "fed": fed,
             "sub": [n.persist_state() for n in self.sub_graph.nodes],
         }
 
     def _pending_statics_state(self) -> list:
-        # static batch entries are picklable; node identity maps by index
+        # static batch entries pickle in object form; node identity maps
+        # by index (NativeBatch closures materialize — they are rare and
+        # only survive until their scripted release time)
         idx = {id(n): i for i, n in enumerate(self.sub_graph.nodes)}
         return [
-            (t, idx[id(node)], entries) for (t, node, entries) in self._pending_statics
+            (
+                t,
+                idx[id(node)],
+                entries if type(entries) is list else entries.materialize(),
+            )
+            for (t, node, entries) in self._pending_statics
         ]
 
     def restore_state(self, st: dict) -> None:
@@ -939,9 +1061,30 @@ class IterateNode(Node):
         ]
         self._read_pos = st["read_pos"]
         self._fed = st["fed"]
+        if self._tok and not self._encode_fed(st["fed"]):
+            self._fed_tok = None
+            self._demote_scope()
         for node, sub_st in zip(self.sub_graph.nodes, st["sub"]):
             if sub_st is not None:
                 node.restore_state(sub_st)
+        if self._tok and any(
+            not getattr(c, "_tok", False) for c in self.captures.values()
+        ):
+            # a capture could not re-encode its snapshot: whole scope
+            # follows it down (positions are already object-form here)
+            self._demote_scope()
+
+    def _encode_fed(self, fed: dict) -> bool:
+        """Re-encode restored object-form fed mirrors into the C keyed
+        stores; False when a row is not plane-representable."""
+        new = {}
+        for name in self.iterated_names:
+            st = nks_encode(fed[name].rows, self._tab)
+            if st is None:
+                return False
+            new[name] = st
+        self._fed_tok = new
+        return True
 
     # ------------------------------------------------------------- pumping
 
@@ -967,6 +1110,50 @@ class IterateNode(Node):
         fed.update(out)
         return out
 
+    def _translate_tok(self, name: str, nb):
+        """Token twin of ``_translate``: per-key resolution over flat
+        (key128, token) columns with the fed mirror queried in one C
+        call — no row ever decodes to a tuple."""
+        fed = self._fed_tok[name]
+        kvs = _kvs_of(nb.key_lo, nb.key_hi)
+        toks = nb.token.tolist()
+        dfs = nb.diff.tolist()
+        per: dict[int, int | None] = {}
+        for i, kv in enumerate(kvs):
+            if dfs[i] > 0:
+                per[kv] = toks[i]
+            else:
+                per.setdefault(kv, None)
+        u_kvs = list(per.keys())
+        lo_u, hi_u = _kv_cols(u_kvs)
+        old = fed.get(lo_u, hi_u).tolist()
+        absent = (1 << 64) - 1
+        o_kv: list[int] = []
+        o_tok: list[int] = []
+        o_diff: list[int] = []
+        for j, kv in enumerate(u_kvs):
+            cur = old[j] if old[j] != absent else None
+            new = per[kv]
+            if cur == new:
+                continue  # unchanged row: the object plane consolidates
+            if cur is not None:
+                o_kv.append(kv)
+                o_tok.append(cur)
+                o_diff.append(-1)
+            if new is not None:
+                o_kv.append(kv)
+                o_tok.append(new)
+                o_diff.append(1)
+        n = len(o_kv)
+        lo, hi = _kv_cols(o_kv)
+        out = self._dp.NativeBatch(
+            self._tab, lo, hi,
+            np.fromiter(o_tok, np.uint64, n),
+            np.fromiter(o_diff, np.int64, n),
+        )
+        fed.update(out.key_lo, out.key_hi, out.token, out.diff)
+        return out
+
     def _wave_delta(self, name: str) -> list[Entry]:
         """Capture-stream entries appended since the last read."""
         cap = self.captures[name]
@@ -974,6 +1161,91 @@ class IterateNode(Node):
         new = cap.stream[pos:]
         self._read_pos[name] = len(cap.stream)
         return [(k, row, d) for (_t, k, row, d) in new]
+
+    def _read_log(self, cap: "CaptureNode", pos: int):
+        """Log items appended since `pos`, split by plane (order within
+        each kind preserved — z-set math is commutative across them).
+        Does NOT advance any read position."""
+        batches: list = []
+        entries: list[Entry] = []
+        for item in cap.stream[pos:]:
+            if len(item) == 4:
+                _t, k, row, d = item
+                entries.append((k, row, d))
+            else:
+                batches.append(item[1])
+        return batches, entries
+
+    def _wave_quad(self, cap: "CaptureNode", pos: int):
+        """Log items since `pos` as one (lo, hi, tok, diff) array quad, or
+        None when an object item is not plane-representable (caller
+        demotes the scope). Boundary-audited."""
+        batches, entries = self._read_log(cap, pos)
+        if not batches and not entries:
+            return np.empty(0, np.uint64), np.empty(0, np.uint64), \
+                np.empty(0, np.uint64), np.empty(0, np.int64)
+        return self._boundary(
+            lambda: _wave_arrays(self._tab, batches, entries)
+        )
+
+    def _feedback_delta(self, name: str, external: dict):
+        """One round's feedback for an iterated placeholder: the capture's
+        new wave delta ⊖ this round's external push (the C ⊖ P identity
+        from the class docstring). Returns a NativeBatch (token plane), an
+        entry list (object plane), or None when the feedback is empty.
+        Advances the capture read position and updates the fed mirror."""
+        if self._tok:
+            cap = self.captures[name]
+            pos = self._read_pos.get(name, 0)
+            ext = external.get(name)
+            # convert a (rare) object-form external first: the demotion
+            # paths below then run with the external dict intact
+            e_quad = None
+            if type(ext) is list and ext:
+                e_quad = self._boundary(
+                    lambda: _wave_arrays(self._tab, [], ext)
+                )
+                if e_quad is None:
+                    self._demote_scope()
+                    return self._feedback_obj(name, external)
+            elif ext is not None and type(ext) is not list and len(ext):
+                e_quad = (ext.key_lo, ext.key_hi, ext.token, ext.diff)
+            quad = self._wave_quad(cap, pos)
+            if quad is None:
+                self._demote_scope()  # read position remapped, not consumed
+                return self._feedback_obj(name, external)
+            self._read_pos[name] = len(cap.stream)
+            external[name] = []
+            if e_quad is None:
+                lo, hi, tok, diff = (a.copy() for a in quad)
+                m = self._nat.consolidate_tokens(lo, hi, tok, diff)
+            else:
+                lo, hi, tok, diff = self._nat.difference_tokens(quad, e_quad)
+                m = len(lo)
+            if m == 0:
+                return None
+            fb = self._dp.NativeBatch(
+                self._tab, lo[:m], hi[:m], tok[:m], diff[:m]
+            )
+            self._fed_tok[name].update(
+                fb.key_lo, fb.key_hi, fb.token, fb.diff
+            )
+            return fb
+        return self._feedback_obj(name, external)
+
+    def _feedback_obj(self, name: str, external: dict):
+        delta = self._wave_delta(name)
+        ext = external.pop(name, [])
+        if type(ext) is not list:  # demoted mid-wave with a token external
+            ext = ext.materialize()
+        external[name] = []
+        feedback = consolidate(
+            delta + [(k, row, -d) for (k, row, d) in ext]
+        )
+        if not feedback:
+            return None
+        self._fed[name].update(feedback)
+        return feedback
 
     def _release_statics(self, time: int) -> bool:
         """Push body-closure static batches whose scripted time has come
@@ -993,28 +1265,26 @@ class IterateNode(Node):
         return released
 
     def finish_time(self, time: int) -> None:
-        batches = {
-            name: self.take_input(i) for i, name in enumerate(self.input_names)
-        }
+        raws = [self.take_segments(i) for i in range(len(self.input_names))]
         released = self._release_statics(time)
-        if not any(batches.values()) and not released and not self._pending_convergence:
+        has_input = any(b or e for b, e in raws)
+        if not has_input and not released and not self._pending_convergence:
             return
         self._pending_convergence = False
         # External (outer) pushes put the placeholder out of sync with the
         # capture; they are compensated exactly once, in the first round's
         # feedback. Feedback pushes re-establish P = C, so from round 2 on
         # the feedback is the wave delta alone.
-        external: dict[str, list[Entry]] = {name: [] for name in self.iterated_names}
-        for name, batch in batches.items():
-            if not batch:
-                continue
-            batch = consolidate(batch)
-            if name in external:
-                batch = self._translate(name, batch)
-                external[name] = batch
-            if batch:
-                self.placeholder_nodes[name].push(batch)
+        external: dict[str, Any] = {name: [] for name in self.iterated_names}
+        self._ext = external
+        if self._tok and not self._push_inputs_tok(raws, external):
+            self._demote_scope()  # outer rows not plane-representable
+        if not self._tok:
+            self._push_inputs_obj(raws, external)
         out_start = {name: self._read_pos[name] for name in self.output_names}
+        self._out_start = out_start
+        tab = self._tab if self._tok else None
+        m0 = tab.stat_materialize_rows if tab is not None else 0
         rounds = 0
         while True:
             self.inner_t += 2
@@ -1023,14 +1293,9 @@ class IterateNode(Node):
             rounds += 1
             quiescent = True
             for name in self.iterated_names:
-                delta = self._wave_delta(name)
-                feedback = consolidate(
-                    delta + [(k, row, -d) for (k, row, d) in external.pop(name, [])]
-                )
-                external[name] = []
-                if feedback:
+                feedback = self._feedback_delta(name, external)
+                if feedback is not None:
                     quiescent = False
-                    self._fed[name].update(feedback)
                     self.placeholder_nodes[name].push(feedback)
             if quiescent:
                 break
@@ -1040,22 +1305,18 @@ class IterateNode(Node):
                 # convergence resumes on the next wave
                 self._pending_convergence = True
                 break
-        # emit each output's net change over this outer timestamp
-        for name in self.output_names:
-            cap = self.captures[name]
-            delta = consolidate(
-                [
-                    (k, row, d)
-                    for (_t, k, row, d) in cap.stream[out_start[name]:]
-                ]
+        self.plane_stats["rounds"] += rounds
+        if tab is not None:
+            # whole-scope decode audit: rows ANY body operator pulled back
+            # to Python during the fixpoint loop (zero = every round ran
+            # on the token plane end to end; the acceptance gate)
+            self.plane_stats["scope_materialize_rows"] += (
+                tab.stat_materialize_rows - m0
             )
-            self._read_pos[name] = len(cap.stream)
-            out_node = self.out_nodes.get(name)
-            if out_node is not None and delta:
-                out_node.push(delta)
-                # downstream of out_node runs later in topo order within
-                # this same wave because out_node was created after self
-                out_node.finish_time(time)
+        # emit each output's net change over this outer timestamp
+        self._emit_outputs(time, out_start)
+        self._out_start = None
+        self._ext = None
         # consumed capture prefixes are dead: truncate so memory and
         # checkpoint size track the live collection, not total history
         for name in self.output_names:
@@ -1063,6 +1324,89 @@ class IterateNode(Node):
             if self._read_pos[name] == len(cap.stream):
                 cap.stream.clear()
                 self._read_pos[name] = 0
+
+    def _push_inputs_obj(self, raws: list, external: dict) -> None:
+        from pathway_tpu.engine.core import _flatten_segments
+
+        for i, name in enumerate(self.input_names):
+            b, e = raws[i]
+            batch = _flatten_segments(b, e)
+            if not batch:
+                continue
+            batch = consolidate(batch)
+            if name in external:
+                batch = self._translate(name, batch)
+                external[name] = batch
+            if batch:
+                self.placeholder_nodes[name].push(batch)
+
+    def _push_inputs_tok(self, raws: list, external: dict) -> bool:
+        """Batch-first outer push: every input wave becomes ONE
+        consolidated NativeBatch; iterated inputs translate through the C
+        fed mirror. False (nothing pushed) when a wave holds a
+        plane-unrepresentable row — the caller demotes and replays."""
+        converted: list[tuple[str, Any]] = []
+        for i, name in enumerate(self.input_names):
+            b, e = raws[i]
+            if not b and not e:
+                continue
+            quad = self._boundary(lambda b=b, e=e: _wave_arrays(self._tab, b, e))
+            if quad is None:
+                return False
+            nb = self._dp.NativeBatch(
+                self._tab,
+                np.ascontiguousarray(quad[0]),
+                np.ascontiguousarray(quad[1]),
+                np.ascontiguousarray(quad[2]),
+                np.ascontiguousarray(quad[3]),
+            )
+            if not nb.is_distinct_insert():
+                nb = nb.consolidate()
+            converted.append((name, nb))
+        for name, nb in converted:
+            if name in external:
+                nb = self._boundary(lambda n=name, x=nb: self._translate_tok(n, x))
+                external[name] = nb
+            if nb is not None and len(nb):
+                self.placeholder_nodes[name].push(nb)
+        return True
+
+    def _emit_outputs(self, time: int, out_start: dict) -> None:
+        for name in self.output_names:
+            cap = self.captures[name]
+            out_node = self.out_nodes.get(name)
+            if self._tok:
+                quad = self._wave_quad(cap, out_start[name])
+                if quad is None:
+                    self._demote_scope()  # positions remapped; fall through
+                else:
+                    self._read_pos[name] = len(cap.stream)
+                    if out_node is None or not len(quad[0]):
+                        continue
+                    lo, hi, tok, diff = (a.copy() for a in quad)
+                    m = self._nat.consolidate_tokens(lo, hi, tok, diff)
+                    if not m:
+                        continue
+                    out_node.push(
+                        self._dp.NativeBatch(
+                            self._tab, lo[:m], hi[:m], tok[:m], diff[:m]
+                        )
+                    )
+                    # downstream of out_node runs later in topo order
+                    # within this same wave (out_node was created after
+                    # self)
+                    out_node.finish_time(time)
+                    continue
+            delta = consolidate(
+                [
+                    (k, row, d)
+                    for (_t, k, row, d) in cap.stream[out_start[name]:]
+                ]
+            )
+            self._read_pos[name] = len(cap.stream)
+            if out_node is not None and delta:
+                out_node.push(delta)
+                out_node.finish_time(time)
 
     def on_end(self, time: int) -> None:
         """End-of-stream: release any remaining closure statics, flush the

@@ -455,13 +455,50 @@ class ProcessExchangeNode(Node):
         if self.route is None:
             buckets[0].extend(entries)
         else:
+            route = self.route
+            shard_of = _shard_of
+            # route tokens repeat heavily within a wave (group keys):
+            # memoize token -> shard so the blake2b serialization runs
+            # once per DISTINCT token, not once per row. The cache key
+            # includes the token's TYPE: _shard_of routes a bare int via
+            # the % fast path but an equal float via the blake path, and
+            # dict equality (5 == 5.0) must not fold them — routing has
+            # to stay a pure function of the token, never of which form
+            # happened to arrive first in the wave.
+            shard_cache: dict = {}
+            route_errors = 0
+            first_error: BaseException | None = None
             for entry in entries:
                 key, row, _diff = entry
                 try:
-                    p = _shard_of(self.route(key, row), n)
-                except Exception:  # noqa: BLE001 — owner re-evaluates + logs
-                    p = 0
+                    tok = route(key, row)
+                except Exception as e:  # noqa: BLE001 — owner re-evaluates
+                    # + logs through its normal path; shard 0 is the
+                    # deterministic overflow bucket
+                    route_errors += 1
+                    if first_error is None:
+                        first_error = e
+                    buckets[0].append(entry)
+                    continue
+                try:
+                    ck = (tok.__class__, tok)
+                    p = shard_cache.get(ck)
+                    if p is None:
+                        p = shard_cache[ck] = shard_of(tok, n)
+                except TypeError:
+                    # unhashable token: no memo, route it directly
+                    # (_shard_of's stable-repr fallback still applies)
+                    p = shard_of(tok, n)
                 buckets[p].append(entry)
+            if route_errors:
+                import logging
+
+                logging.getLogger("pathway_tpu.workers").warning(
+                    "exchange wire %d (node %d): %d row(s) failed shard "
+                    "routing, sent to process 0 (first error: %s: %s)",
+                    self.wire_id, self.node_id, route_errors,
+                    type(first_error).__name__, first_error,
+                )
         return buckets, nb_buckets
 
     def inject_remote(self, time: int, payload: Any) -> None:

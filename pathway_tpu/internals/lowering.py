@@ -308,12 +308,50 @@ class Session:
                 isinstance(a, ex.ColumnReference)
                 and not isinstance(a, ex.IdReference)
                 and a.name in names
-                and main._dtype_of(a.name) in (dt.INT, dt.STR, dt.BOOL)
+                and (
+                    main._dtype_of(a.name) in (dt.INT, dt.STR, dt.BOOL)
+                    or isinstance(main._dtype_of(a.name), dt.Pointer)
+                )
             ):
                 cols.append(names.index(a.name))
             else:
                 return None
         return cols
+
+    def _plane_scalar_schema(self, table: Table) -> bool:
+        """Every declared column dtype is a plane-representable scalar —
+        the gate for marking a STATIC table native: its object rows intern
+        losslessly, so downstream operators may plan token-resident (the
+        iterate bodies' closure tables — edge lists keyed by pointers —
+        are the motivating case)."""
+        from pathway_tpu.internals import dtype as dt
+
+        def scalar(d) -> bool:
+            if d in (dt.INT, dt.FLOAT, dt.BOOL, dt.STR, dt.BYTES):
+                return True
+            if isinstance(d, dt.Pointer):
+                return True
+            if isinstance(d, dt.Optional):
+                return scalar(d.wrapped)
+            return False
+
+        try:
+            return all(
+                scalar(table._dtype_of(n)) for n in table._column_names()
+            )
+        except Exception:  # noqa: BLE001 — undecidable schema: stay object
+            return False
+
+    @staticmethod
+    def _distinct_insert_rows(rows: list) -> bool:
+        """All diffs +1 with globally distinct keys — the shape whose
+        per-key operator semantics are plane-invariant."""
+        seen: set[int] = set()
+        for (_t, key, _row, diff) in rows:
+            if diff != 1 or key.value in seen:
+                return False
+            seen.add(key.value)
+        return True
 
     def _try_native_map(
         self, main: Table, exprs: dict, spec: OpSpec
@@ -405,6 +443,19 @@ class Session:
 
         if kind == "static":
             node = eng.InputNode(g)
+            if (
+                eng._nb_type() is not None
+                and self._plane_scalar_schema(table)
+                and self._distinct_insert_rows(spec.params["rows"])
+            ):
+                # all-scalar schema + a healthy all-insert key set: the
+                # object rows intern losslessly and key-level operator
+                # semantics agree across planes, so downstream operators
+                # (joins/maps over debug tables, the iterate bodies'
+                # closure edge lists) may plan native. Tables carrying
+                # retractions or duplicate keys keep the object plans
+                # (RowwiseNode's keyed dedup semantics).
+                self._native_specs.add(spec.id)
             if self.mesh is not None and self.mesh.process_id != 0:
                 # every process builds the same static tables; process 0
                 # owns the rows (exchanges distribute them) — otherwise
@@ -456,6 +507,10 @@ class Session:
             entries = self.placeholder_data.get(name, [])
             if entries:
                 self.static_batches.append((0, node, list(entries)))
+            if eng.iterate_native_on():
+                # a token-resident IterateNode feeds placeholders whole
+                # NativeBatch waves: let the body's operators plan native
+                self._native_specs.add(spec.id)
             return node
 
         if kind == "rowwise":
@@ -555,10 +610,16 @@ class Session:
             if spec.params.get("reindex"):
                 nodes = [
                     eng.ReindexNode(
-                        g, n, (lambda salt: lambda key, row: Key(hash_values(key, salt)))(i)
+                        g, n,
+                        (lambda salt: lambda key, row: Key(hash_values(key, salt)))(i),
+                        # dp_rekey_salt: the salted keys blake in C, so
+                        # concat_reindex unions stay token-resident
+                        native_salt=i,
                     )
                     for i, n in enumerate(nodes)
                 ]
+                if all(t._spec.id in self._native_specs for t in spec.inputs):
+                    self._native_specs.add(spec.id)
             elif all(t._spec.id in self._native_specs for t in spec.inputs):
                 # token batches flow through concat untouched
                 self._native_specs.add(spec.id)
@@ -641,7 +702,11 @@ class Session:
                         isinstance(a, ex.ColumnReference)
                         and not isinstance(a, ex.IdReference)
                         and a.name in names
-                        and main._dtype_of(a.name) in (dt.INT, dt.STR, dt.BOOL)
+                        and (
+                            main._dtype_of(a.name) in (dt.INT, dt.STR, dt.BOOL)
+                            # pointer pieces blake identically in C
+                            or isinstance(main._dtype_of(a.name), dt.Pointer)
+                        )
                     ):
                         cols.append(names.index(a.name))
                     else:
@@ -650,8 +715,24 @@ class Session:
                 if cols:
                     native_cols = cols
                     self._native_specs.add(spec.id)
+            # with_id(<pointer column>): the new key IS the column value —
+            # key-level decode in C (dp_decode_key_col), no hashing at all
+            native_key_col = None
+            if native_cols is None and main._spec.id in self._native_specs:
+                from pathway_tpu.internals import dtype as dt2
+
+                names = main._column_names()
+                if (
+                    isinstance(key_expr, ex.ColumnReference)
+                    and not isinstance(key_expr, ex.IdReference)
+                    and key_expr.name in names
+                    and isinstance(main._dtype_of(key_expr.name), dt2.Pointer)
+                ):
+                    native_key_col = names.index(key_expr.name)
+                    self._native_specs.add(spec.id)
             return eng.ReindexNode(
-                g, main_node, key_fn, native_cols=native_cols
+                g, main_node, key_fn, native_cols=native_cols,
+                native_key_col=native_key_col,
             )
 
         if kind == "flatten":
@@ -818,6 +899,9 @@ class Session:
             it_node = self._get_iterate_node(it_spec)
             out_node = eng.InputNode(self.graph)
             it_node.set_output_node(name, out_node)
+            if eng.iterate_native_on():
+                # token-resident scope emissions arrive as NativeBatch
+                self._native_specs.add(spec.id)
             return out_node
 
         if kind == "row_transformer":
@@ -926,7 +1010,9 @@ class Session:
         # is a column or a numpy-compilable numeric expression. Gated off
         # FLOAT/ANY group columns: token identity is byte-based, and a
         # float column may carry int-valued rows (literal-faithful JSON)
-        # that Python dict equality would fold into one group.
+        # that Python dict equality would fold into one group. Pointer
+        # columns ARE stable (tag-6 pieces, no cross-type folding) — the
+        # graph workloads group by vertex pointers every round.
         native_plan = None
         if native_ok:
             names = main._column_names()
@@ -936,7 +1022,10 @@ class Session:
                     isinstance(e, ex.ColumnReference)
                     and not isinstance(e, ex.IdReference)
                     and e.name in names
-                    and main._dtype_of(e.name) in (dt.INT, dt.STR, dt.BOOL)
+                    and (
+                        main._dtype_of(e.name) in (dt.INT, dt.STR, dt.BOOL)
+                        or isinstance(main._dtype_of(e.name), dt.Pointer)
+                    )
                 ):
                     gb_cols.append(names.index(e.name))
                 else:
@@ -985,8 +1074,42 @@ class Session:
         gres = GroupResolver(gb_exprs, reducer_slots, main)
         fns = [compile_expression(e, gres) for e in out_exprs.values()]
         fn = self._guarded_row_fn(fns, getattr(spec, "trace", None))
+        # pure slot picks over a plan-mode groupby (which emits
+        # NativeBatch) splice in C: the reduce output — every hot loop's
+        # per-round aggregate — stays token-resident into downstream
+        # joins/maps instead of round-tripping through Python rows
+        splice_specs: list | None = None
+        if native_plan is not None:
+            splice_specs = []
+            for e in out_exprs.values():
+                if isinstance(e, ex.ReducerExpression) and id(e) in reducer_slots:
+                    splice_specs.append((0, reducer_slots[id(e)]))
+                    continue
+                if isinstance(e, ex.ColumnReference) and not isinstance(
+                    e, ex.IdReference
+                ):
+                    slot = next(
+                        (
+                            i
+                            for i, gexp in enumerate(gb_exprs)
+                            if isinstance(gexp, ex.ColumnReference)
+                            and gexp.name == e.name
+                        ),
+                        None,
+                    )
+                    if slot is not None:
+                        splice_specs.append((0, slot))
+                        continue
+                splice_specs = None
+                break
+            if splice_specs is not None:
+                self._native_specs.add(spec.id)
         return self._sharded(
-            [gnode], lambda sg, ins: eng.RowwiseNode(sg, ins, fn), [_route_key]
+            [gnode],
+            lambda sg, ins: eng.RowwiseNode(
+                sg, ins, fn, native_specs=splice_specs
+            ),
+            [_route_key],
         )
 
     # ---------------------------------------------------------------- join
@@ -1034,7 +1157,13 @@ class Session:
                         isinstance(e, ex.ColumnReference)
                         and not isinstance(e, ex.IdReference)
                         and e.name in names
-                        and table._dtype_of(e.name) in (dt.INT, dt.STR, dt.BOOL)
+                        and (
+                            table._dtype_of(e.name) in (dt.INT, dt.STR, dt.BOOL)
+                            # Pointer join keys (graph edges x vertex state
+                            # every iterate round) are byte-stable tag-6
+                            # pieces — no cross-type folding to preserve
+                            or isinstance(table._dtype_of(e.name), dt.Pointer)
+                        )
                     ):
                         cols.append(names.index(e.name))
                     else:
@@ -1153,7 +1282,10 @@ class Session:
         sub.mesh = None
         captures: dict[str, eng.CaptureNode] = {}
         for name, t in it_spec.results.items():
-            captures[name] = eng.CaptureNode(sub.graph, sub.node_of(t))
+            captures[name] = eng.CaptureNode(
+                sub.graph, sub.node_of(t),
+                token_resident=eng.iterate_native_on(),
+            )
         if sub.connectors:
             raise NotImplementedError(
                 "pw.iterate bodies cannot reference streaming connector "

@@ -16,6 +16,14 @@ protocol. Here the equivalents are:
 
 The host control plane carries arbitrary Python rows; bulk numeric
 columns ride the ICI all_to_all in parallel/exchange.py instead.
+
+Frame format: pickle PROTOCOL 5 with out-of-band buffers — a frame is
+``[n_bufs][pkl_len][pkl][buf_len buf]*`` under one outer length prefix.
+NativeBatch wire tuples keep their flat numpy columns as ndarrays, so
+their buffers ship out-of-band: the array bytes go straight from the
+array to the socket (and straight off the receive buffer into the
+reconstructed arrays) without ever being copied through the pickle
+stream. ``Mesh.stats`` counts frames/bytes and how much rode out-of-band.
 """
 
 from __future__ import annotations
@@ -91,6 +99,17 @@ class ProcessMesh:
         # quiesce protocol's "nothing new in flight" witness
         # (engine/runtime.py _mesh_quiesce)
         self.data_frames_sent = 0
+        # wire accounting (docs/parallelism.md): pickle-stream vs
+        # out-of-band bytes — oob is the zero-copy share protocol-5
+        # buffer_callback moved out of the pickle stream
+        self.stats = {
+            "frames_sent": 0,
+            "frames_recv": 0,
+            "bytes_sent": 0,
+            "bytes_recv": 0,
+            "oob_buffers_sent": 0,
+            "oob_bytes_sent": 0,
+        }
         self._closed = False
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -158,7 +177,7 @@ class ProcessMesh:
                 body = self._recv_exact(conn, _LEN.unpack(head)[0])
                 if body is None:
                     return
-                kind, payload = pickle.loads(body)  # noqa: S301 — trusted mesh
+                kind, payload = self._decode_frame(body)
                 with self._cv:
                     if kind == "data":
                         node_id, rnd, entries = payload
@@ -191,13 +210,54 @@ class ProcessMesh:
                     self._dead.add(peer)
                     self._cv.notify_all()
 
+    def _decode_frame(self, body: bytes) -> tuple:
+        """Inverse of ``_send``'s framing. Out-of-band buffers are handed
+        to pickle as memoryviews of the receive block — reconstructed
+        numpy arrays alias it (no per-array copy; the block stays alive
+        through their refcounts)."""
+        st = self.stats
+        st["frames_recv"] += 1
+        st["bytes_recv"] += len(body) + _LEN.size
+        mv = memoryview(body)
+        n_bufs = _LEN.unpack_from(mv, 0)[0]
+        pkl_len = _LEN.unpack_from(mv, _LEN.size)[0]
+        pos = 2 * _LEN.size
+        pkl = mv[pos : pos + pkl_len]
+        pos += pkl_len
+        bufs = []
+        for _ in range(n_bufs):
+            blen = _LEN.unpack_from(mv, pos)[0]
+            pos += _LEN.size
+            bufs.append(mv[pos : pos + blen])
+            pos += blen
+        # noqa: S301 — trusted mesh
+        return pickle.loads(pkl, buffers=bufs)
+
     def _send(self, peer: int, kind: str, payload: Any) -> None:
         # injected wire failure: surfaces to the caller exactly like a
         # peer socket error would (the supervisor path, not a hang)
         faults.check("mesh.send")
-        body = pickle.dumps((kind, payload), protocol=4)
+        bufs: list[pickle.PickleBuffer] = []
+        pkl = pickle.dumps(
+            (kind, payload), protocol=5, buffer_callback=bufs.append
+        )
+        raws = [b.raw() for b in bufs]
+        oob = sum(r.nbytes for r in raws)
+        total = 2 * _LEN.size + len(pkl) + sum(
+            _LEN.size + r.nbytes for r in raws
+        )
+        head = _LEN.pack(total) + _LEN.pack(len(raws)) + _LEN.pack(len(pkl))
+        st = self.stats
+        st["frames_sent"] += 1
+        st["bytes_sent"] += total + _LEN.size
+        st["oob_buffers_sent"] += len(raws)
+        st["oob_bytes_sent"] += oob
         with self._send_locks[peer]:
-            self._send_socks[peer].sendall(_LEN.pack(len(body)) + body)
+            sock = self._send_socks[peer]
+            sock.sendall(head + pkl)
+            for r in raws:  # zero-copy: each buffer goes straight out
+                sock.sendall(_LEN.pack(r.nbytes))
+                sock.sendall(r)
 
     # ------------------------------------------------------------ exchange
 

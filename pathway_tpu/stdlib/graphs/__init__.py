@@ -1,5 +1,12 @@
 """pw.graphs: iterative graph algorithms via pw.iterate
 (reference: stdlib/graphs/ — bellman_ford/, pagerank/, louvain_communities/).
+
+Execution: each algorithm's fixpoint loop runs in the engine's
+token-resident iterate scope — see docs/iterate.md for the nested-scope
+token plane, the C ⊖ P feedback identity, the fallback ladder, and the
+PATHWAY_ITERATE_NATIVE kill switch. pagerank and connected_components
+are formulated so every round stays on the native zset plane (pure-pick
+join selects, update_rows instead of join_left, vectorized arithmetic).
 """
 
 from __future__ import annotations
@@ -20,10 +27,20 @@ class Graph:
         self.E = E
 
 
+_PAGERANK_SCALE = 1_000_000_000  # fixed-point rank resolution (1e-9)
+
+
 def pagerank(edges: Table, steps: int = 50, damping: float = 0.85) -> Table:
     """PageRank over edges(u: Pointer, v: Pointer) -> (rank: float) keyed by
-    vertex (reference: stdlib/graphs/pagerank/impl.py; scaled-int ranks in
-    the reference, float here)."""
+    vertex (reference: stdlib/graphs/pagerank/impl.py).
+
+    Ranks iterate as SCALED INTEGERS (like the reference): integer
+    arithmetic is exact and summation-order independent, so the fixpoint
+    is bit-identical across the token and object planes and convergence
+    terminates exactly (float ranks can 2-cycle at the last ulp, where
+    the two planes' different summation orders diverge). The public
+    `rank` column is the float unscaling, computed once outside the loop.
+    """
     degs = edges.groupby(edges.u).reduce(edges.u, degree=red.count())
     vertices_u = edges.groupby(edges.u).reduce(vid=edges.u)
     vertices_v = edges.groupby(edges.v).reduce(vid=edges.v)
@@ -31,29 +48,64 @@ def pagerank(edges: Table, steps: int = 50, damping: float = 0.85) -> Table:
     vertices = vertices_u.concat_reindex(vertices_v).groupby(
         ex.this.vid
     ).reduce(vid=ex.this.vid)
+    scale = _PAGERANK_SCALE
+    base_add = int(round(scale * (1.0 - damping)))
+    dnum = int(round(damping * 10_000))
 
     def step(ranks: Table) -> dict[str, Table]:
-        # contribution of u along each edge = rank(u) / degree(u)
+        # contribution of u along each edge = rank(u) // degree(u). The
+        # joins select PURE column picks (fused into the C join emission)
+        # and the division runs as its own vectorized select — every
+        # round of the fixpoint stays on the native zset plane
         contribs = (
             edges.join(ranks, edges.u == ranks.vid)
             .select(u=ex.left.u, v=ex.left.v, rank=ex.right.rank)
             .join(degs, ex.left.u == degs.u)
-            .select(v=ex.left.v, contrib=ex.left.rank / ex.right.degree)
+            .select(v=ex.left.v, rank=ex.left.rank, degree=ex.right.degree)
+            .select(v=ex.this.v, contrib=ex.this.rank // ex.this.degree)
         )
         summed = contribs.groupby(contribs.v).reduce(
             vid=contribs.v, flow=red.sum(contribs.contrib)
         )
-        incoming = vertices.join_left(summed, vertices.vid == summed.vid).select(
-            vid=ex.left.vid, flow=coalesce(ex.right.flow, 0.0)
+        # inflow per vertex via key-addressed update_rows over a zero
+        # baseline (not join_left + coalesce): update_rows is a
+        # token-resident operator, so every round of the fixpoint stays
+        # on the native zset plane end to end (docs/iterate.md)
+        base = vertices.select(vid=vertices.vid, flow=0).with_id_from(
+            ex.this.vid
         )
-        new_ranks = incoming.select(
-            vid=incoming.vid, rank=(1.0 - damping) + damping * incoming.flow
+        incoming = base.update_rows(summed.with_id_from(ex.this.vid))
+        raw = incoming.select(
+            vid=incoming.vid,
+            rank=base_add + (incoming.flow * dnum) // 10_000,
         ).with_id_from(ex.this.vid)
+        # hysteresis snap: floor-division noise (±1 unit per hop) can
+        # ping-pong the integer fixpoint in a persistent micro-cycle;
+        # updates within ±2 fixed-point units (2e-9 rank) keep the OLD
+        # value, so the contraction provably reaches an exact fixpoint
+        new_ranks = (
+            raw.join(ranks, raw.vid == ranks.vid)
+            .select(vid=ex.left.vid, new=ex.left.rank, old=ex.right.rank)
+            .select(
+                vid=ex.this.vid,
+                rank=if_else(
+                    (ex.this.new - ex.this.old <= 2)
+                    & (ex.this.new - ex.this.old >= -2),
+                    ex.this.old,
+                    ex.this.new,
+                ),
+            )
+            .with_id_from(ex.this.vid)
+        )
         return {"ranks": new_ranks}
 
-    init = vertices.select(vid=vertices.vid, rank=1.0).with_id_from(ex.this.vid)
+    init = vertices.select(vid=vertices.vid, rank=scale).with_id_from(
+        ex.this.vid
+    )
     result = iterate(lambda ranks: step(ranks), iteration_limit=steps, ranks=init)
-    return result
+    return result.select(
+        vid=result.vid, rank=result.rank / scale
+    ).with_id_from(ex.this.vid)
 
 
 def bellman_ford(vertices: Table, edges: Table) -> Table:
@@ -99,6 +151,47 @@ def bellman_ford(vertices: Table, edges: Table) -> Table:
 
     result = iterate(lambda state: step(state), state=init)
     return result.without("vid")
+
+
+def connected_components(edges: Table) -> Table:
+    """Connected components over undirected edges(u: Pointer, v: Pointer)
+    -> (vid: Pointer, rep: Pointer) keyed by vertex: every vertex labeled
+    with its component's representative (the minimum vertex pointer in
+    the 128-bit key order). Min-label propagation via pw.iterate
+    (docs/iterate.md): an edge update re-converges from the previous
+    fixpoint in O(affected), like pagerank.
+    """
+    # undirected closure: propagate along both directions of each edge
+    fwd = edges.select(a=edges.u, b=edges.v)
+    bwd = edges.select(a=edges.v, b=edges.u)
+    arcs = fwd.concat_reindex(bwd)
+    vertices = (
+        arcs.groupby(arcs.a).reduce(vid=arcs.a)
+        .concat_reindex(arcs.groupby(arcs.b).reduce(vid=arcs.b))
+        .groupby(ex.this.vid)
+        .reduce(vid=ex.this.vid)
+    )
+
+    def step(labels: Table) -> dict[str, Table]:
+        # candidate label for b = label(a) along each arc; keep the min
+        # of (own label, neighbor candidates) per vertex
+        cand = (
+            arcs.join(labels, arcs.a == labels.vid)
+            .select(vid=ex.left.b, lab=ex.right.lab)
+            .concat_reindex(labels.select(vid=labels.vid, lab=labels.lab))
+        )
+        best = cand.groupby(cand.vid).reduce(
+            vid=cand.vid, lab=red.min(cand.lab)
+        )
+        return {"labels": best.with_id_from(ex.this.vid)}
+
+    init = vertices.select(
+        vid=vertices.vid, lab=vertices.vid
+    ).with_id_from(ex.this.vid)
+    labels = iterate(lambda labels: step(labels), labels=init)
+    return labels.select(vid=labels.vid, rep=labels.lab).with_id_from(
+        ex.this.vid
+    )
 
 
 def _with_weight(E: Table) -> Table:

@@ -12,6 +12,11 @@ Leg 4 (chaos-quick): the fast crash-recovery equivalence drill
 (scripts/chaos_drill.py --quick, 4 fault kinds x 1 seed) — a crashed,
 torn, flapped, or degraded run must recover to output byte-identical to
 the fault-free baseline (docs/robustness.md).
+Leg 5 (iterate-object): the iterate equivalence suite with the
+token-resident scope's kill switch thrown (PATHWAY_ITERATE_NATIVE=0) on
+the otherwise-native engine — the object plumbing must stay
+byte-identical to the token plane (docs/iterate.md). The token side of
+the same suite already runs inside legs 1-2.
 
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
@@ -122,6 +127,15 @@ def main() -> int:
         run_leg("workers-t1", {"PATHWAY_THREADS": "1"}, extra, INVARIANCE_PATHS),
         run_leg("workers-t4", {"PATHWAY_THREADS": "4"}, extra, INVARIANCE_PATHS),
         run_chaos_leg(),
+        run_leg(
+            "iterate-object", {"PATHWAY_ITERATE_NATIVE": "0"}, extra,
+            [
+                "tests/test_iterate_native.py",
+                "tests/test_iterate.py",
+                "tests/test_iterate_matrix.py",
+                "tests/test_graphs.py",
+            ],
+        ),
     ]
     ok = all(l["rc"] == 0 and l["failed"] == 0 and l["passed"] > 0 for l in legs)
     dirty = bool(
