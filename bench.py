@@ -908,6 +908,34 @@ def bench_dataflow(repo: str) -> dict:
             ),
             1,
         )
+        # observability overhead rung: the same wordcount with the full
+        # instrumentation plane on (wave tracing + metrics + flight
+        # ring). Acceptance: <10% enabled; the disabled cost IS the
+        # baseline above (every probe is one `PLANE is None` test).
+        obs_rate = _run_engine_script(
+            wc, {"PATHWAY_THREADS": "1", "PATHWAY_OBSERVABILITY": "1"},
+            stats=stats, rung="wordcount_obs_rows_per_sec",
+        )
+        out["wordcount_obs_rows_per_sec"] = round(obs_rate, 1)
+        out["observability_overhead_pct"] = round(
+            (1.0 - obs_rate / out["wordcount_rows_per_sec"]) * 100, 1
+        )
+        # profiler attribution rung: one profiled run must attribute
+        # >=95% of pipeline wall to named operators/stages and state the
+        # ingest share (docs/observability.md)
+        prof_path = os.path.join(tmp, "wc_profile.json")
+        try:
+            _run_engine_script_once(
+                wc, {"PATHWAY_THREADS": "1", "PATHWAY_PROFILE": prof_path},
+            )
+            with open(prof_path) as f:
+                prof = json.load(f)
+            out["wordcount_profile_attributed_pct"] = prof["attributed_pct"]
+            out["wordcount_profile_ingest_share"] = prof["ingest_share"]
+        except (RuntimeError, OSError, ValueError) as e:
+            out["wordcount_profile_attributed_pct"] = None
+            out["wordcount_profile_ingest_share"] = None
+            out["wordcount_profile_skip_reason"] = f"failed: {e}"
         # the object plane is ~10x slower; a 1M-row run measures the same
         # per-row rate without an extra minute of bench wall-clock
         n_py = WORDCOUNT_ROWS // 5
@@ -1088,6 +1116,22 @@ def bench_dataflow(repo: str) -> dict:
             - out["join_rows_per_sec"] / out["join_pretokenized_rows_per_sec"],
             3,
         )
+        # profiled join: the profiler's per-stage report must reconcile
+        # with the A/B-measured join_ingest_share above (same pipeline,
+        # attribution instead of differential measurement)
+        jprof_path = os.path.join(tmp, "join_profile.json")
+        try:
+            _run_engine_script_once(
+                js, {"PATHWAY_THREADS": "1", "PATHWAY_PROFILE": jprof_path},
+            )
+            with open(jprof_path) as f:
+                jprof = json.load(f)
+            out["join_profile_attributed_pct"] = jprof["attributed_pct"]
+            out["join_profile_ingest_share"] = jprof["ingest_share"]
+        except (RuntimeError, OSError, ValueError) as e:
+            out["join_profile_attributed_pct"] = None
+            out["join_profile_ingest_share"] = None
+            out["join_profile_skip_reason"] = f"failed: {e}"
 
         rinp = os.path.join(tmp, "reg.jsonl")
         _gen_regression_input(rinp, REGRESSION_ROWS)

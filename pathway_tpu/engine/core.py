@@ -19,6 +19,7 @@ from typing import Any, Callable, Iterable, Sequence
 
 import numpy as np
 
+from pathway_tpu.internals import observability as _obs
 from pathway_tpu.internals.errors import ERROR, ErrorValue, global_error_log
 from pathway_tpu.internals.keys import (
     Key,
@@ -330,6 +331,19 @@ class Node:
         # user-frame trace (set by lowering from the op spec) — enriches
         # runtime error messages with the pipeline call site
         self.trace: str | None = None
+        # plan-node label (the op-spec kind, set by lowering): what makes
+        # two GroupByNodes distinguishable in the TUI, logs and metrics
+        self.label: str | None = None
+
+    def describe(self) -> str:
+        """Human identity for monitors/metrics: type, plan label, call
+        site when known, and the node id."""
+        base = type(self).__name__
+        if self.label:
+            base += f"[{self.label}]"
+        if self.trace:
+            base += f"@{self.trace}"
+        return f"{base}#{self.node_id}"
 
     def log_error(self, message: str) -> None:
         if self.trace:
@@ -488,10 +502,14 @@ class Graph:
     def step(self, time: int) -> None:
         from time import perf_counter_ns
 
+        plane = _obs.PLANE
         for node in self.nodes:
             t0 = perf_counter_ns()
             node.finish_time(time)
-            node.time_ns += perf_counter_ns() - t0
+            elapsed = perf_counter_ns() - t0
+            node.time_ns += elapsed
+            if plane is not None:
+                plane.wave(node, time, elapsed)
 
     def end(self, time: int) -> None:
         # per node: drain buffered input FIRST, then end-of-stream hooks —
@@ -499,9 +517,22 @@ class Graph:
         # flush, delivered via topo order) before its on_end closes the
         # file. Upstream on_end emissions still precede every downstream
         # node's finish_time because nodes run in topological order.
+        plane = _obs.PLANE
+        if plane is None:
+            for node in self.nodes:
+                node.finish_time(time)
+                node.on_end(time)
+            return
+        from time import perf_counter_ns
+
         for node in self.nodes:
+            t0 = perf_counter_ns()
             node.finish_time(time)
             node.on_end(time)
+            # record the end-flush span for the profiler/histograms but
+            # do NOT fold it into time_ns: the seconds-total stat must
+            # read the same whether instrumentation is on or off
+            plane.wave(node, time, perf_counter_ns() - t0)
 
 
 class InputNode(Node):

@@ -45,6 +45,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable
 
 from pathway_tpu.engine import faults
+from pathway_tpu.internals import observability as _obs
 
 __all__ = [
     "BucketPolicy",
@@ -175,6 +176,12 @@ class DeviceProgram:
             # quarantined bucket, cooldown still running: host path
             with self._lock:
                 self.host_fallbacks += 1
+            if _obs.PLANE is not None:
+                _obs.PLANE.metrics.counter(
+                    "pathway_device_host_fallbacks_total",
+                    {"program": self.name},
+                    help="dispatches served by the host path",
+                )
             return self._fn(*args, **kwargs)
         # bookkeeping only under the lock; the dispatch itself runs
         # outside it so overlapping stages never serialize here
@@ -209,10 +216,47 @@ class DeviceProgram:
                     q["failures"]
                 )
                 self.host_fallbacks += 1
+                failures = q["failures"]
+            if _obs.PLANE is not None:
+                _obs.PLANE.record(
+                    "device.quarantine", program=self.name,
+                    bucket=repr(bucket), failures=failures,
+                    error=f"{type(e).__name__}: {e}"[:300],
+                )
+                _obs.PLANE.metrics.counter(
+                    "pathway_device_dispatch_failures_total",
+                    {"program": self.name},
+                    help="device dispatches that degraded to the host path",
+                )
+                # this dispatch is ALSO served by the host path below —
+                # the fallback counter must agree with host_fallbacks
+                _obs.PLANE.metrics.counter(
+                    "pathway_device_host_fallbacks_total",
+                    {"program": self.name},
+                    help="dispatches served by the host path",
+                )
             return self._fn(*args, **kwargs)
         with self._lock:
-            if bucket in self.quarantine:
-                self.quarantine.pop(bucket, None)  # probe succeeded
+            lifted = self.quarantine.pop(bucket, None) is not None
+        if _obs.PLANE is not None:
+            if lifted:
+                _obs.PLANE.record(
+                    "device.quarantine_lift", program=self.name,
+                    bucket=repr(bucket),
+                )
+            if fresh_sig:
+                _obs.PLANE.record(
+                    "device.compile", program=self.name, bucket=repr(bucket),
+                )
+                _obs.PLANE.metrics.counter(
+                    "pathway_device_compiles_total",
+                    {"program": self.name},
+                    help="XLA compilations charged to the program",
+                )
+            _obs.PLANE.metrics.counter(
+                "pathway_device_dispatches_total", {"program": self.name},
+                help="device dispatches through the plane",
+            )
         return out
 
     def _cooldown(self, failures: int) -> float:

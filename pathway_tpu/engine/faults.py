@@ -177,6 +177,12 @@ class FaultSchedule:
         self._lock = threading.Lock()
 
     def decide(self, point: str) -> bool:
+        return self.decide_hit(point)[0]
+
+    def decide_hit(self, point: str) -> tuple[bool, int]:
+        """(fired, hit) under one lock hold — callers that record the
+        shot must use THIS hit number, not a later hit_count() read
+        (concurrent probes of the same point would skew it)."""
         with self._lock:
             hit = self._hits.get(point, 0) + 1
             self._hits[point] = hit
@@ -187,7 +193,7 @@ class FaultSchedule:
             )
             if fired:
                 self._fired.append((point, hit))
-            return fired
+            return fired, hit
 
     @property
     def fired(self) -> list[tuple[str, int]]:
@@ -248,6 +254,21 @@ def active() -> bool:
     return s is not None
 
 
+def _note_shot(point: str, hit: int, action: str) -> None:
+    """Feed the fired shot into the observability flight recorder (one
+    event per SHOT, never per probe — probes that don't fire cost only
+    the schedule lookup). The drill asserts every entry of `fired_log`
+    has a matching recorder event (scripts/chaos_drill.py)."""
+    from pathway_tpu.internals import observability as obs
+
+    if obs.PLANE is not None:
+        obs.PLANE.record("fault", point=point, hit=hit, action=action)
+        obs.PLANE.metrics.counter(
+            "pathway_faults_fired_total", {"point": point},
+            help="injected fault shots by point",
+        )
+
+
 def fire(point: str) -> bool:
     """Probe an injection point: True when the schedule says this hit
     fails. The caller performs the domain-appropriate damage (tear a
@@ -255,7 +276,10 @@ def fire(point: str) -> bool:
     s = _SCHEDULE if _RESOLVED else _resolve()
     if s is None:
         return False
-    return s.decide(point)
+    fired, hit = s.decide_hit(point)
+    if fired:
+        _note_shot(point, hit, "fire")
+    return fired
 
 
 def check(point: str) -> None:
@@ -264,8 +288,10 @@ def check(point: str) -> None:
     s = _SCHEDULE if _RESOLVED else _resolve()
     if s is None:
         return
-    if s.decide(point):
-        raise FaultInjected(point, s.hit_count(point))
+    fired, hit = s.decide_hit(point)
+    if fired:
+        _note_shot(point, hit, "check")
+        raise FaultInjected(point, hit)
 
 
 def crash(point: str) -> None:
@@ -274,11 +300,21 @@ def crash(point: str) -> None:
     s = _SCHEDULE if _RESOLVED else _resolve()
     if s is None:
         return
-    if s.decide(point):
+    fired, hit = s.decide_hit(point)
+    if fired:
+        _note_shot(point, hit, "crash")
         hard_crash()
 
 
 def hard_crash() -> None:
+    # black-box before the box disappears: the flight recorder's dump is
+    # the only record a kill -9-style exit leaves behind
+    try:
+        from pathway_tpu.internals import observability as obs
+
+        obs.dump_flight("crash")
+    except Exception:  # noqa: BLE001 — nothing may delay the crash path
+        pass
     os._exit(CRASH_EXIT_CODE)
 
 

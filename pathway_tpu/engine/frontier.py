@@ -41,6 +41,8 @@ import math
 from time import perf_counter_ns
 from typing import Any, Callable, Iterable
 
+from pathway_tpu.internals import observability as _obs
+
 # The empty frontier: the source has promised it will never deliver
 # again. min() over mixed int/float watermarks keeps working.
 DONE = math.inf
@@ -153,11 +155,15 @@ class _Pend:
     source payloads to deliver, and input stashed while the operator's
     frontier had not yet passed the timestamp."""
 
-    __slots__ = ("payloads", "stash")
+    __slots__ = ("payloads", "stash", "t0")
 
     def __init__(self) -> None:
         self.payloads: list[tuple[str, Any]] = []  # (kind, payload)
         self.stash: list[tuple[list, list, list | None]] = []
+        # wave tracing: when this notification was first queued — the
+        # fire-time delta is the wave's queue wait (observability plane
+        # only; 0 keeps the disabled hot path at one predicate test)
+        self.t0 = perf_counter_ns() if _obs.PLANE is not None else 0
 
 
 class FrontierScheduler:
@@ -485,7 +491,19 @@ class FrontierScheduler:
         self._cost_ns[slot] = (
             elapsed if ema is None else 0.5 * ema + 0.5 * elapsed
         )
-        self._stash_emissions(slot, t)
+        plane = _obs.PLANE
+        if plane is None:
+            self._stash_emissions(slot, t)
+        else:
+            s0 = perf_counter_ns()
+            self._stash_emissions(slot, t)
+            plane.wave(
+                node, t,
+                exec_ns=elapsed,
+                queue_ns=max(t0 - pend.t0, 0) if pend.t0 else 0,
+                stash_ns=perf_counter_ns() - s0,
+                injected=bool(below),
+            )
         self.waves_fired += 1
 
     def pump(self, budget: int | None = None) -> int:

@@ -18,6 +18,7 @@ from typing import Any, Callable, Iterable, Sequence
 import numpy as np
 
 from pathway_tpu.engine import faults
+from pathway_tpu.internals import observability as _obs
 from pathway_tpu.engine.core import (
     CaptureNode,
     Entry,
@@ -249,6 +250,17 @@ class Runtime:
         while the straggler catches up (frontier semantics; previously
         every wave stepped the whole graph at one shared timestamp).
         """
+        try:
+            self._run_streaming()
+        except BaseException as e:
+            if _obs.PLANE is not None:
+                _obs.PLANE.record(
+                    "runtime.error", error=f"{type(e).__name__}: {e}"[:500]
+                )
+                _obs.dump_flight("error")
+            raise
+
+    def _run_streaming(self) -> None:
         for c in self.connectors:
             c.start()
         if not self.connectors:
@@ -263,11 +275,23 @@ class Runtime:
         closed: set = set()
         ckpt_dirty = False
         while True:
-            _time.sleep(self.autocommit_ms / 1000.0)
-            for c in self.connectors:
-                entries = c.poll()
-                if entries:
-                    sched.stage(src[c], self.next_time(), entries)
+            plane = _obs.PLANE
+            if plane is None:
+                _time.sleep(self.autocommit_ms / 1000.0)
+                for c in self.connectors:
+                    entries = c.poll()
+                    if entries:
+                        sched.stage(src[c], self.next_time(), entries)
+            else:
+                t0 = _time.perf_counter()
+                _time.sleep(self.autocommit_ms / 1000.0)
+                t1 = _time.perf_counter()
+                plane.stage_seconds("idle", t1 - t0)
+                for c in self.connectors:
+                    entries = c.poll()
+                    if entries:
+                        sched.stage(src[c], self.next_time(), entries)
+                plane.stage_seconds("poll", _time.perf_counter() - t1)
             stopped = self.stop_event is not None and self.stop_event.is_set()
             for c in self.connectors:
                 if (stopped or c.done) and src[c] not in closed:
@@ -280,6 +304,15 @@ class Runtime:
                 # chaos drills: die hard right after a wave retired, with
                 # its input offsets consumed but no checkpoint cut yet
                 faults.crash("runtime.wave")
+            if plane is not None:
+                plane.tick_sources(
+                    self.time,
+                    lambda: [
+                        (c.name, sched.watermark(src[c]))
+                        for c in self.connectors
+                    ],
+                    sched.global_frontier,
+                )
             # checkpoint on cadence whenever there is anything new to
             # commit — retired waves OR offset-frontier advances (a
             # quiet stream whose source finished a file still needs its
@@ -296,7 +329,14 @@ class Runtime:
                 # within a dispatch, so the cut lands next cadence.
                 and not sched.has_async()
             ):
-                self.checkpointer.checkpoint(self.time)
+                if plane is None:
+                    self.checkpointer.checkpoint(self.time)
+                else:
+                    t0 = _time.perf_counter()
+                    self.checkpointer.checkpoint(self.time)
+                    plane.stage_seconds(
+                        "checkpoint", _time.perf_counter() - t0
+                    )
                 ckpt_dirty = False
             if len(closed) == len(self.connectors):
                 # final drain: anything staged between the last poll and
@@ -418,6 +458,7 @@ class Runtime:
         Returns the final allgather view {proc: local_time}."""
         prev_sent: dict | None = None
         r = 0
+        q0 = _time.perf_counter()
         deadline = _time.monotonic() + self._QUIESCE_TIMEOUT_S
         while True:
             sched.advance_local(self.time)
@@ -443,6 +484,18 @@ class Runtime:
             drained = all(v[1] for v in view.values())
             sent_now = {p: v[2] for p, v in view.items()}
             if r + 1 >= rounds and drained and sent_now == prev_sent:
+                if _obs.PLANE is not None:
+                    # metric only: waves fired inside the fence window are
+                    # already attributed per-operator by the scheduler's
+                    # span hook — feeding the window to the profiler too
+                    # would count that wall-clock twice
+                    _obs.PLANE.stage_seconds(
+                        "quiesce", _time.perf_counter() - q0, profile=False
+                    )
+                    _obs.PLANE.record(
+                        "mesh.quiesce", export=False, tag=tag, rounds=r + 1,
+                        time=self.time,
+                    )
                 return {p: v[0] for p, v in view.items()}
             prev_sent = sent_now
             r += 1
@@ -492,6 +545,22 @@ class Runtime:
         all processes snapshot the same epoch — mutually consistent by
         construction (no wave is half-absorbed anywhere).
         """
+        try:
+            self._run_mesh(static_batches)
+        except BaseException as e:
+            # postmortem before the supervisor restarts the generation:
+            # the recorder holds the last waves/frames/faults this worker
+            # saw, which is exactly what "why did the mesh die" needs
+            if _obs.PLANE is not None:
+                _obs.PLANE.record(
+                    "runtime.error", error=f"{type(e).__name__}: {e}"[:500]
+                )
+                _obs.dump_flight("error")
+            raise
+
+    def _run_mesh(
+        self, static_batches: list[tuple[int, InputNode, list[Entry]]] | None = None
+    ) -> None:
         from pathway_tpu.engine.frontier import DONE
         from pathway_tpu.engine.workers import ProcessExchangeNode
         from pathway_tpu.parallel.process_mesh import WorkerLost
@@ -569,6 +638,15 @@ class Runtime:
                 # _pump_mesh, so fence-quiesce waves count too)
                 if self._pump_mesh(sched, mesh, xnodes, wm_sent):
                     ckpt_dirty = True
+                if _obs.PLANE is not None:
+                    _obs.PLANE.tick_sources(
+                        self.time,
+                        lambda: [
+                            (c.name, sched.watermark(src[c]))
+                            for c in self.connectors
+                        ],
+                        sched.global_frontier,
+                    )
                 # 4. checkpoint fences (cadence owned by process 0)
                 if (
                     mesh.process_id == 0
@@ -640,7 +718,14 @@ class Runtime:
                         self.checkpointer.checkpoint(t_end)
                         self.checkpointer.close()
                     break
-                mesh.wait_frames(self.autocommit_ms / 1000.0)
+                if _obs.PLANE is None:
+                    mesh.wait_frames(self.autocommit_ms / 1000.0)
+                else:
+                    t0 = _time.perf_counter()
+                    mesh.wait_frames(self.autocommit_ms / 1000.0)
+                    _obs.PLANE.stage_seconds(
+                        "idle", _time.perf_counter() - t0
+                    )
         finally:
             mesh.frontier_inbox = False
 
@@ -759,6 +844,8 @@ class Runtime:
                         f"{self._ASYNC_STALL_S:.0f}s"
                     )
                 _time.sleep(0.0005)
+                if _obs.PLANE is not None:
+                    _obs.PLANE.stage_seconds("idle", 0.0005)
             else:
                 stalls += 1
                 if stalls > 10_000:
@@ -816,6 +903,8 @@ class Runtime:
                         f"unresolved after {self._ASYNC_STALL_S:.0f}s"
                     )
                 _time.sleep(0.0005)  # a deferred wave is still computing
+                if _obs.PLANE is not None:
+                    _obs.PLANE.stage_seconds("idle", 0.0005)
             else:
                 stalls += 1
                 if stalls > 10_000:

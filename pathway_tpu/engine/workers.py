@@ -405,6 +405,9 @@ class ProcessExchangeNode(Node):
         super().__init__(graph, [inp])
         self.mesh = mesh
         self.route = route
+        # plan-node label: exchange boundaries are not spec-built, so the
+        # wire id is their identity in metrics/monitors
+        self.label = f"exchange:w{wire_id}"
         # token-resident route plan (('key',) | ('group', cols)): native
         # batches split in C and cross the mesh in wire form — unique-row
         # blob + flat arrays — instead of per-row pickled tuples

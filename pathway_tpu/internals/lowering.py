@@ -206,6 +206,7 @@ class Session:
         spec = table._spec
         if spec.id in self.cache:
             return self.cache[spec.id]
+        n_before = len(self.graph.nodes)
         node = self._build(table, spec)
         # user-frame trace for runtime error messages (trace.py parity)
         trace = getattr(spec, "trace", None)
@@ -213,6 +214,30 @@ class Session:
             node.trace = trace
             for replica in getattr(node, "replicas", []):
                 replica.trace = trace
+        # plan-node label: the op-spec kind names WHAT the operator is in
+        # the pipeline (groupby/join/select/...), which is what the TUI,
+        # logs and metrics show — two GroupByNodes stay distinguishable
+        # via label + call-site trace + node id (Node.describe). Interior
+        # nodes a spec builds (the GroupByNode under a reduce's rowwise
+        # tail, join arrangement halves, …) were registered during
+        # _build: label every still-unlabeled one with this spec's kind —
+        # nodes of nested input specs got theirs first via the recursive
+        # node_of, so the sweep only touches this spec's own nodes.
+        label = spec.kind
+        if spec.kind == "connector":
+            label = f"connector:{spec.params.get('name') or ''}"
+        for interior in self.graph.nodes[n_before:]:
+            if interior.label is None:
+                interior.label = label
+                if trace and interior.trace is None:
+                    interior.trace = trace
+                for replica in getattr(interior, "replicas", []):
+                    if replica.label is None:
+                        replica.label = label
+        if node.label is None:
+            node.label = label
+            for replica in getattr(node, "replicas", []):
+                replica.label = label
         # semantic fingerprint incl. UDF bytecode — persistence signature
         # invalidates snapshots when only a function body changes. Kept
         # LAZY (spec reference, hashed on first access) so sessions that
@@ -1316,7 +1341,9 @@ class Session:
     # ------------------------------------------------------------- execute
 
     def capture(self, table: Table) -> eng.CaptureNode:
-        return eng.CaptureNode(self.graph, self.node_of(table))
+        node = eng.CaptureNode(self.graph, self.node_of(table))
+        node.label = "capture"
+        return node
 
     def subscribe(
         self,
@@ -1327,16 +1354,20 @@ class Session:
     ) -> None:
         from pathway_tpu.engine.core import SubscribeNode
 
-        SubscribeNode(self.graph, self.node_of(table), on_change, on_time_end, on_end)
+        node = SubscribeNode(
+            self.graph, self.node_of(table), on_change, on_time_end, on_end
+        )
+        node.label = "subscribe"
 
     def output(
         self, table: Table, write_batch: Callable, flush=None, close=None,
         write_native: Callable | None = None,
     ) -> None:
-        OutputNode(
+        node = OutputNode(
             self.graph, self.node_of(table), write_batch, flush, close,
             write_native=write_native,
         )
+        node.label = "output"
 
     def execute(self) -> None:
         runtime = Runtime(self.graph, autocommit_ms=self.autocommit_ms)

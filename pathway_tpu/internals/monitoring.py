@@ -53,7 +53,10 @@ class StatsMonitor:
             "rate": rate,
             "hot": [
                 {
-                    "op": f"{type(n).__name__}#{n.node_id}",
+                    # plan-node label + call site via describe(): two
+                    # GroupByNodes (different groupbys) stay apart in the
+                    # TUI/log line, not just by opaque node id
+                    "op": n.describe(),
                     "rows_in": n.rows_in,
                     "rows_out": n.rows_out,
                     "latency_ms": n.time_ns / 1e6,

@@ -3,11 +3,44 @@
 
 from __future__ import annotations
 
+import json
+import logging
 from typing import Any
 
+from pathway_tpu.internals import observability as obs
 from pathway_tpu.internals.config import get_config
 from pathway_tpu.internals.lowering import Session
 from pathway_tpu.internals.parse_graph import G
+
+logger = logging.getLogger("pathway_tpu.run")
+
+
+def _arm_observability(
+    observability: bool | None, profile: bool | str | None
+) -> str | None:
+    """Resolve the observability/profile switches (explicit args win over
+    PATHWAY_OBSERVABILITY / PATHWAY_PROFILE) and return the profile
+    output path, if profiling. The plane stays process-wide; the
+    profiler is re-armed fresh per run so reports never mix runs."""
+    profile_path: str | None = None
+    if profile:
+        profile_path = (
+            "pathway_profile.json" if profile is True else str(profile)
+        )
+        obs.enable(profile=True)
+    elif observability or observability is None:
+        if observability:
+            obs.enable()
+        else:
+            obs.maybe_enable_from_env()
+        # PATHWAY_PROFILE is its own switch: honored whether the plane
+        # came from the env or from an explicit observability=True
+        profile_path = obs.profile_path_from_env()
+        if profile_path is not None:
+            obs.enable(profile=True)
+    if profile_path is not None and obs.PLANE is not None:
+        obs.PLANE.profiler = obs.Profiler()  # per-run window
+    return profile_path
 
 
 def run(
@@ -22,8 +55,14 @@ def run(
     terminate_on_error: bool = False,
     autocommit_duration_ms: int | None = None,
     device: str | None = None,
+    observability: bool | None = None,
+    profile: bool | str | None = None,
     **kwargs: Any,
 ) -> None:
+    import time as _time
+
+    profile_path = _arm_observability(observability, profile)
+    _build_t0 = _time.perf_counter()
     session = Session()
     session.graph.terminate_on_error = terminate_on_error or get_config().terminate_on_error
     if autocommit_duration_ms:
@@ -67,12 +106,35 @@ def run(
     from pathway_tpu.internals.telemetry import attach_telemetry
 
     telemetry = attach_telemetry(session, get_config().monitoring_server)
+    spine_exporter = None
+    if obs.PLANE is not None:
+        # graph build + lowering (incl. the session's one-time parallel/
+        # jax machinery import) is its own profile stage — without it the
+        # report would blame ~1s of library init on "unattributed"
+        obs.PLANE.stage_seconds("build", _time.perf_counter() - _build_t0)
+        if telemetry is not None:
+            # observability-spine events flow out the telemetry pipe too
+            spine_exporter = telemetry.export_event
+            obs.PLANE.add_exporter(spine_exporter)
+    dumps_before = (
+        len(obs.PLANE.recorder.dumped) if obs.PLANE is not None else 0
+    )
     try:
         if telemetry is not None:
             with telemetry.span("run"):
                 session.execute()
         else:
             session.execute()
+    except BaseException:
+        # outer net for errors outside the runtime pumps (lowering,
+        # persistence attach, static pump) — the pumps dump their own
+        # richer record first, so skip if one already landed this run
+        if (
+            obs.PLANE is not None
+            and len(obs.PLANE.recorder.dumped) == dumps_before
+        ):
+            obs.dump_flight("run-error")
+        raise
     finally:
         # restore the terminal if the monitoring TUI was live
         for m in session.monitors:
@@ -82,9 +144,22 @@ def run(
                     live.stop()
                 except Exception:  # noqa: BLE001
                     pass
+        if spine_exporter is not None and obs.PLANE is not None:
+            obs.PLANE.remove_exporter(spine_exporter)
         if telemetry is not None:
             telemetry.operator_stats(session.graph)
             telemetry.shutdown()
+    plane = obs.PLANE
+    if plane is not None and plane.profiler is not None and profile_path:
+        report = plane.profiler.report(session.graph)
+        with open(profile_path, "w") as f:
+            json.dump(report, f, indent=2)
+        logger.info(
+            "profile: %.2fs wall (%.1f%% attributed, ingest share %.1f%%)"
+            " -> %s",
+            report["total_s"], report["attributed_pct"],
+            100.0 * report["ingest_share"], profile_path,
+        )
 
 
 def run_all(**kwargs: Any) -> None:

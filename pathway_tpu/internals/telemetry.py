@@ -132,6 +132,7 @@ class Telemetry:
                 {
                     "kind": "operator",
                     "operator": type(node).__name__,
+                    "label": getattr(node, "label", None) or "",
                     "id": node.node_id,
                     "rows_in": node.rows_in,
                     "rows_out": node.rows_out,
@@ -140,6 +141,16 @@ class Telemetry:
                     "ts": time.time(),
                 }
             )
+
+    def export_event(self, event: dict) -> None:
+        """Observability-spine subscriber: structured events (faults,
+        breaker flips, device quarantines, mesh quiesces) flow out the
+        same JSONL/OTLP pipe as spans and metrics. High-volume wave spans
+        are ring-only by design (observability.ObservabilityPlane.record
+        export=False) — they arrive as histograms instead."""
+        self.exporter.export(
+            {"kind": "event", "run_id": self.run_id, **event}
+        )
 
     def shutdown(self) -> None:
         self.exporter.shutdown()

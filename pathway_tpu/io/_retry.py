@@ -37,6 +37,7 @@ import time as _time
 from typing import Any, Callable, Iterator
 
 from pathway_tpu.engine import faults
+from pathway_tpu.internals import observability as _obs
 
 __all__ = ["RetryPolicy", "CircuitOpen"]
 
@@ -94,6 +95,9 @@ class RetryPolicy:
         self._last_error: BaseException | None = None
         self.attempts_total = 0
         self.retries_total = 0
+        # /metrics + /statistics export breaker state per policy — a
+        # WeakSet registration, so dropped policies vanish on their own
+        _obs.register_retry_policy(self)
 
     # ------------------------------------------------------------- breaker
 
@@ -124,14 +128,27 @@ class RetryPolicy:
             raise CircuitOpen(self.name, self._last_error)
 
     def _record_success(self) -> None:
+        closed = False
         with self._lock:
             self._consecutive_failures = 0
             if self.state != "closed":
                 self.state = "closed"
                 self._open_count = 0
+                closed = True
             self._last_error = None
+        if closed and _obs.PLANE is not None:
+            _obs.PLANE.record("breaker.close", policy=self.name)
 
     def _record_failure(self, err: BaseException) -> None:
+        if _obs.PLANE is not None:
+            _obs.PLANE.record(
+                "retry.failure", export=False, policy=self.name,
+                error=f"{type(err).__name__}: {err}"[:300],
+            )
+            _obs.PLANE.metrics.counter(
+                "pathway_retry_failures_total", {"policy": self.name},
+                help="failed attempts recorded by retry policies",
+            )
         opened = False
         with self._lock:
             self._last_error = err
@@ -152,6 +169,16 @@ class RetryPolicy:
                 self._open_count += 1
                 opened = True
         if opened:
+            if _obs.PLANE is not None:
+                _obs.PLANE.record(
+                    "breaker.open", policy=self.name,
+                    failures=self._consecutive_failures,
+                    error=f"{type(err).__name__}: {err}"[:300],
+                )
+                _obs.PLANE.metrics.counter(
+                    "pathway_breaker_opens_total", {"policy": self.name},
+                    help="circuit-breaker open transitions",
+                )
             if self.on_breaker_open is not None:
                 try:
                     self.on_breaker_open(self)

@@ -623,6 +623,12 @@ def read(
     native_info = _native_info(format, schema, csv_settings, with_metadata)
 
     if mode == "static":
+        # static ingest happens HERE, at graph-build time — record its
+        # wall clock so the pipeline profiler's ingest stage covers it
+        # (observability.pretime; the run itself only sees ready rows)
+        from pathway_tpu.internals import observability as _obs
+
+        _ingest_t0 = _time.perf_counter()
         # pk sources keep the object plane: duplicate-pk rows rely on the
         # keyed RowwiseNode's last-write-wins, which the stateless native
         # map path deliberately doesn't reproduce
@@ -639,6 +645,7 @@ def read(
                     lambda kr: data.append((0, kr[0], kr[1], 1)),
                 )
             spec = OpSpec("static_native", [], rows=data, batches=batches)
+            _obs.pretime("ingest", _time.perf_counter() - _ingest_t0)
             return Table(spec, schema, univ.Universe())
         rows = []
         for f in _list_files(path):
@@ -647,7 +654,9 @@ def read(
         keys = None
         if pk:
             keys = [key_for_values(*[r[names.index(c)] for c in pk]) for r in rows]
-        return Table.from_rows(schema, rows, keys=keys)
+        table = Table.from_rows(schema, rows, keys=keys)
+        _obs.pretime("ingest", _time.perf_counter() - _ingest_t0)
+        return table
 
     # streaming: poll for new files forever (reference directory watcher).
     # _single_pass (kwargs, internal/bench): deliver current files once and

@@ -37,6 +37,7 @@ import time
 from typing import Any
 
 from pathway_tpu.engine import faults
+from pathway_tpu.internals import observability as _obs
 
 _LEN = struct.Struct("<Q")
 
@@ -178,6 +179,19 @@ class ProcessMesh:
                 if body is None:
                     return
                 kind, payload = self._decode_frame(body)
+                if kind == "datat":
+                    # trace-tagged data frame (sender had observability
+                    # on): log the receive against the sender's context —
+                    # joining both processes' dumps on (run, wire, time,
+                    # seq) reconstructs the wave's cross-worker timeline
+                    node_id_t, rnd_t, entries_t, ctx = payload
+                    plane = _obs.PLANE
+                    if plane is not None:
+                        plane.record(
+                            "mesh.recv", export=False, wire=node_id_t,
+                            t=rnd_t, frm=peer, run=ctx[0], seq=ctx[2],
+                        )
+                    kind, payload = "data", (node_id_t, rnd_t, entries_t)
                 with self._cv:
                     if kind == "data":
                         node_id, rnd, entries = payload
@@ -263,7 +277,19 @@ class ProcessMesh:
 
     def send_bucket(self, peer: int, node_id: int, rnd: int, entries: list) -> None:
         self.data_frames_sent += 1
-        self._send(peer, "data", (node_id, rnd, entries))
+        plane = _obs.PLANE
+        if plane is None:
+            self._send(peer, "data", (node_id, rnd, entries))
+            return
+        # tag the frame with trace context: (run_id, sender, seq). The
+        # receiver logs the same tuple on arrival, so one dump per
+        # process is enough to reconstruct a wave's cross-worker path
+        ctx = (plane.run_id, self.process_id, plane.next_seq())
+        plane.record(
+            "mesh.send", export=False, wire=node_id, t=rnd, to=peer,
+            seq=ctx[2],
+        )
+        self._send(peer, "datat", (node_id, rnd, entries, ctx))
 
     def recv_bucket(self, peer: int, node_id: int, rnd: int) -> list:
         """Blocks until the peer's bucket arrives. A slow peer is waited

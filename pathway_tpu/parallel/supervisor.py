@@ -109,6 +109,12 @@ def run_supervised(
     the successful generation; raises :class:`SupervisedMeshFailed` after
     ``max_restarts`` failed generations and :class:`TimeoutError` on the
     overall deadline."""
+    from pathway_tpu.internals import observability as obs
+
+    # supervisor-side black box: generation lifecycles land in the flight
+    # recorder (workers dump their own rings when they crash; this is the
+    # restart-decision record that stitches those dumps together)
+    obs.maybe_enable_from_env()
     base_env = {**os.environ, **(env or {})}
     deadline = time.monotonic() + timeout_s
     failures: list[str] = []
@@ -132,6 +138,11 @@ def run_supervised(
                 # their wires and exit on their own — kill + wait the
                 # stragglers to reclaim the ports for the next generation
                 errs = _reap(procs)
+                obs.record(
+                    "supervisor.restart", generation=generation,
+                    dead_workers=dead,
+                    exit_codes=[codes[i] for i in dead],
+                )
                 failed = (
                     f"generation {generation}: worker(s) {dead} exited "
                     f"{[codes[i] for i in dead]}"
@@ -141,12 +152,21 @@ def run_supervised(
                         failed += f"\n-- worker {i} stderr --\n{err[-2000:]}"
                 break
             if all(c == 0 for c in codes):
+                if generation > 0:
+                    # restarts happened: leave the decision record beside
+                    # the workers' own crash dumps
+                    obs.record(
+                        "supervisor.recovered", generations=generation + 1,
+                    )
+                    obs.dump_flight("supervisor")
                 return {
                     "generations": generation + 1,
                     "stderr": _reap(procs),
                 }
             time.sleep(poll_s)
         failures.append(failed or "unknown failure")
+    obs.record("supervisor.gave_up", generations=max_restarts + 1)
+    obs.dump_flight("supervisor")
     raise SupervisedMeshFailed(
         f"mesh failed {max_restarts + 1} generations:\n" + "\n".join(failures)
     )

@@ -56,11 +56,15 @@ WORKLOAD = textwrap.dedent(
     import numpy as np
     import pathway_tpu as pw
     from pathway_tpu.engine.device_plane import DeviceProgram, get_device_plane
+    from pathway_tpu.internals import observability as obs
     from pathway_tpu.io import RetryPolicy
     from pathway_tpu.io.python import ConnectorSubject
 
     PDIR, OUT, N_EVENTS = sys.argv[1], sys.argv[2], int(sys.argv[3])
     SPEC = os.environ.get("PATHWAY_FAULTS", "0")
+    # arm the flight recorder BEFORE any fault can fire: every shot of
+    # the schedule must land in the recorder timeline (harness asserts)
+    obs.maybe_enable_from_env()
 
     DeviceProgram.PROBE_BASE_S = 0.01  # drill-speed re-probe backoff
     plane = get_device_plane()
@@ -136,6 +140,8 @@ WORKLOAD = textwrap.dedent(
         assert src_policy.retries_total > 0, "flap schedule never flapped"
     if "device.dispatch" in SPEC:
         assert prog.host_fallbacks > 0, "device schedule never degraded"
+    # normal-exit black box (hard crashes dump inside faults.hard_crash)
+    obs.dump_flight("drill-end")
     """
 )
 
@@ -172,12 +178,22 @@ QUICK_KINDS = ["crash_mid_wave", "torn_metadata", "connector_flap", "device_disp
 MAX_GENERATIONS = 4  # a schedule may land a crash in the recovery window
 
 
-def _run_workload(pdir: str, out: str, spec: str, n_events: int) -> int:
+def _run_workload(
+    pdir: str, out: str, spec: str, n_events: int,
+    flight_dir: str | None = None,
+) -> int:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PATHWAY_FAULTS": spec}
+    if flight_dir is not None:
+        env["PATHWAY_OBSERVABILITY"] = "1"
+        env["PATHWAY_FLIGHT_DIR"] = flight_dir
+        # a roomy ring: the default 4096 could evict early fault events
+        # behind a long run's wave spans, failing _check_flight falsely
+        env.setdefault("PATHWAY_OBS_RING", "65536")
     r = subprocess.run(
         [sys.executable, "-c", WORKLOAD.format(repo=REPO),
          pdir, out, str(n_events)],
         capture_output=True, text=True, timeout=240,
-        env={**os.environ, "JAX_PLATFORMS": "cpu", "PATHWAY_FAULTS": spec},
+        env=env,
     )
     if r.returncode not in (0, CRASH_EXIT):
         raise RuntimeError(
@@ -185,6 +201,41 @@ def _run_workload(pdir: str, out: str, spec: str, n_events: int) -> int:
             + r.stderr[-3000:]
         )
     return r.returncode
+
+
+def _check_flight(flight_dir: str, kind: str, seed: int) -> dict:
+    """Assert the flight-recorder contract on a faulted case's dumps:
+    every shot the schedule logged (`faults_fired`) has a matching
+    `fault` event in the recorder timeline — the postmortem never hides
+    an injected failure. Returns summary counts for the case record."""
+    import glob
+
+    dumps = sorted(glob.glob(os.path.join(flight_dir, "flight-*.json")))
+    assert dumps, f"{kind} seed {seed}: no flight-recorder dumps written"
+    events: list[dict] = []
+    fired: list[tuple] = []
+    for path in dumps:
+        with open(path) as f:
+            payload = json.load(f)
+        events.extend(payload.get("events", []))
+        fired.extend(tuple(x) for x in payload.get("faults_fired", []))
+    fault_events = {
+        (e.get("point"), e.get("hit"))
+        for e in events if e.get("k") == "fault"
+    }
+    missing = [shot for shot in fired if shot not in fault_events]
+    assert not missing, (
+        f"{kind} seed {seed}: {len(missing)} injected fault(s) absent from "
+        f"the flight-recorder timeline: {missing[:5]}"
+    )
+    assert fired, (
+        f"{kind} seed {seed}: schedule fired nothing — dumps carry no shots"
+    )
+    return {
+        "dumps": len(dumps),
+        "fault_shots": len(fired),
+        "wave_events": sum(1 for e in events if e.get("k") == "wave"),
+    }
 
 
 def consolidate(deliveries_path: str) -> bytes:
@@ -231,9 +282,10 @@ def run_case(kind: str, seed: int, n_events: int, workdir: str) -> dict:
     persistence dir; returns the case record incl. canonical output."""
     pdir = os.path.join(workdir, f"{kind}-s{seed}-pdir")
     out = os.path.join(workdir, f"{kind}-s{seed}-deliveries.jsonl")
+    flight_dir = os.path.join(workdir, f"{kind}-s{seed}-flight")
     spec = KINDS[kind](seed)
     t0 = time.monotonic()
-    rc = _run_workload(pdir, out, spec, n_events)
+    rc = _run_workload(pdir, out, spec, n_events, flight_dir=flight_dir)
     generations = 1
     note = ""
     if kind in CRASH_KINDS:
@@ -248,9 +300,11 @@ def run_case(kind: str, seed: int, n_events: int, workdir: str) -> dict:
         while rc == CRASH_EXIT:
             if generations > MAX_GENERATIONS:
                 raise AssertionError(f"{kind} seed {seed}: kept crashing")
-            rc = _run_workload(pdir, out, "0", n_events)
+            rc = _run_workload(pdir, out, "0", n_events,
+                               flight_dir=flight_dir)
             generations += 1
     assert rc == 0, f"{kind} seed {seed}: final generation rc={rc}"
+    flight = _check_flight(flight_dir, kind, seed)
     return {
         "kind": kind,
         "seed": seed,
@@ -258,6 +312,7 @@ def run_case(kind: str, seed: int, n_events: int, workdir: str) -> dict:
         "generations": generations,
         "seconds": round(time.monotonic() - t0, 2),
         "note": note,
+        "flight": flight,
         "output": consolidate(out).decode(),
     }
 

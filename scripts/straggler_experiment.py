@@ -101,6 +101,13 @@ SCRIPT = textwrap.dedent(
     last["a_max_gap"] = max(gaps) if gaps else 0.0
     with open(OUT + f".{{PID}}", "w") as f:
         json.dump(last, f)
+    # with PATHWAY_OBSERVABILITY=1 (+ PATHWAY_FLIGHT_DIR) in the caller's
+    # env, each worker leaves a flight dump whose wave events carry the
+    # (operator, time, queue/exec) timeline — the skew experiment becomes
+    # reconstructable from one dump per process instead of rerunning
+    from pathway_tpu.internals import observability as _sobs
+    if _sobs.PLANE is not None:
+        _sobs.dump_flight("straggler-end")
     """
 )
 

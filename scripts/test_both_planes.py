@@ -17,6 +17,11 @@ token-resident scope's kill switch thrown (PATHWAY_ITERATE_NATIVE=0) on
 the otherwise-native engine — the object plumbing must stay
 byte-identical to the token plane (docs/iterate.md). The token side of
 the same suite already runs inside legs 1-2.
+Leg 6 (observability): the engine suites with full instrumentation on
+(PATHWAY_OBSERVABILITY=1) — wave tracing, metrics and the flight
+recorder must be result-invariant (docs/observability.md); the A/B
+byte-identical pipeline check itself lives in
+tests/test_observability_plane.py::test_instrumentation_is_result_invariant.
 
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
@@ -134,6 +139,18 @@ def main() -> int:
                 "tests/test_iterate.py",
                 "tests/test_iterate_matrix.py",
                 "tests/test_graphs.py",
+            ],
+        ),
+        # full instrumentation on: wave tracing + metrics + flight ring
+        # must not change any engine result (the dedicated A/B
+        # byte-identical pipeline test is in test_observability_plane.py)
+        run_leg(
+            "observability", {"PATHWAY_OBSERVABILITY": "1"}, extra,
+            [
+                "tests/test_observability_matrix.py",
+                "tests/test_observability_plane.py",
+                "tests/test_frontier.py",
+                "tests/test_workers.py",
             ],
         ),
     ]

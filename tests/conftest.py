@@ -29,3 +29,10 @@ def _clear_parse_graph():
     G.clear()
     yield
     G.clear()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test, excluded from tier-1 (-m 'not slow')",
+    )
