@@ -1177,6 +1177,87 @@ def bench_dataflow(repo: str) -> dict:
     return out
 
 
+def bench_serving(repo: str) -> dict:
+    """Closed-loop serving-gateway rungs (scripts/serving_loadgen.py):
+    p50/p99 latency and goodput at 100 and 1k concurrent closed-loop
+    clients against a live gateway-fronted RAG pipeline, plus the
+    straggler acceptance pair — under a PATHWAY_FAULTS-injected 20 ms
+    straggler, the gateway run must keep p99 bounded by shedding at the
+    edge while the no-gateway control's pending-future map grows to the
+    full client count. CPU-servable: measured on every host (the LLM
+    decode side has its own device rungs); failures record an explicit
+    skip reason, never a bare null."""
+    out: dict = {}
+
+    def run_loadgen(extra: list[str], env_extra: dict | None = None) -> dict:
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", **(env_extra or {})}
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "scripts", "serving_loadgen.py"),
+             *extra],
+            capture_output=True, text=True, timeout=600, env=env, cwd=repo,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"loadgen rc={r.returncode}: {r.stderr[-1500:]}"
+            )
+        lines = r.stdout.strip().splitlines()
+        if not lines:
+            raise RuntimeError(
+                f"loadgen produced no output (stderr: {r.stderr[-500:]})"
+            )
+        return json.loads(lines[-1])
+
+    try:
+        m100 = run_loadgen(["--clients", "100", "--duration", "5"])
+        out["serving_p50_ms_100"] = m100["p50_ms"]
+        out["serving_p99_ms_100"] = m100["p99_ms"]
+        out["serving_goodput_rps_100"] = m100["goodput_rps"]
+        m1k = run_loadgen(
+            ["--clients", "1000", "--duration", "6", "--max-queue", "256"]
+        )
+        out["serving_p50_ms_1k"] = m1k["p50_ms"]
+        out["serving_p99_ms_1k"] = m1k["p99_ms"]
+        out["serving_goodput_rps_1k"] = m1k["goodput_rps"]
+        out["serving_skip_reason"] = None
+    except (RuntimeError, OSError, ValueError, KeyError, subprocess.TimeoutExpired) as e:
+        for k in (
+            "serving_p50_ms_100", "serving_p99_ms_100",
+            "serving_goodput_rps_100", "serving_p50_ms_1k",
+            "serving_p99_ms_1k", "serving_goodput_rps_1k",
+        ):
+            out.setdefault(k, None)
+        out["serving_skip_reason"] = f"failed: {e}"
+    # straggler acceptance pair: same 20 ms straggler on every request,
+    # with and without the gateway (PATHWAY_FAULTS drives the slow path)
+    try:
+        straggle = {"PATHWAY_FAULTS": "serving.straggler@1+"}
+        g = run_loadgen(
+            ["--clients", "100", "--duration", "5", "--straggler-ms", "20",
+             "--max-queue", "16"],
+            straggle,
+        )
+        c = run_loadgen(
+            ["--clients", "100", "--duration", "5", "--straggler-ms", "20",
+             "--no-gateway"],
+            straggle,
+        )
+        out["serving_straggler_p99_ms"] = g["p99_ms"]
+        out["serving_straggler_p99_ms_control"] = c["p99_ms"]
+        out["serving_straggler_max_pending"] = g["max_pending"]
+        out["serving_straggler_max_pending_control"] = c["max_pending"]
+        out["serving_straggler_shed"] = g["shed"]
+        out["serving_straggler_skip_reason"] = None
+    except (RuntimeError, OSError, ValueError, KeyError, subprocess.TimeoutExpired) as e:
+        for k in (
+            "serving_straggler_p99_ms", "serving_straggler_p99_ms_control",
+            "serving_straggler_max_pending",
+            "serving_straggler_max_pending_control", "serving_straggler_shed",
+        ):
+            out.setdefault(k, None)
+        out["serving_straggler_skip_reason"] = f"failed: {e}"
+    return out
+
+
 def _detect_backend() -> str:
     """Probe the jax backend WITHOUT initializing this process's client
     (the RAG-on-chip subprocess must grab the device first)."""
@@ -1214,6 +1295,7 @@ def main() -> None:
     # before this process initializes its own client
     rag_tpu = _rag_tpu_null(skip_reason) if skip_device else bench_rag_tpu(repo)
     dataflow = bench_dataflow(repo)
+    serving = bench_serving(repo)
     dev = jax.devices()[0]
     decode_rate = knn_p50 = knn_single = knn_device = embed_rate = None
     decode_fail = None
@@ -1272,6 +1354,7 @@ def main() -> None:
         ),
         **dataflow,
         **rag_tpu,
+        **serving,
         # config 5 stretch: Gemma-2B-shaped on-chip decode
         "lm_decode_tokens_per_sec": (
             round(decode_rate, 1) if decode_rate else None

@@ -95,6 +95,7 @@ from pathway_tpu import debug  # noqa: E402
 from pathway_tpu import demo  # noqa: E402
 from pathway_tpu import io  # noqa: E402
 from pathway_tpu import persistence  # noqa: E402
+from pathway_tpu import serving  # noqa: E402
 from pathway_tpu.stdlib import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils, viz  # noqa: E402
 from pathway_tpu.internals.sql import sql  # noqa: E402
 from pathway_tpu.internals.yaml_loader import load_yaml  # noqa: E402
@@ -191,7 +192,7 @@ __all__ = [
     "udf", "UDF", "udfs", "reducers",
     "column_definition", "ColumnDefinition", "schema_from_types",
     "schema_from_dict", "schema_from_pandas", "schema_builder",
-    "io", "debug", "demo", "persistence", "temporal", "indexing", "ml",
+    "io", "debug", "demo", "persistence", "serving", "temporal", "indexing", "ml",
     "graphs", "stateful", "statistical", "ordered", "utils", "viz", "universes",
     "sql", "load_yaml", "BaseCustomAccumulator", "xpacks",
     "get_config", "PathwayConfig", "set_license_key", "set_monitoring_config",

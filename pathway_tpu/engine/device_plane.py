@@ -51,6 +51,7 @@ __all__ = [
     "BucketPolicy",
     "DeviceProgram",
     "DevicePlane",
+    "SlotPool",
     "WaveCoalescer",
     "get_device_plane",
 ]
@@ -373,6 +374,108 @@ class WaveCoalescer:
                 f.set_result(values[i])
 
 
+class SlotPool:
+    """Fixed pool of decode slots over one persistent multi-row buffer —
+    the bookkeeping half of continuous batching (serving/
+    continuous_batching.py). Each slot is one row of a leased KV cache; a
+    request acquires a slot at admission, holds it across its whole
+    generation, and releases it at the step boundary where it finishes —
+    at which point the *same decode batch* re-fills the row with the next
+    queued request instead of waiting for the wave to drain.
+
+    Counters are the observable the acceptance tests pin: ``refills``
+    (acquisitions after the pool has been non-empty at least once — i.e.
+    a freed row handed to a new request), ``joined_inflight``
+    (acquisitions while at least one other slot was mid-generation), and
+    the active/high-water gauges. They export through the metrics
+    registry as ``pathway_serving_slot_*`` when the observability plane
+    is armed, and are always readable off the pool itself.
+    """
+
+    def __init__(self, name: str, n_slots: int):
+        if n_slots < 1:
+            raise ValueError(f"slot pool needs >= 1 slot, got {n_slots}")
+        self.name = name
+        self.n_slots = n_slots
+        self._lock = threading.Lock()
+        # LIFO keeps hot cache rows hot; slot 0 first for determinism
+        self._free = list(range(n_slots))[::-1]
+        self.acquired_total = 0
+        self.refills = 0  # acquisitions of a previously-used slot
+        self.joined_inflight = 0  # acquired while others were mid-flight
+        self.high_water = 0
+        self._ever_used: set[int] = set()
+
+    @property
+    def active(self) -> int:
+        with self._lock:
+            return self.n_slots - len(self._free)
+
+    def acquire(self) -> int | None:
+        """Take a free slot (None when the pool is exhausted — the caller
+        leaves the request queued for the next step boundary)."""
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop()
+            self.acquired_total += 1
+            others_in_flight = self.n_slots - len(self._free) - 1
+            joined = others_in_flight > 0
+            refill = slot in self._ever_used
+            if joined:
+                self.joined_inflight += 1
+            if refill:
+                self.refills += 1
+            self._ever_used.add(slot)
+            self.high_water = max(self.high_water, others_in_flight + 1)
+            active = others_in_flight + 1
+        if _obs.PLANE is not None:
+            m = _obs.PLANE.metrics
+            m.counter(
+                "pathway_serving_slot_acquires_total", {"pool": self.name},
+                help="decode slots handed to requests",
+            )
+            m.gauge(
+                "pathway_serving_slots_active", active, {"pool": self.name},
+                help="decode slots currently mid-generation",
+            )
+            if refill:
+                m.counter(
+                    "pathway_serving_slot_refills_total", {"pool": self.name},
+                    help="freed decode slots re-filled with a new request",
+                )
+            if joined:
+                m.counter(
+                    "pathway_serving_joined_inflight_total",
+                    {"pool": self.name},
+                    help="requests that joined an in-flight decode batch",
+                )
+        return slot
+
+    def release(self, slot: int) -> None:
+        with self._lock:
+            if slot in self._free:
+                raise ValueError(f"slot {slot} released twice")
+            self._free.append(slot)
+            active = self.n_slots - len(self._free)
+        if _obs.PLANE is not None:
+            _obs.PLANE.metrics.gauge(
+                "pathway_serving_slots_active", active, {"pool": self.name},
+                help="decode slots currently mid-generation",
+            )
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "n_slots": self.n_slots,
+                "active": self.n_slots - len(self._free),
+                "acquired_total": self.acquired_total,
+                "refills": self.refills,
+                "joined_inflight": self.joined_inflight,
+                "high_water": self.high_water,
+            }
+
+
 class DevicePlane:
     """Process-wide device-dispatch plane (see module docstring)."""
 
@@ -380,8 +483,15 @@ class DevicePlane:
         self.buckets = bucket_policy or BucketPolicy()
         self.programs: dict[str, DeviceProgram] = {}
         self._leases: dict[Any, list] = {}  # key -> pooled buffers
+        self._slot_pools: dict[str, SlotPool] = {}
         self._name_seq = 0
-        self._lock = threading.Lock()
+        # REENTRANT on purpose: drop_program/drop_namespace run from
+        # weakref finalizers, and gc can fire a finalizer on any
+        # allocation — including one made while THIS thread already
+        # holds the plane lock. A plain Lock deadlocks that thread
+        # against itself (observed: jax.jit construction inside
+        # program() triggering a dead chat's finalizer).
+        self._lock = threading.RLock()
         self._dispatch_pool: ThreadPoolExecutor | None = None
         self._staging_pool: ThreadPoolExecutor | None = None
 
@@ -431,17 +541,22 @@ class DevicePlane:
         `fn`; later callers may omit it."""
         with self._lock:
             prog = self.programs.get(name)
-            if prog is None:
-                if fn is None:
-                    raise KeyError(f"no device program named {name!r}")
-                prog = DeviceProgram(
-                    name,
-                    fn,
-                    donate_argnums=donate_argnums,
-                    static_argnames=static_argnames,
-                )
-                self.programs[name] = prog
+        if prog is not None:
             return prog
+        if fn is None:
+            raise KeyError(f"no device program named {name!r}")
+        # build the jit OUTSIDE the lock: jit construction allocates
+        # heavily, and a gc-triggered finalizer re-entering the plane
+        # must never find this thread mid-critical-section
+        fresh = DeviceProgram(
+            name,
+            fn,
+            donate_argnums=donate_argnums,
+            static_argnames=static_argnames,
+        )
+        with self._lock:
+            prog = self.programs.setdefault(name, fresh)
+        return prog
 
     def compile_counts(self) -> dict[tuple[str, Any], int]:
         """{(program_name, bucket): compilations} across the plane — the
@@ -481,6 +596,29 @@ class DevicePlane:
             flush_fn, max_batch=max_batch,
             pool=None if inline else self.dispatch_pool,
         )
+
+    def slot_pool(self, name: str, n_slots: int) -> SlotPool:
+        """Register-or-get the named decode slot pool (continuous
+        batching). Like :meth:`program`, pools are plane-owned so their
+        counters survive the batcher that uses them and export through
+        /metrics; `drop_program` releases pools keyed to the program."""
+        with self._lock:
+            pool = self._slot_pools.get(name)
+            if pool is None:
+                pool = self._slot_pools[name] = SlotPool(name, n_slots)
+            elif pool.n_slots != n_slots:
+                raise ValueError(
+                    f"slot pool {name!r} already registered with "
+                    f"{pool.n_slots} slots (asked for {n_slots})"
+                )
+            return pool
+
+    def slot_pools(self) -> dict[str, dict[str, int]]:
+        """{pool_name: counters} across the plane — the /statistics and
+        metrics view of continuous-batching occupancy."""
+        with self._lock:
+            pools = list(self._slot_pools.items())
+        return {name: pool.snapshot() for name, pool in pools}
 
     def unique_name(self, prefix: str) -> str:
         """Collision-proof program name for per-instance registrations
@@ -532,6 +670,30 @@ class DevicePlane:
                 if isinstance(k, tuple) and name in k
             ]:
                 del self._leases[key]
+
+    def drop_namespace(self, prefix: str) -> None:
+        """Release every program, lease pool and slot pool in a
+        per-instance namespace: names equal to `prefix` or starting with
+        ``prefix + "/"`` (a continuous batcher registers
+        ``{prefix}/prefill``, ``{prefix}/step``, ``{prefix}/slots`` and a
+        cache lease keyed on `prefix`). Prefix matching is
+        delimiter-aware so ``cb#1`` never swallows ``cb#10``."""
+
+        def hit(s: Any) -> bool:
+            return isinstance(s, str) and (
+                s == prefix or s.startswith(prefix + "/")
+            )
+
+        with self._lock:
+            for pname in [p for p in self.programs if hit(p)]:
+                del self.programs[pname]
+            for key in [
+                k for k in self._leases
+                if isinstance(k, tuple) and any(hit(e) for e in k)
+            ]:
+                del self._leases[key]
+            for pname in [p for p in self._slot_pools if hit(p)]:
+                del self._slot_pools[pname]
 
     # -------------------------------------------------------- batch padding
 

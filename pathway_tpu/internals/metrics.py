@@ -147,6 +147,17 @@ def _device_lines(out: _Lines) -> None:
             "pathway_device_host_fallbacks", {"program": name},
             prog.host_fallbacks,
         )
+    pools = plane.slot_pools()
+    if pools:
+        # continuous-batching occupancy straight off the plane, scrapable
+        # even when the observability plane (and its counters) is off
+        out.typ("pathway_serving_slot_pool", "gauge")
+        for pname, snap in pools.items():
+            for stat in ("active", "refills", "joined_inflight", "high_water"):
+                out.sample(
+                    "pathway_serving_slot_pool",
+                    {"pool": pname, "stat": stat}, snap[stat],
+                )
 
 
 _BREAKER_STATES = {"closed": 0, "open": 1, "half_open": 2}
@@ -283,6 +294,7 @@ def render_statistics(session: Any, started_at: float) -> dict:
                 f"{prog}/{bucket}": q
                 for (prog, bucket), q in dp_mod._plane.quarantined().items()
             },
+            "slot_pools": dp_mod._plane.slot_pools(),
         }
     policies = _obs.retry_policies()
     if policies:

@@ -221,6 +221,45 @@ class MetricsRegistry:
                 h = self._histograms[k] = _Histogram(bounds)
             h.observe(value)
 
+    # -------------------------------------------------------------- reads
+    #
+    # Subsystems may react to each other's signals through the registry
+    # (the serving gateway's watermark backpressure reads the runtime's
+    # lag gauges) — reads are snapshots under the same lock as writes.
+
+    def gauge_value(self, name: str, labels: dict | None = None) -> float | None:
+        """Current value of one gauge series (None if never set)."""
+        k = self._key(name, labels)
+        with self._lock:
+            return self._gauges.get(k)
+
+    def counter_value(self, name: str, labels: dict | None = None) -> float:
+        k = self._key(name, labels)
+        with self._lock:
+            return self._counters.get(k, 0.0)
+
+    def max_gauge(
+        self,
+        name: str,
+        label: str | None = None,
+        values: Iterable[str] | None = None,
+    ) -> float:
+        """Max across every series of `name` (0.0 when absent). With
+        `label`+`values` only series whose `label` is in `values` count —
+        e.g. the watermark lag of a specific source set."""
+        allowed = set(values) if values is not None else None
+        best = 0.0
+        with self._lock:
+            for k, v in self._gauges.items():
+                if k[0] != name:
+                    continue
+                if label is not None and allowed is not None:
+                    series_labels = dict(k[1]) if len(k) > 1 else {}
+                    if series_labels.get(label) not in allowed:
+                        continue
+                best = max(best, v)
+        return best
+
     # ------------------------------------------------------------- export
 
     def items(self):

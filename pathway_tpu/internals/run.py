@@ -14,6 +14,23 @@ from pathway_tpu.internals.parse_graph import G
 
 logger = logging.getLogger("pathway_tpu.run")
 
+# The live session of a blocking pw.run (always-on serving processes run
+# pw.run on a thread; shutdown hooks and tests stop it cooperatively).
+_CURRENT: dict[str, Any] = {}
+
+
+def current_session() -> Any:
+    return _CURRENT.get("session")
+
+
+def stop_current_run() -> None:
+    """Cooperatively stop a streaming ``pw.run``: the pump closes its
+    connectors at the next wave boundary and finalizes with the usual
+    end-of-stream flush. No-op when nothing is running."""
+    s = _CURRENT.get("session")
+    if s is not None:
+        s.stop_event.set()
+
 
 def _arm_observability(
     observability: bool | None, profile: bool | str | None
@@ -64,6 +81,7 @@ def run(
     profile_path = _arm_observability(observability, profile)
     _build_t0 = _time.perf_counter()
     session = Session()
+    _CURRENT["session"] = session
     session.graph.terminate_on_error = terminate_on_error or get_config().terminate_on_error
     if autocommit_duration_ms:
         session.autocommit_ms = autocommit_duration_ms
@@ -136,6 +154,12 @@ def run(
             obs.dump_flight("run-error")
         raise
     finally:
+        # drop the cooperative-stop handle IF it is still ours — a
+        # concurrent run on another thread may already have replaced it,
+        # and stopping a finished session must stay a no-op (also frees
+        # the session graph in long-lived serving processes)
+        if _CURRENT.get("session") is session:
+            _CURRENT.pop("session", None)
         # restore the terminal if the monitoring TUI was live
         for m in session.monitors:
             live = getattr(m, "live", None)

@@ -609,6 +609,96 @@ def generate_serving(
     return jnp.concatenate([prompt_ids, toks.T], axis=1), cache
 
 
+def prefill_into_slot(
+    params: Params,
+    prompt_ids: Array,  # [1, P] LEFT-padded (pad_left_rows convention)
+    prompt_mask: Array,  # [1, P] 1/0
+    cache: Params,  # multi-slot serving cache (init_kv_cache shape)
+    slot: Array,  # scalar int32 — which cache row this request owns
+    cfg: TransformerConfig,
+) -> tuple[Array, Params]:
+    """Prefill ONE request into row `slot` of a multi-slot serving cache
+    (continuous batching). Runs the standard b=1 left-padded prefill into
+    a scratch single-row cache and scatters that row into `cache` at the
+    slot. `slot` is a traced scalar, so one compiled program serves every
+    slot of the bucket — a request joining an in-flight batch costs zero
+    new XLA compilations once its prompt bucket is warm. Returns (first
+    decoded token [1] int32, cache); argmax decoding, matching the
+    temperature-0 `generate_serving` path bit for bit per row."""
+    lg, mini = prefill(params, prompt_ids, init_kv_cache(cfg, 1), cfg, prompt_mask)
+    cache["k"] = jax.lax.dynamic_update_slice(
+        cache["k"], mini["k"], (0, slot, 0, 0, 0)
+    )
+    cache["v"] = jax.lax.dynamic_update_slice(
+        cache["v"], mini["v"], (0, slot, 0, 0, 0)
+    )
+    return jnp.argmax(lg, -1).astype(jnp.int32), cache
+
+
+def decode_step_slots(
+    params: Params,
+    cache: Params,
+    token: Array,  # [b] int32 — the token each slot consumes this step
+    pos: Array,  # [b] int32 — per-slot physical write position
+    pad_len: Array,  # [b] int32 — per-slot left-pad length
+    cfg: TransformerConfig,
+) -> tuple[Array, Params]:
+    """One decode step where every batch row is an INDEPENDENT request at
+    its own sequence position (continuous batching). Unlike
+    :func:`decode_step`, which advances a wave-aligned batch at one shared
+    scalar position, here `token`/`pos`/`pad_len` are per-row vectors: row
+    i consumes ``token[i]``, writes its K/V at physical position
+    ``pos[i]`` of its own cache slot, and attends over
+    ``[pad_len[i], pos[i]]`` — its left-padded prompt plus the tokens it
+    has decoded so far. Rows never read each other's slots, so a freshly
+    prefilled request is correct from its first step even though its
+    neighbours are mid-generation. Returns (next token [b] int32, cache);
+    argmax decoding, bit-identical per row to the wave-aligned path."""
+    b = token.shape[0]
+    h, dh = cfg.n_heads, cfg.head_dim
+    x = params["tok_embed"].astype(cfg.dtype)[token][:, None, :]
+    x = x + params["pos_embed"].astype(cfg.dtype)[pos - pad_len][:, None, :]
+    mask_len = cfg.max_len
+    kmask = (
+        (jnp.arange(mask_len)[None, :] <= pos[:, None])
+        & (jnp.arange(mask_len)[None, :] >= pad_len[:, None])
+    )[:, None, None, :]
+    rows = jnp.arange(b)
+    for li, block in enumerate(params["blocks"]):
+        xin = _rmsnorm(x, block["ln1_scale"])
+        qkv = jnp.einsum(
+            "bsd,de->bse", xin, block["qkv"].astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, 1, h, dh)
+        k = k.reshape(b, h, dh)
+        v = v.reshape(b, h, dh)
+        cache["k"] = cache["k"].at[li, rows, pos].set(k)
+        cache["v"] = cache["v"].at[li, rows, pos].set(v)
+        keys, vals = cache["k"][li], cache["v"][li]  # [b, S, h, dh]
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, keys, preferred_element_type=jnp.float32
+        ) / math.sqrt(dh)
+        scores = jnp.where(kmask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        ctx = jnp.einsum(
+            "bhqk,bkhd->bqhd", probs, vals, preferred_element_type=jnp.float32
+        ).astype(cfg.dtype).reshape(b, 1, cfg.d_model)
+        attn_out = jnp.einsum(
+            "bsd,de->bse", ctx, block["o"].astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+        x = x + attn_out
+        x = x + _ffn(_rmsnorm(x, block["ln2_scale"]), block, cfg)
+    hline = _rmsnorm(x, params["ln_f_scale"])
+    lg = jnp.einsum(
+        "bsd,vd->bsv", hline, params["tok_embed"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.argmax(lg[:, 0, :], -1).astype(jnp.int32), cache
+
+
 class TransformerLM:
     """Convenience OO wrapper over the functional model."""
 

@@ -1,0 +1,302 @@
+"""Continuous batching for LLM decode: slot-based scheduling over one
+persistent KV cache.
+
+The wave-aligned serving path (`JaxLMChat._generate_batch`) dispatches a
+whole generation as ONE jitted program per wave: every request in the
+batch prefills together and decodes together, and a request arriving one
+millisecond after the dispatch waits for the entire wave to drain —
+p99 latency under load is bounded below by the full generation time of
+the slowest co-batched wave. Continuous batching (the vLLM/Orca model)
+replaces that with a **slot scheduler**:
+
+* the KV cache is one persistent multi-row buffer (a device-plane lease,
+  `init_kv_cache(cfg, n_slots)`); each row is a **slot**
+  (:class:`~pathway_tpu.engine.device_plane.SlotPool`);
+* a new request is admitted at the next **step boundary**: a b=1
+  prefill (`models/transformer.prefill_into_slot`) scatters its prompt
+  K/V into a free cache row — the in-flight neighbours never stop
+  decoding for it;
+* every decode step advances ALL occupied slots by one token through a
+  single jitted program with per-row positions
+  (`models/transformer.decode_step_slots`);
+* a request that finishes releases its slot at the step boundary, and
+  the same boundary re-fills the row from the admission queue.
+
+Both programs ride the device plane: the compile ledger proves a request
+joining mid-generation costs **zero new XLA compilations** (the step
+program is one shape; prefill is one shape per prompt bucket), and slot
+counters flow into the metrics registry
+(``pathway_serving_slot_refills_total``,
+``pathway_serving_joined_inflight_total``,
+``pathway_serving_decode_steps_total``, ``pathway_serving_slots_active``).
+
+**Kill switch**: ``PATHWAY_CONTINUOUS_BATCH=0`` makes `JaxLMChat` fall
+back to the wave-aligned coalescer path. The fallback is byte-identical
+per request — `decode_step_slots` is the same math as the scanned
+`decode_step` with the shared scalar position replaced by a per-row
+vector, pinned by ``tests/test_continuous_batching.py``.
+
+Decoding is temperature-0 (argmax) here; sampled generation keeps the
+wave-aligned path (a per-request RNG stream inside a shared step program
+is future work and the chat constructor routes accordingly).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from concurrent.futures import Future
+from typing import Any
+
+from pathway_tpu.internals import observability as _obs
+
+__all__ = ["ContinuousBatcher", "continuous_batching_on"]
+
+
+def continuous_batching_on() -> bool:
+    """The kill switch: PATHWAY_CONTINUOUS_BATCH=0 restores wave-aligned
+    dispatch (default on)."""
+    return os.environ.get("PATHWAY_CONTINUOUS_BATCH", "1") not in (
+        "0", "false", "no",
+    )
+
+
+class _Request:
+    __slots__ = (
+        "row", "length", "future", "tokens", "token", "steps_done", "slot",
+        "pad_len", "width",
+    )
+
+    def __init__(self, row: list, future: Future):
+        self.row = row  # token ids (already budget-truncated)
+        self.length = len(row)
+        self.future = future
+        self.tokens: list[int] = []  # emitted output tokens
+        self.token = 0  # the token the next decode step consumes
+        self.steps_done = 0
+        self.slot: int | None = None
+        self.pad_len = 0  # left-pad of the prompt bucket
+        self.width = 0  # physical prompt width (the seq bucket)
+
+
+class ContinuousBatcher:
+    """Slot-based decode scheduler over one leased multi-row KV cache.
+
+    ``submit(prompt)`` returns a :class:`concurrent.futures.Future`
+    resolving to the generated token string (the `JaxLMChat` output
+    format). A background decode thread runs only while requests are in
+    flight: it re-fills freed slots from the queue at every step
+    boundary, advances all occupied slots one token per dispatch, and
+    exits (restoring the cache lease) when the pool drains.
+    """
+
+    def __init__(
+        self,
+        *,
+        params: Any,
+        cfg: Any,
+        tokenizer: Any,
+        n_steps: int,
+        n_slots: int = 8,
+        plane: Any = None,
+        name: str | None = None,
+    ):
+        import functools
+
+        from pathway_tpu.engine.device_plane import get_device_plane
+        from pathway_tpu.models import transformer
+
+        if n_steps < 1:
+            raise ValueError("n_steps must be >= 1")
+        self.params = params
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.n_steps = n_steps
+        self.n_slots = n_slots
+        self.budget = cfg.max_len - n_steps
+        self._plane = plane or get_device_plane()
+        self.name = name or self._plane.unique_name("cb")
+        self.pool = self._plane.slot_pool(f"{self.name}/slots", n_slots)
+        self._prefill = self._plane.program(
+            f"{self.name}/prefill",
+            functools.partial(transformer.prefill_into_slot, cfg=cfg),
+            donate_argnums=(3,),  # the shared cache rides the lease cycle
+        )
+        self._step = self._plane.program(
+            f"{self.name}/step",
+            functools.partial(transformer.decode_step_slots, cfg=cfg),
+            donate_argnums=(1,),
+        )
+        self._cache_key = ("cb_kv_cache", self.name, n_slots)
+        self._lock = threading.Lock()
+        self._queue: deque[_Request] = deque()
+        self._active: dict[int, _Request] = {}  # slot -> request
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self.stats = {
+            "submitted": 0, "completed": 0, "decode_steps": 0,
+            "prefills": 0, "max_queue": 0,
+        }
+
+    # ------------------------------------------------------------- surface
+
+    def submit(self, prompt: str) -> Future:
+        """Queue one prompt; the future resolves to the token string."""
+        row = list(self.tokenizer.tokenize(prompt))[-self.budget:]
+        fut: Future = Future()
+        req = _Request(row, fut)
+        with self._lock:
+            self._queue.append(req)
+            self.stats["submitted"] += 1
+            self.stats["max_queue"] = max(
+                self.stats["max_queue"], len(self._queue)
+            )
+            if not self._running:
+                self._running = True
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name=f"pw-cb-{self.name}",
+                )
+                self._thread.start()
+        return fut
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue) + len(self._active)
+
+    def drain(self, timeout: float | None = 30.0) -> None:
+        """Block until the in-flight work finishes (tests/teardown)."""
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def close(self) -> None:
+        """Release plane registrations (programs, slot pool, cache lease).
+        Called by the owner's finalizer; in-flight work is drained first."""
+        self.drain()
+        self._plane.drop_namespace(self.name)
+
+    # ---------------------------------------------------------- decode loop
+
+    def _loop(self) -> None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pathway_tpu.models import transformer
+
+        cache = self._plane.lease(
+            self._cache_key,
+            lambda: transformer.init_kv_cache(self.cfg, self.n_slots),
+        )
+        try:
+            while True:
+                # ---- step boundary: re-fill freed slots from the queue
+                while True:
+                    with self._lock:
+                        if not self._queue:
+                            break
+                        slot = self.pool.acquire()
+                        if slot is None:
+                            break  # batch full; next boundary re-checks
+                        req = self._queue.popleft()
+                        self._active[slot] = req
+                        req.slot = slot
+                    cache = self._admit(req, slot, cache)
+                with self._lock:
+                    if not self._active:
+                        # nothing left; exit under the lock so a submit
+                        # racing this check either sees _running=True
+                        # (we loop again) or starts a fresh thread
+                        if self._queue:
+                            continue
+                        self._running = False
+                        return
+                    batch = dict(self._active)
+                # ---- one decode step over every occupied slot
+                tok = np.zeros(self.n_slots, np.int32)
+                pos = np.zeros(self.n_slots, np.int32)
+                pad = np.zeros(self.n_slots, np.int32)
+                for slot, req in batch.items():
+                    tok[slot] = req.token
+                    pos[slot] = req.width + req.steps_done
+                    pad[slot] = req.pad_len
+                nxt, cache = self._step(
+                    self.params, cache, jnp.asarray(tok), jnp.asarray(pos),
+                    jnp.asarray(pad), bucket=self.n_slots,
+                )
+                nxt = np.asarray(nxt)
+                self.stats["decode_steps"] += 1
+                if _obs.PLANE is not None:
+                    _obs.PLANE.metrics.counter(
+                        "pathway_serving_decode_steps_total",
+                        {"pool": self.pool.name},
+                        help="continuous-batching decode steps dispatched",
+                    )
+                for slot, req in batch.items():
+                    req.steps_done += 1
+                    req.tokens.append(int(nxt[slot]))
+                    req.token = int(nxt[slot])
+                    if len(req.tokens) >= self.n_steps:
+                        self._finish(slot, req)
+        except BaseException as e:  # noqa: BLE001 — fail every waiter loudly
+            with self._lock:
+                self._running = False
+                held = list(self._active.keys())
+                waiting = list(self._active.values()) + list(self._queue)
+                self._active.clear()
+                self._queue.clear()
+            for slot in held:
+                # slots must go back to the pool: leaking them would
+                # shrink the batch forever and leave a later submit
+                # spinning on an exhausted pool with nothing in flight
+                self.pool.release(slot)
+            for req in waiting:
+                if not req.future.done():
+                    req.future.set_exception(e)
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                raise
+        finally:
+            # restore the cache lease ONLY if our namespace still exists:
+            # a finalizer may have dropped it while this thread was
+            # mid-generation, and restore() would re-create the lease
+            # entry under the dropped key — pinning the multi-slot KV
+            # cache in the process-global plane with no owner left
+            with self._plane._lock:
+                alive = (
+                    self._plane._slot_pools.get(self.pool.name) is self.pool
+                )
+            if alive:
+                self._plane.restore(self._cache_key, cache)
+
+    def _admit(self, req: _Request, slot: int, cache: Any):
+        """Prefill one queued request into its freshly acquired slot (the
+        join-at-step-boundary event)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pathway_tpu.xpacks.llm.embedders import pad_left_rows
+
+        ids, mask = pad_left_rows([req.row], self.budget, n_rows=1)
+        req.width = ids.shape[1]
+        req.pad_len = req.width - req.length
+        first, cache = self._prefill(
+            self.params, jnp.asarray(ids), jnp.asarray(mask), cache,
+            jnp.asarray(slot, jnp.int32), bucket=(1, req.width),
+        )
+        req.token = int(np.asarray(first)[0])
+        req.tokens.append(req.token)
+        self.stats["prefills"] += 1
+        if len(req.tokens) >= self.n_steps:  # n_steps == 1
+            self._finish(slot, req)
+        return cache
+
+    def _finish(self, slot: int, req: _Request) -> None:
+        with self._lock:
+            self._active.pop(slot, None)
+            self.stats["completed"] += 1
+        self.pool.release(slot)
+        if not req.future.done():
+            req.future.set_result(
+                " ".join(f"<{int(t)}>" for t in req.tokens)
+            )

@@ -184,6 +184,14 @@ class JaxLMChat(BaseChat):
     (llms.py:441). Here the model is a JAX program: batched prefill + scanned
     decode with a KV cache (models/transformer.py), jit-compiled once.
     Pass trained `params`, or leave None for random weights (testing).
+
+    Dispatch model: **continuous batching** by default (temperature 0) —
+    requests join an in-flight decode batch at step boundaries through
+    the slot scheduler (serving/continuous_batching.py), so a request
+    arriving mid-generation never waits for the whole wave to drain.
+    ``PATHWAY_CONTINUOUS_BATCH=0`` (or ``continuous_batching=False``, or
+    any ``temperature > 0``) falls back to the wave-aligned coalescer:
+    one left-padded generate dispatch per wave, byte-identical output.
     """
 
     def __init__(
@@ -194,6 +202,8 @@ class JaxLMChat(BaseChat):
         max_new_tokens: int = 64,
         temperature: float = 0.0,
         max_batch: int = 64,
+        continuous_batching: bool | None = None,
+        decode_slots: int = 8,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
@@ -244,10 +254,30 @@ class JaxLMChat(BaseChat):
         self._batcher = self._plane.coalescer(
             self._generate_batch, max_batch=max_batch
         )
+        # continuous batching: slot-scheduled decode (joins at step
+        # boundaries) unless killed by env/arg or sampled generation
+        from pathway_tpu.serving.continuous_batching import (
+            ContinuousBatcher,
+            continuous_batching_on,
+        )
+
+        if continuous_batching is None:
+            continuous_batching = continuous_batching_on()
+        self._cb: ContinuousBatcher | None = None
+        if continuous_batching and self.temperature == 0.0:
+            self._cb = ContinuousBatcher(
+                params=self.params,
+                cfg=self.config,
+                tokenizer=self.tokenizer,
+                n_steps=self.max_new_tokens,
+                n_slots=decode_slots,
+                plane=self._plane,
+            )
         # the plane is process-global: without this, every dead chat
         # instance would pin its compiled program + KV-cache pools forever
         self._finalizer = weakref.finalize(
-            self, self._plane.drop_program, self._gen.name
+            self, _release_chat_programs, self._plane, self._gen.name,
+            self._cb.name if self._cb is not None else None,
         )
 
     def _generate_batch(self, prompts: list[str]) -> list[str]:
@@ -284,9 +314,21 @@ class JaxLMChat(BaseChat):
         ]
 
     async def __wrapped__(self, messages: Any, **kwargs: Any) -> str:
+        import asyncio
+
         msgs = messages.value if isinstance(messages, Json) else messages
         if isinstance(msgs, list):
             prompt = "\n".join(m["content"] for m in msgs)
         else:
             prompt = str(msgs)
+        if self._cb is not None:
+            return await asyncio.wrap_future(self._cb.submit(prompt))
         return await self._batcher.submit(prompt)
+
+
+def _release_chat_programs(plane: Any, gen_name: str, cb_name: str | None) -> None:
+    """Finalizer body for JaxLMChat: module-level so the weakref holds no
+    bound method back-reference to the instance."""
+    plane.drop_program(gen_name)
+    if cb_name is not None:
+        plane.drop_namespace(cb_name)

@@ -16,12 +16,15 @@ from pathway_tpu.internals.table import Table
 
 
 class BaseRestServer:
-    def __init__(self, host: str, port: int, **kwargs: Any):
+    def __init__(self, host: str, port: int, gateway: Any = None, **kwargs: Any):
         from pathway_tpu.io.http import PathwayWebserver
 
         self.host = host
         self.port = port
         self.webserver = PathwayWebserver(host=host, port=port)
+        # one ServingGateway fronts every route of this server
+        # (admission control + watermark backpressure, docs/serving.md §6)
+        self.gateway = gateway
 
     def serve(
         self,
@@ -34,7 +37,10 @@ class BaseRestServer:
             webserver=self.webserver,
             route=route,
             schema=schema,
-            delete_completed_queries=False,
+            delete_completed_queries=kwargs.pop(
+                "delete_completed_queries", False
+            ),
+            gateway=self.gateway,
         )
         writer(handler(queries))
 

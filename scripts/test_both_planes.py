@@ -22,6 +22,12 @@ Leg 6 (observability): the engine suites with full instrumentation on
 recorder must be result-invariant (docs/observability.md); the A/B
 byte-identical pipeline check itself lives in
 tests/test_observability_plane.py::test_instrumentation_is_result_invariant.
+Leg 7 (serving-gateway): the serving edge suites with the
+continuous-batching kill switch thrown (PATHWAY_CONTINUOUS_BATCH=0) —
+wave-aligned fallback must stay byte-identical and the gateway /
+rest-connector contract must hold on both dispatch models; the CB-on
+side of the same suites already runs inside legs 1-2
+(docs/serving.md §6).
 
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
@@ -151,6 +157,18 @@ def main() -> int:
                 "tests/test_observability_plane.py",
                 "tests/test_frontier.py",
                 "tests/test_workers.py",
+            ],
+        ),
+        # serving edge with continuous batching killed: the wave-aligned
+        # fallback must stay byte-identical and the gateway contract
+        # (admission/backpressure/rest statuses) must hold either way
+        run_leg(
+            "serving-gateway", {"PATHWAY_CONTINUOUS_BATCH": "0"}, extra,
+            [
+                "tests/test_serving_gateway.py",
+                "tests/test_continuous_batching.py",
+                "tests/test_device_plane.py",
+                "tests/test_llm_xpack.py",
             ],
         ),
     ]

@@ -1,0 +1,229 @@
+"""Continuous batching for LLM decode (serving/continuous_batching.py).
+
+Pins the acceptance contract of the slot scheduler:
+
+  * a request admitted MID-GENERATION joins the in-flight decode batch at
+    a step boundary — the device-plane compile ledger shows zero new XLA
+    compilations for the join, and the slot counters (pool + metrics
+    registry) prove the freed-slot re-fill happened;
+  * `PATHWAY_CONTINUOUS_BATCH=0` (and `continuous_batching=False`) fall
+    back to wave-aligned dispatch BYTE-identically — the slot path's
+    per-row math is the same as the scanned `generate_serving` path;
+  * slot-pool bookkeeping: acquire/release, refill + joined-in-flight
+    counters, exhaustion, namespace cleanup.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+import pytest
+
+from pathway_tpu.engine.device_plane import DevicePlane, SlotPool
+from pathway_tpu.internals import observability as obs
+from pathway_tpu.models import lm_config
+
+
+@pytest.fixture(autouse=True)
+def _plane_off():
+    yield
+    obs.disable()
+
+
+TINY = dict(
+    vocab_size=256, d_model=16, n_heads=2, n_layers=1, d_ff=32, max_len=64
+)
+
+
+def _chat(**kw):
+    from pathway_tpu.xpacks.llm.llms import JaxLMChat
+
+    kw.setdefault("config", lm_config(**TINY))
+    kw.setdefault("max_new_tokens", 4)
+    return JaxLMChat(**kw)
+
+
+# ------------------------------------------------------------ slot pool
+
+
+def test_slot_pool_acquire_release_and_counters():
+    pool = SlotPool("t", 2)
+    a = pool.acquire()
+    b = pool.acquire()
+    assert {a, b} == {0, 1}
+    assert pool.acquire() is None  # exhausted: request stays queued
+    assert pool.joined_inflight == 1  # b acquired while a was in flight
+    assert pool.refills == 0
+    pool.release(a)
+    c = pool.acquire()
+    assert c == a
+    assert pool.refills == 1  # a freed row re-filled
+    assert pool.joined_inflight == 2
+    assert pool.high_water == 2
+    with pytest.raises(ValueError):
+        pool.release(b)
+        pool.release(b)  # double release fails loudly
+
+
+def test_plane_slot_pool_registry_and_namespace_drop():
+    plane = DevicePlane()
+    pool = plane.slot_pool("cb#1/slots", 4)
+    assert plane.slot_pool("cb#1/slots", 4) is pool
+    with pytest.raises(ValueError):
+        plane.slot_pool("cb#1/slots", 8)  # size conflict fails loudly
+    plane.program("cb#1/prefill", lambda x: x)
+    plane.program("cb#10/prefill", lambda x: x)  # prefix sibling
+    plane.restore(("cb_kv_cache", "cb#1", 4), {"k": 0})
+    plane.drop_namespace("cb#1")
+    assert "cb#1/prefill" not in plane.programs
+    assert "cb#10/prefill" in plane.programs  # delimiter-aware match
+    assert "cb#1/slots" not in plane._slot_pools
+    assert not any(
+        isinstance(k, tuple) and "cb#1" in k for k in plane._leases
+    )
+
+
+# ---------------------------------------------------- kill-switch equality
+
+
+def test_continuous_batching_matches_wave_aligned_byte_identically():
+    """The central equivalence: the slot scheduler's output equals the
+    wave-aligned generate dispatch byte for byte, per request."""
+    cb = _chat(continuous_batching=True, decode_slots=4)
+    wa = _chat(continuous_batching=False)
+    prompts = ["a b c", "d", "hello world longer prompt", "x y", "q", "z z z"]
+    futs = [cb._cb.submit(p) for p in prompts]
+    got_cb = [f.result(timeout=60) for f in futs]
+    got_wa = wa._generate_batch(prompts)
+    assert got_cb == got_wa
+    cb._cb.drain()
+
+
+def test_kill_switch_env_restores_wave_aligned_path(monkeypatch):
+    monkeypatch.setenv("PATHWAY_CONTINUOUS_BATCH", "0")
+    chat = _chat()
+    assert chat._cb is None  # wave-aligned coalescer only
+    monkeypatch.setenv("PATHWAY_CONTINUOUS_BATCH", "1")
+    chat_on = _chat()
+    assert chat_on._cb is not None
+
+
+def test_sampled_generation_keeps_wave_aligned_path():
+    chat = _chat(temperature=0.7)
+    assert chat._cb is None  # per-request rng in a shared step: future work
+
+
+# ------------------------------------------- mid-generation join acceptance
+
+
+def test_mid_generation_join_refills_slot_without_new_compile():
+    """A request admitted while another is mid-generation joins the
+    in-flight decode batch: the compile ledger gains NOTHING (the step
+    program and the prompt bucket are warm) and the slot counters — on
+    the pool and in the metrics registry — record the join/re-fill."""
+    obs.enable()
+    chat = _chat(max_new_tokens=24, continuous_batching=True, decode_slots=2)
+    cb = chat._cb
+    assert cb is not None
+    # warm both programs and the prompt bucket with one full generation
+    cb.submit("warm up prompt").result(timeout=60)
+    cb.drain()
+    warmed = (dict(cb._step.compile_counts), dict(cb._prefill.compile_counts))
+    pool_before = cb.pool.snapshot()
+
+    first = cb.submit("first long running request")
+    # wait until the first request is provably mid-generation
+    deadline = _time.monotonic() + 30
+    while cb.stats["decode_steps"] < 3 and _time.monotonic() < deadline:
+        _time.sleep(0.005)
+    assert cb.stats["decode_steps"] >= 3, "first request never started decoding"
+    second = cb.submit("second joins the flight")
+    r1 = first.result(timeout=60)
+    r2 = second.result(timeout=60)
+    cb.drain()
+    # outputs still equal the wave-aligned path (no cross-slot bleed)
+    wa = _chat(continuous_batching=False, max_new_tokens=24)
+    assert [r1, r2] == wa._generate_batch(
+        ["first long running request", "second joins the flight"]
+    )
+    # zero new compiles for the join
+    after = (dict(cb._step.compile_counts), dict(cb._prefill.compile_counts))
+    assert after == warmed, f"join recompiled: {warmed} -> {after}"
+    # slot counters prove the join: pool-side and registry-side
+    pool_after = cb.pool.snapshot()
+    assert pool_after["joined_inflight"] > pool_before["joined_inflight"]
+    assert pool_after["refills"] > pool_before["refills"]
+    plane = obs.PLANE
+    assert plane is not None
+    assert plane.metrics.counter_value(
+        "pathway_serving_joined_inflight_total", {"pool": cb.pool.name}
+    ) >= 1
+    assert plane.metrics.counter_value(
+        "pathway_serving_slot_refills_total", {"pool": cb.pool.name}
+    ) >= 1
+    assert plane.metrics.counter_value(
+        "pathway_serving_decode_steps_total", {"pool": cb.pool.name}
+    ) >= 23
+
+
+def test_queue_overflow_waits_for_free_slot():
+    """More requests than slots: the excess queues and lands in freed
+    slots (refills), every result still byte-equal to wave-aligned."""
+    chat = _chat(continuous_batching=True, decode_slots=2)
+    cb = chat._cb
+    prompts = [f"prompt number {i}" for i in range(7)]
+    futs = [cb.submit(p) for p in prompts]
+    got = [f.result(timeout=120) for f in futs]
+    cb.drain()
+    wa = _chat(continuous_batching=False)
+    assert got == wa._generate_batch(prompts)
+    snap = cb.pool.snapshot()
+    assert snap["refills"] >= 5  # 7 requests over 2 slots
+    assert snap["active"] == 0  # fully drained
+
+
+def test_chat_finalizer_releases_cb_namespace():
+    chat = _chat(continuous_batching=True, decode_slots=2)
+    cb = chat._cb
+    cb.submit("a b").result(timeout=60)
+    cb.drain()
+    plane = chat._plane
+    name = cb.name
+    assert f"{name}/prefill" in plane.programs
+    assert f"{name}/step" in plane.programs
+    assert f"{name}/slots" in plane._slot_pools
+    assert any(isinstance(k, tuple) and name in k for k in plane._leases)
+    chat._finalizer()  # what gc runs when the instance dies
+    assert f"{name}/prefill" not in plane.programs
+    assert f"{name}/step" not in plane.programs
+    assert f"{name}/slots" not in plane._slot_pools
+    assert not any(isinstance(k, tuple) and name in k for k in plane._leases)
+
+
+def test_cb_chat_through_a_pipeline():
+    """JaxLMChat rides the UDF machinery with continuous batching on:
+    a table of questions answers identically to the wave-aligned run."""
+    import pathway_tpu as pw
+    from pathway_tpu.xpacks.llm.llms import prompt_chat_single_qa
+
+    def run_once(cb_on: bool) -> dict:
+        chat = _chat(continuous_batching=cb_on, decode_slots=2)
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(q=str),
+            [("what is a", ), ("what is b", ), ("what is c", )],
+        )
+        r = t.select(
+            q=pw.this.q, a=chat(pw.apply(prompt_chat_single_qa, pw.this.q))
+        )
+        rows = {}
+        pw.io.subscribe(
+            r,
+            on_change=lambda key, row, time, is_addition: rows.__setitem__(
+                row["q"], row["a"]
+            ),
+        )
+        pw.run()
+        pw.internals.parse_graph.G.clear()
+        return rows
+
+    assert run_once(True) == run_once(False)
