@@ -202,13 +202,21 @@ def test_streaming_native_matches_python_parser(tmp_path):
     )
     th = threading.Thread(target=pw.run, daemon=True)
     th.start()
-    deadline = _t.time() + 10
-    want = {"s": sum(i + 0.25 for i in range(50)), "n": 50}
-    while _t.time() < deadline:
-        if got and got[-1] == want:
-            break
-        _t.sleep(0.05)
-    assert got and got[-1] == want, got[-1] if got else None
+    try:
+        deadline = _t.time() + 10
+        want = {"s": sum(i + 0.25 for i in range(50)), "n": 50}
+        while _t.time() < deadline:
+            if got and got[-1] == want:
+                break
+            _t.sleep(0.05)
+        assert got and got[-1] == want, got[-1] if got else None
+    finally:
+        # stop the streaming pump — a leaked fs watcher run pollutes the
+        # process-global observability plane for every later test
+        from pathway_tpu.internals import run as _run_mod
+
+        _run_mod.stop_current_run()
+        th.join(timeout=20)
 
 
 def test_bool_ops_native_match_python_plane(tmp_path):
