@@ -1177,6 +1177,132 @@ def bench_dataflow(repo: str) -> dict:
     return out
 
 
+def bench_ann(stats: dict) -> dict:
+    """IVF-PQ ANN rungs vs the exact-scan control (ROADMAP item 3,
+    docs/retrieval.md). In-process jax on the default backend — these
+    rungs are MEASURED on CPU-only hosts too (unlike the device-gated
+    knn_p50 rungs): the ANN-vs-exact ratio is a property of the index
+    structure, and the acceptance bar (>= 5x q/s at 1M docs) must be
+    checkable on this host.
+
+    Operating point: d=64 clustered corpus (1000 gaussians — IVF exists
+    for clustered embedding geometry, uniform-random vectors have no
+    lists to route to), B=32 query batch, k=10, nprobe=16,
+    candidates=1024. Recall is reported at the SAME settings as the
+    latency — one operating point, no recall/speed bait-and-switch.
+    The 10M rung peaks around ~12 GB of arrays; the guard requires 24 GB
+    of host RAM (2x headroom for allocator/transient slack) and skips
+    with an explicit reason on hosts below it.
+    """
+    from pathway_tpu.ops import ivf as _ivf
+    from pathway_tpu.ops.topk import knn_search
+
+    out: dict = {}
+    d, B, k = 64, 32, 10
+    nprobe, cand = 16, 1024
+    n_trials = 5
+
+    def run_scale(n: int, label: str) -> None:
+        rng = np.random.default_rng(7)
+        # clusters scale WITH the corpus (~1k rows per topic): growing a
+        # corpus adds topics, it does not pile 10k near-duplicates onto
+        # each one — and with a fixed cluster count the 10M rung turns
+        # into a within-near-tie discrimination test that no candidate
+        # budget this side of the cluster size can pass
+        kc = max(1000, n // 1000)
+        centers = rng.standard_normal((kc, d), dtype=np.float32)
+        docs = centers[rng.integers(0, kc, n)]
+        docs += 0.15 * rng.standard_normal((n, d), dtype=np.float32)
+        docs /= np.linalg.norm(docs, axis=1, keepdims=True)
+        q = docs[rng.choice(n, B)] + 0.05 * rng.standard_normal(
+            (B, d), dtype=np.float32
+        )
+        t0 = time.perf_counter()
+        index = _ivf.build_ivf_pq(docs, seed=0)
+        out[f"ann{label}_build_s"] = round(time.perf_counter() - t0, 1)
+        qdev = jnp.asarray(q)
+        ddev = jnp.asarray(docs)
+        del docs
+
+        def exact_call():
+            return knn_search(qdev, ddev, k, "cos", normalized=True)
+
+        def ann_call():
+            return _ivf.ivf_pq_search(
+                qdev, index, k, nprobe=nprobe, candidates=cand
+            )
+
+        exact_res = exact_call()
+        _sync(exact_res.distances)  # compile
+        exact_trials = []
+        for _ in range(n_trials):
+            t0 = time.perf_counter()
+            _sync(exact_call().distances)
+            exact_trials.append((time.perf_counter() - t0) * 1000.0)
+        ann_res = ann_call()
+        _sync(ann_res[1])  # compile
+        ann_trials = []
+        for _ in range(n_trials):
+            t0 = time.perf_counter()
+            _sync(ann_call()[1])
+            ann_trials.append((time.perf_counter() - t0) * 1000.0)
+        exact_idx = np.asarray(exact_res.indices)
+        ann_idx = np.asarray(ann_res[0])
+        recall = float(
+            np.mean(
+                [
+                    len(set(ann_idx[i]) & set(exact_idx[i])) / k
+                    for i in range(B)
+                ]
+            )
+        )
+        exact_p50 = float(np.median(exact_trials))
+        ann_p50 = float(np.median(ann_trials))
+        suffix = "" if label == "1M" else f"_{label}"
+        out[f"ann{label}_p50_ms"] = round(ann_p50, 1)
+        out[f"ann{label}_exact_p50_ms"] = round(exact_p50, 1)  # the control
+        out[f"ann_recall_at_10{suffix}"] = round(recall, 3)
+        out[f"ann_vs_exact_speedup{suffix}"] = round(
+            exact_p50 / max(ann_p50, 1e-9), 1
+        )
+        stats[f"ann{label}_p50_ms"] = {
+            "median": round(ann_p50, 2),
+            "best": round(min(ann_trials), 2),
+            "trials": [round(x, 2) for x in ann_trials],
+        }
+        stats[f"ann{label}_exact_p50_ms"] = {
+            "median": round(exact_p50, 2),
+            "best": round(min(exact_trials), 2),
+            "trials": [round(x, 2) for x in exact_trials],
+        }
+
+    try:
+        run_scale(1_000_000, "1M")
+        out["ann1M_skip_reason"] = None
+    except Exception as e:  # noqa: BLE001 — record, never kill the bench
+        out["ann1M_p50_ms"] = None
+        out["ann1M_skip_reason"] = f"failed: {type(e).__name__}: {e}"
+    ram_gb = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE") / 2**30
+    need_gb = 24
+    if os.environ.get("PATHWAY_BENCH_SKIP_ANN10M") == "1":
+        out["ann10M_p50_ms"] = None
+        out["ann10M_skip_reason"] = "skipped: PATHWAY_BENCH_SKIP_ANN10M=1"
+    elif ram_gb < need_gb:
+        out["ann10M_p50_ms"] = None
+        out["ann10M_skip_reason"] = (
+            f"skipped: host RAM {ram_gb:.0f} GB < {need_gb} GB needed "
+            "for 10M docs"
+        )
+    else:
+        try:
+            run_scale(10_000_000, "10M")
+            out["ann10M_skip_reason"] = None
+        except Exception as e:  # noqa: BLE001
+            out["ann10M_p50_ms"] = None
+            out["ann10M_skip_reason"] = f"failed: {type(e).__name__}: {e}"
+    return out
+
+
 def bench_serving(repo: str) -> dict:
     """Closed-loop serving-gateway rungs (scripts/serving_loadgen.py):
     p50/p99 latency and goodput at 100 and 1k concurrent closed-loop
@@ -1309,6 +1435,9 @@ def main() -> None:
         knn_p50 = bench_knn()  # before embed: HBM clean for the 1M-doc matrix
         knn_single, knn_device = bench_knn_single_dispatch()
         embed_rate = bench_embed()
+    # ANN rungs LAST: the 10M corpus leans on host RAM / HBM that the
+    # device rungs above want clean
+    ann_rungs = bench_ann(dataflow.setdefault("stats", {}))
     result = {
         "metric": "embed_throughput_per_chip",
         "value": round(embed_rate, 1) if embed_rate is not None else None,
@@ -1355,6 +1484,7 @@ def main() -> None:
         **dataflow,
         **rag_tpu,
         **serving,
+        **ann_rungs,
         # config 5 stretch: Gemma-2B-shaped on-chip decode
         "lm_decode_tokens_per_sec": (
             round(decode_rate, 1) if decode_rate else None

@@ -91,6 +91,17 @@ class BucketPolicy:
             )
         return self._round_up(max(n, 1), self.min_rows, self.max_rows)
 
+    def cap_bucket(self, n: int, lo: int = 8) -> int:
+        """Padded capacity for a RESIDENT slab dimension (doc slots, ANN
+        list capacity): power-of-two round-up with no upper clamp —
+        unlike dispatch-batch rows, a persistent buffer legitimately
+        grows past max_rows, and the pow2 ladder still bounds the jit
+        cache to log2(capacity) shapes over the slab's lifetime."""
+        b = max(1, lo)
+        while b < n:
+            b *= 2
+        return b
+
     def seq_bucket(self, longest: int, cap: int) -> int:
         """Padded sequence length for rows whose longest is `longest`,
         bounded by the model cap."""

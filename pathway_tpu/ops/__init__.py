@@ -21,6 +21,11 @@ from pathway_tpu.ops.distances import (
     l2_distances,
     normalize,
 )
+from pathway_tpu.ops.ivf import (
+    IvfPqArrays,
+    build_ivf_pq,
+    ivf_pq_search,
+)
 from pathway_tpu.ops.topk import (
     TopKResult,
     knn_search,
@@ -34,6 +39,9 @@ __all__ = [
     "dot_products",
     "l2_distances",
     "normalize",
+    "IvfPqArrays",
+    "build_ivf_pq",
+    "ivf_pq_search",
     "TopKResult",
     "knn_search",
     "knn_search_sharded",

@@ -243,11 +243,68 @@ def knn_search_sharded(
 
 
 def make_knn_searcher(
-    k: int, metric: str = "cos", mesh: Mesh | None = None, axis: str = "data"
+    k: int,
+    metric: str = "cos",
+    mesh: Mesh | None = None,
+    axis: str = "data",
+    *,
+    ann: bool | None = None,
+    nprobe: int | None = None,
 ) -> Callable[[Array, Array], TopKResult]:
-    """Pre-configured searcher closure (stable jit cache across calls)."""
+    """Pre-configured searcher closure (stable jit cache across calls).
 
-    def search(queries: Array, docs: Array) -> TopKResult:
-        return knn_search_sharded(queries, docs, k, metric, mesh, axis)
+    `ann=True` routes through the IVF-PQ index (`ops/ivf.py`): the first
+    search against a given doc matrix trains and caches the index, later
+    searches probe `nprobe` lists instead of scanning every row. The
+    `PATHWAY_ANN` env var overrides either way — `0` forces the exact
+    scan (the kill-switch discipline), `1` opts unlabeled call sites in.
+    Sharded meshes keep the exact per-shard scan regardless (the ANN
+    tier shards by routing lists across chips — docs/retrieval.md).
+    """
+    from pathway_tpu.indexing import ann_enabled
 
-    return search
+    # ann=False is an explicit exact-search request — the env can veto an
+    # ANN opt-in (PATHWAY_ANN=0) but must not override an explicit False
+    use_ann = (
+        ann is not False
+        and ann_enabled(default=bool(ann))
+        and mesh is None
+        and metric in ("cos", "cosine", "dot", "l2sq")
+    )
+    if not use_ann:
+        def search(queries: Array, docs: Array) -> TopKResult:
+            return knn_search_sharded(queries, docs, k, metric, mesh, axis)
+
+        return search
+
+    import weakref
+
+    import numpy as np
+
+    from pathway_tpu.ops import ivf as _ivf
+
+    # one resident index per searcher, keyed by a LIVE reference to the
+    # doc matrix: an id()-keyed cache would serve stale neighbors when a
+    # freed array's address is recycled by a new same-shape matrix
+    cache: dict = {}
+
+    def search_ann(queries: Array, docs: Array) -> TopKResult:
+        index = None
+        ent = cache.get("index")
+        if ent is not None:
+            ref, shape, cached = ent
+            if ref() is docs and shape == tuple(docs.shape):
+                index = cached
+        if index is None:
+            index = _ivf.build_ivf_pq(np.asarray(docs), metric=metric)
+            try:
+                ref = weakref.ref(docs)
+            except TypeError:  # unweakreferenceable: pin it (still correct)
+                ref = (lambda d=docs: d)
+            cache["index"] = (ref, tuple(docs.shape), index)
+        slots, dists = _ivf.ivf_pq_search(
+            queries, index, k, nprobe=nprobe, metric=metric
+        )
+        return TopKResult(indices=slots, distances=dists)
+
+    return search_ann

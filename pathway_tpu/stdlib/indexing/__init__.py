@@ -22,6 +22,8 @@ from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     BruteForceKnn,
     BruteForceKnnFactory,
     BruteForceKnnMetricKind,
+    IvfPqKnn,
+    IvfPqKnnFactory,
     LshKnn,
     LshKnnFactory,
     USearchMetricKind,
@@ -41,6 +43,7 @@ from pathway_tpu.stdlib.indexing.sorting import (
 from pathway_tpu.stdlib.indexing.vector_document_index import (
     VectorDocumentIndex,
     default_brute_force_knn_document_index,
+    default_ivf_pq_knn_document_index,
     default_lsh_knn_document_index,
     default_usearch_knn_document_index,
     default_vector_document_index,
@@ -60,6 +63,8 @@ __all__ = [
     "USearchKnn",
     "UsearchKnnFactory",
     "USearchMetricKind",
+    "IvfPqKnn",
+    "IvfPqKnnFactory",
     "LshKnn",
     "LshKnnFactory",
     "TantivyBM25",
@@ -69,6 +74,7 @@ __all__ = [
     "VectorDocumentIndex",
     "default_vector_document_index",
     "default_brute_force_knn_document_index",
+    "default_ivf_pq_knn_document_index",
     "default_usearch_knn_document_index",
     "default_lsh_knn_document_index",
     "default_full_text_document_index",

@@ -46,14 +46,37 @@ class _HybridHostIndex:
             sub.remove(key)
 
     def search(self, query: Any, k: int, metadata_filter: str | None = None):
-        scores: dict[Key, float] = {}
         fetch = max(k * self.per_sub_factor, k)
-        for sub, payload in zip(self.subs, query):
-            for rank, (key, _score) in enumerate(
-                sub.search(payload, fetch, metadata_filter)
-            ):
-                scores[key] = scores.get(key, 0.0) + 1.0 / (self.rrf_k + rank + 1)
-        ranked = sorted(scores.items(), key=lambda kv: -kv[1])[:k]
+        ranked_lists = [
+            sub.search(payload, fetch, metadata_filter)
+            for sub, payload in zip(self.subs, query)
+        ]
+        scores: dict[Key, float] = {}
+        for results in ranked_lists:
+            for key, _score in results:
+                scores.setdefault(key, 0.0)
+        for results in ranked_lists:
+            for rank, (key, _score) in enumerate(results):
+                scores[key] += 1.0 / (self.rrf_k + rank + 1)
+            if len(results) < fetch:
+                # SHORT list: this sub ranked everything it matches, so a
+                # doc absent from it bounds at "just past the fetch
+                # horizon" — pad it there (strictly below every real hit
+                # of this sub) instead of dropping its contribution to 0.
+                # Without the pad, a sub returning 2 hits (a rare BM25
+                # term) outranks every other sub's top hits: its lone
+                # 1/(K+1) ties the other sub's rank-0 and beats its
+                # rank-1, however strong those vector matches are.
+                seen = {key for key, _ in results}
+                pad = 1.0 / (self.rrf_k + fetch + 1)
+                for key in scores:
+                    if key not in seen:
+                        scores[key] += pad
+        # (score, key) tie-break: fusion output must not depend on dict
+        # insertion order (worker-count invariance, like every retriever)
+        ranked = sorted(
+            scores.items(), key=lambda kv: (-kv[1], kv[0].value)
+        )[:k]
         return [(key, -s) for key, s in ranked]
 
 

@@ -107,6 +107,55 @@ class UsearchKnn(_EmbeddingKnn):
 
 
 @dataclass(frozen=True)
+class IvfPqKnn(_EmbeddingKnn):
+    """Device-native incremental IVF-PQ ANN (docs/retrieval.md): coarse
+    k-means routing + product-quantized ADC scan + exact rescore,
+    maintained under retractions with background retrains
+    (`pathway_tpu/indexing/ann.py`).
+
+    Kill switch: ``PATHWAY_ANN=0`` builds the exact slab index instead —
+    byte-identical ranking semantics (same (score, key) tie-break), the
+    guarantee the `ann` CI leg pins. Corpora under `train_min` rows are
+    served exactly either way.
+    """
+
+    dimensions: int | None = None
+    reserved_space: int = 1024
+    metric: str = BruteForceKnnMetricKind.COS
+    n_lists: int | None = None
+    nprobe: int | None = None
+    subvectors: int | None = None
+    train_min: int = 256
+    background_retrain: bool = True
+    embedder: Any = None
+
+    def _host_index_factory(self) -> Callable:
+        cfg = (
+            self.dimensions, self.reserved_space, self.metric, self.n_lists,
+            self.nprobe, self.subvectors, self.train_min,
+            self.background_retrain,
+        )
+
+        def build():
+            # env read at BUILD time (graph lowering), not class-def time,
+            # so a test leg's PATHWAY_ANN applies to every pipeline it runs
+            from pathway_tpu.indexing import IvfPqIndex, ann_enabled
+
+            if not ann_enabled(True):
+                return VectorSlabIndex(
+                    dimensions=cfg[0], reserved_space=cfg[1], metric=cfg[2],
+                    approx=False,
+                )
+            return IvfPqIndex(
+                dimensions=cfg[0], reserved_space=cfg[1], metric=cfg[2],
+                n_lists=cfg[3], nprobe=cfg[4], subvectors=cfg[5],
+                train_min=cfg[6], background_retrain=cfg[7],
+            )
+
+        return build
+
+
+@dataclass(frozen=True)
 class LshKnn(_EmbeddingKnn):
     """LSH-bucketed approximate KNN (reference: LshKnn,
     stdlib/indexing/nearest_neighbors.py:262 over ml/classifiers/_knn_lsh.py)."""
@@ -175,6 +224,38 @@ class UsearchKnnFactory(InnerIndexFactory):
             dimensions=self.dimensions,
             reserved_space=self.reserved_space,
             metric=self.metric,
+            embedder=self.embedder,
+        )
+
+
+@dataclass(frozen=True)
+class IvfPqKnnFactory(InnerIndexFactory):
+    dimensions: int | None = None
+    reserved_space: int = 1024
+    metric: str = BruteForceKnnMetricKind.COS
+    n_lists: int | None = None
+    nprobe: int | None = None
+    subvectors: int | None = None
+    train_min: int = 256
+    background_retrain: bool = True
+    embedder: Any = None
+
+    def build_inner_index(
+        self,
+        data_column: ColumnReference,
+        metadata_column: ColumnExpression | None = None,
+    ) -> IvfPqKnn:
+        return IvfPqKnn(
+            data_column=data_column,
+            metadata_column=metadata_column,
+            dimensions=self.dimensions,
+            reserved_space=self.reserved_space,
+            metric=self.metric,
+            n_lists=self.n_lists,
+            nprobe=self.nprobe,
+            subvectors=self.subvectors,
+            train_min=self.train_min,
+            background_retrain=self.background_retrain,
             embedder=self.embedder,
         )
 

@@ -16,6 +16,7 @@ from pathway_tpu.internals.table import Table
 from pathway_tpu.stdlib.indexing.data_index import DataIndex
 from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     BruteForceKnn,
+    IvfPqKnn,
     LshKnn,
     UsearchKnn,
 )
@@ -72,6 +73,32 @@ def default_usearch_knn_document_index(
         metadata_column=metadata_column,
         dimensions=dimensions,
         metric=metric,
+        embedder=embedder,
+    )
+    return DataIndex(data_table=data_table, inner_index=inner)
+
+
+def default_ivf_pq_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    dimensions: int,
+    embedder: Any | None = None,
+    metadata_column: ColumnExpression | None = None,
+    metric: str = "cos",
+    n_lists: int | None = None,
+    nprobe: int | None = None,
+) -> DataIndex:
+    """Incremental IVF-PQ ANN over the document vectors — the scaling
+    tier past the brute-force slab (docs/retrieval.md). `PATHWAY_ANN=0`
+    falls back to the exact slab with identical ranking semantics."""
+    inner = IvfPqKnn(
+        data_column=data_column,
+        metadata_column=metadata_column,
+        dimensions=dimensions,
+        metric=metric,
+        n_lists=n_lists,
+        nprobe=nprobe,
         embedder=embedder,
     )
     return DataIndex(data_table=data_table, inner_index=inner)

@@ -16,7 +16,11 @@ from typing import Any
 from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
 from pathway_tpu.internals.table import Table
 from pathway_tpu.stdlib.indexing.data_index import DataIndex
-from pathway_tpu.stdlib.indexing.nearest_neighbors import BruteForceKnn, LshKnn
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    IvfPqKnn,
+    LshKnn,
+)
 
 _METRIC = {"euclidean": "l2sq", "cosine": "cos", "cos": "cos", "l2": "l2sq"}
 
@@ -33,6 +37,7 @@ class KNNIndex:
         distance_type: str = "euclidean",
         metadata: ColumnExpression | None = None,
         use_lsh: bool = False,
+        use_ann: bool = False,
     ):
         self.data = data
         if distance_type not in _METRIC:
@@ -46,6 +51,15 @@ class KNNIndex:
                 n_and=n_and,
                 bucket_length=bucket_length,
                 distance_type="l2" if distance_type in ("euclidean", "l2") else "cos",
+            )
+        elif use_ann:
+            # incremental IVF-PQ (docs/retrieval.md); PATHWAY_ANN=0
+            # drops this back to the exact slab at lowering time
+            inner = IvfPqKnn(
+                data_column=data_embedding,
+                metadata_column=metadata,
+                dimensions=n_dimensions,
+                metric=_METRIC[distance_type],
             )
         else:
             inner = BruteForceKnn(

@@ -19,6 +19,47 @@ from pathway_tpu.stdlib.indexing.data_index import DataIndex
 from pathway_tpu.stdlib.indexing.retrievers import InnerIndexFactory
 
 
+def default_retriever_factory(
+    embedder: pw.UDF,
+    dimensions: int | None = None,
+    *,
+    ann: bool | None = None,
+    with_bm25: bool = False,
+    rrf_k: float = 60.0,
+) -> InnerIndexFactory:
+    """Config-driven retriever selection for document stores.
+
+    `ann` picks the incremental IVF-PQ tier over the exact slab
+    (docs/retrieval.md); None defers to the ``PATHWAY_ANN`` env var
+    with an exact default (and ``PATHWAY_ANN=0`` vetoes an explicit
+    True at lowering time either way — the kill-switch contract).
+    `with_bm25` wraps the KNN in a HybridIndexFactory with a BM25
+    leg fused by reciprocal rank (the reference's USearch+Tantivy
+    pairing as one operator).
+    """
+    from pathway_tpu.indexing import ann_enabled
+    from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25Factory
+    from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndexFactory
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        BruteForceKnnFactory,
+        IvfPqKnnFactory,
+    )
+
+    if dimensions is None:
+        dimensions = embedder.get_embedding_dimension()
+    # ann=False is an explicit exact request the env must not override;
+    # ann=True is an opt-in PATHWAY_ANN=0 can veto; None defers entirely
+    if ann is not False and ann_enabled(default=bool(ann)):
+        knn: InnerIndexFactory = IvfPqKnnFactory(
+            dimensions=dimensions, embedder=embedder
+        )
+    else:
+        knn = BruteForceKnnFactory(dimensions=dimensions, embedder=embedder)
+    if not with_bm25:
+        return knn
+    return HybridIndexFactory([knn, TantivyBM25Factory()], k=rrf_k)
+
+
 class DocumentStore:
     """Builds and serves a live document index.
 

@@ -23,8 +23,11 @@ from typing import Any, Callable
 import pathway_tpu as pw
 from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.table import Table
-from pathway_tpu.stdlib.indexing.nearest_neighbors import BruteForceKnnFactory
-from pathway_tpu.xpacks.llm.document_store import DocumentStore, _plain
+from pathway_tpu.xpacks.llm.document_store import (
+    DocumentStore,
+    _plain,
+    default_retriever_factory,
+)
 
 
 def _call_maybe_async(fn: Callable, *args: Any) -> Any:
@@ -101,6 +104,8 @@ class VectorStoreServer:
         splitter: Any = None,
         doc_post_processors: list[Callable] | None = None,
         index_factory: Any = None,
+        ann: bool | None = None,
+        with_bm25: bool = False,
     ):
         if embedder is None and index_factory is None:
             from pathway_tpu.xpacks.llm.embedders import JaxEmbedder
@@ -109,8 +114,12 @@ class VectorStoreServer:
         embedder = _as_embedder(embedder)
         self.embedder = embedder
         if index_factory is None:
-            dim = embedder.get_embedding_dimension()
-            index_factory = BruteForceKnnFactory(dimensions=dim, embedder=embedder)
+            # ann=True -> incremental IVF-PQ tier; None defers to
+            # PATHWAY_ANN (exact default); with_bm25 adds RRF text
+            # fusion. See docs/retrieval.md.
+            index_factory = default_retriever_factory(
+                embedder, ann=ann, with_bm25=with_bm25
+            )
         self.document_store = DocumentStore(
             list(docs),
             retriever_factory=index_factory,

@@ -28,6 +28,11 @@ wave-aligned fallback must stay byte-identical and the gateway /
 rest-connector contract must hold on both dispatch models; the CB-on
 side of the same suites already runs inside legs 1-2
 (docs/serving.md §6).
+Leg 8 (ann): the indexing suites with the ANN kill switch thrown
+(PATHWAY_ANN=0) — every IVF-PQ-configured retriever must drop back to
+the exact slab search with byte-identical ranking semantics
+(docs/retrieval.md); the ANN-on side of the same suites already runs
+inside legs 1-2.
 
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
@@ -169,6 +174,18 @@ def main() -> int:
                 "tests/test_continuous_batching.py",
                 "tests/test_device_plane.py",
                 "tests/test_llm_xpack.py",
+            ],
+        ),
+        # ANN kill switch thrown: IVF-PQ retrievers must reproduce the
+        # exact slab rankings byte-identically across the index stack
+        run_leg(
+            "ann", {"PATHWAY_ANN": "0"}, extra,
+            [
+                "tests/test_ann_index.py",
+                "tests/test_indexing.py",
+                "tests/test_indexing_relevance.py",
+                "tests/test_vector_store.py",
+                "tests/test_ml.py",
             ],
         ),
     ]

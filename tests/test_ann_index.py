@@ -1,0 +1,459 @@
+"""Tier-1 guards for the incremental IVF-PQ ANN subsystem.
+
+Three contracts (docs/retrieval.md):
+* **recall** — recall@10 >= 0.95 vs the exact f32 scan at default
+  nprobe on a seeded clustered corpus;
+* **zset correctness under churn** — interleaved add / retract /
+  retrain must never surface a tombstoned row (no leaks) and never
+  lose a live one (no lost inserts);
+* **kill switch** — PATHWAY_ANN=0 reproduces exact-search rankings
+  byte-identically through the whole InnerIndex/lowering stack.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.indexing import IvfPqIndex, ann_enabled
+from pathway_tpu.internals.keys import Key
+from pathway_tpu.stdlib.indexing import DataIndex, BruteForceKnn, IvfPqKnn
+from pathway_tpu.stdlib.indexing.host_indexes import VectorSlabIndex
+
+DIM = 32
+
+
+def _clustered(n: int, seed: int = 0, n_clusters: int = 40) -> np.ndarray:
+    """Mixture-of-gaussians corpus — the geometry real embedding spaces
+    have, and the one IVF routing exists for."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(n_clusters, DIM))
+    return (
+        centers[rng.integers(0, n_clusters, n)]
+        + 0.15 * rng.normal(size=(n, DIM))
+    ).astype(np.float32)
+
+
+def _load(index, docs: np.ndarray, start: int = 0) -> list[Key]:
+    keys = [Key(start + i) for i in range(len(docs))]
+    for key, vec in zip(keys, docs):
+        index.add(key, vec)
+    return keys
+
+
+def _exact_reference(docs: np.ndarray) -> VectorSlabIndex:
+    # device=False: the reference must be the true f32 ranking, not the
+    # bf16 slab mirror (its ~2^-8 rounding scrambles near-ties and would
+    # penalize the ANN's f32 rescore for being MORE exact)
+    ex = VectorSlabIndex(dimensions=DIM, device=False)
+    _load(ex, docs)
+    return ex
+
+
+def _recall_at(res, ref, k: int = 10) -> float:
+    vals = []
+    for a, b in zip(res, ref):
+        got = {key for key, _ in a[:k]}
+        want = {key for key, _ in b[:k]}
+        vals.append(len(got & want) / max(len(want), 1))
+    return float(np.mean(vals))
+
+
+# ----------------------------------------------------------- recall guard
+
+
+def test_ann_recall_guard_at_default_nprobe():
+    """The tier-1 quality bar: recall@10 >= 0.95 vs exact brute force on
+    a seeded corpus, default nprobe, after incremental (not one-shot)
+    loading."""
+    docs = _clustered(4000, seed=0)
+    ann = IvfPqIndex(dimensions=DIM, background_retrain=False, seed=0)
+    _load(ann, docs)
+    assert ann.stats()["trained"]
+    rng = np.random.default_rng(1)
+    q = docs[rng.choice(len(docs), 50)] + 0.05 * rng.normal(size=(50, DIM))
+    items = [(q[i], 10, None) for i in range(len(q))]
+    res = ann.search_batch(items)
+    ref = _exact_reference(docs).search_batch(items)
+    recall = _recall_at(res, ref)
+    assert recall >= 0.95, f"recall@10 {recall} < 0.95 at default nprobe"
+    # the self-reported gauge agrees with the external measurement
+    assert ann.measured_recall() >= 0.95
+
+
+def test_ann_nprobe_is_a_per_query_knob():
+    """Raising nprobe toward L approaches the exact ranking; the knob is
+    per search call, not per index build."""
+    docs = _clustered(3000, seed=2)
+    ann = IvfPqIndex(dimensions=DIM, background_retrain=False, seed=0)
+    _load(ann, docs)
+    L = ann.stats()["lists"]
+    q = _clustered(20, seed=3)
+    items = [(q[i], 10, None) for i in range(len(q))]
+    ref = _exact_reference(docs).search_batch(items)
+    wide = _recall_at(ann.search_batch(items, nprobe=L), ref)
+    narrow = _recall_at(ann.search_batch(items, nprobe=1), ref)
+    assert wide >= 0.95
+    assert wide >= narrow
+
+
+# ------------------------------------------------------ churn correctness
+
+
+def test_ann_adversarial_churn():
+    """Interleaved add / retract / re-add / retrain: results are always
+    a subset of live rows (no tombstone leaks) and every live row stays
+    findable by its own vector (no lost inserts)."""
+    rng = np.random.default_rng(42)
+    docs = _clustered(2000, seed=4)
+    ann = IvfPqIndex(
+        dimensions=DIM, background_retrain=False, train_min=256, seed=0
+    )
+    live: dict[Key, np.ndarray] = {}
+    next_id = 0
+
+    def check():
+        assert set(ann.key_of.values()) == set(live)
+        sample = rng.choice(len(live), min(30, len(live)), replace=False)
+        keys = list(live)
+        items = [(live[keys[i]], 5, None) for i in sample]
+        res = ann.search_batch(items)
+        for i, matches in zip(sample, res):
+            got = [key for key, _ in matches]
+            assert set(got) <= set(live), "tombstoned row surfaced"
+            assert keys[i] in got, "live row lost from its own neighborhood"
+
+    for round_ in range(6):
+        # adds (fresh ids)
+        for _ in range(300):
+            vec = docs[next_id % len(docs)]
+            key = Key(next_id)
+            ann.add(key, vec)
+            live[key] = vec
+            next_id += 1
+        # retracts
+        if len(live) > 200:
+            for key in rng.choice(list(live), 120, replace=False):
+                ann.remove(key)
+                del live[key]
+        # in-place value updates (zset -old +new on one key) — each a
+        # DISTINCT vector (identical vectors tie at distance 0 and the
+        # self-query check below would be asserting tie-break luck)
+        for key in rng.choice(list(live), 40, replace=False):
+            vec = (
+                docs[int(rng.integers(0, len(docs)))]
+                + 0.03 * rng.normal(size=DIM)
+            ).astype(np.float32)
+            ann.add(key, vec)
+            live[key] = vec
+        if round_ % 2 == 1:
+            ann.retrain_now()
+        check()
+    stats = ann.stats()
+    assert stats["trained"] and stats["retrains"] >= 3
+
+
+def test_ann_compaction_drops_tombstones():
+    docs = _clustered(2000, seed=5)
+    ann = IvfPqIndex(
+        dimensions=DIM, background_retrain=False, compact_frac=0.2, seed=0
+    )
+    keys = _load(ann, docs)
+    base = ann.stats()["compactions"]
+    for key in keys[: len(keys) // 2]:
+        ann.remove(key)
+    stats = ann.stats()
+    assert stats["compactions"] > base
+    assert stats["tombstone_frac"] <= 0.2 + 1e-9
+    # post-compaction searches stay correct
+    items = [(docs[i], 5, None) for i in range(1500, 1520)]
+    live = set(ann.key_of.values())
+    for matches in ann.search_batch(items):
+        assert {key for key, _ in matches} <= live
+
+
+def test_ann_spill_then_resplit():
+    """Drift the distribution after training: appends spill past their
+    preferred lists, the index schedules a retrain (the re-split), and
+    the new generation absorbs the drift."""
+    ann = IvfPqIndex(
+        dimensions=DIM, background_retrain=False, train_min=256, seed=0,
+        retrain_factor=100.0,  # isolate the spill trigger from the size one
+    )
+    _load(ann, _clustered(1500, seed=6))
+    spills_before = ann.stats()["spills"]
+    retrains_before = ann.stats()["retrains"]
+    # a new tight cluster the trained partition knows nothing about
+    rng = np.random.default_rng(7)
+    point = rng.normal(size=DIM)
+    drift = (point + 0.02 * rng.normal(size=(900, DIM))).astype(np.float32)
+    _load(ann, drift, start=10_000)
+    stats = ann.stats()
+    assert stats["spills"] > spills_before
+    assert stats["retrains"] > retrains_before, "chronic spill must re-split"
+    q = [(drift[i], 10, None) for i in range(10)]
+    for matches in ann.search_batch(q):
+        assert len(matches) == 10
+
+
+def test_ann_background_retrain_off_wave_path():
+    """Queries keep answering (old generation) while a retrain runs on
+    another thread; the swap is atomic and results stay ⊆ live."""
+    docs = _clustered(3000, seed=8)
+    ann = IvfPqIndex(dimensions=DIM, background_retrain=True, seed=0)
+    _load(ann, docs)
+    ann.wait_retrain()
+    assert ann.stats()["trained"]
+    live = set(ann.key_of.values())
+    stop = threading.Event()
+    errors: list[Exception] = []
+
+    def churn_retrain():
+        try:
+            while not stop.is_set():
+                ann.retrain_now()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=churn_retrain, daemon=True)
+    t.start()
+    try:
+        items = [(docs[i], 10, None) for i in range(40)]
+        for _ in range(15):
+            for matches in ann.search_batch(items):
+                assert {key for key, _ in matches} <= live
+                assert matches, "queries must not block or blank on retrain"
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert not errors
+
+
+# ------------------------------------------------------------ kill switch
+
+
+def _rankings(index_cls_kwargs: dict, monkeypatch, env: str | None):
+    """Build the same dataflow query against an IvfPqKnn retriever and
+    return (list of matched texts, list of scores)."""
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    if env is None:
+        monkeypatch.delenv("PATHWAY_ANN", raising=False)
+    else:
+        monkeypatch.setenv("PATHWAY_ANN", env)
+    rng = np.random.default_rng(11)
+    vecs = rng.normal(size=(30, 4)).round(3)
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(vec=object, name=str),
+        [(tuple(vecs[i]), f"doc{i}") for i in range(len(vecs))],
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qvec=object),
+        [(tuple((vecs[i] + 0.01).round(3)),) for i in range(0, 30, 3)],
+    )
+    inner = IvfPqKnn(data_column=docs.vec, dimensions=4, **index_cls_kwargs)
+    res = DataIndex(docs, inner).query_as_of_now(
+        queries.qvec, number_of_matches=5, with_distances=True
+    )
+    df = pw.debug.table_to_pandas(res, include_id=False)
+    names = [tuple(r) for r in df["name"]]
+    scores = [tuple(r) for r in df["_pw_index_reply_score"]]
+    G.clear()
+    return names, scores
+
+
+def test_pathway_ann_0_is_byte_identical_to_exact(monkeypatch):
+    """The kill-switch contract: PATHWAY_ANN=0 must reproduce the exact
+    brute-force rankings byte for byte (same scores, same tie-break) —
+    and on a sub-train_min corpus ANN-on does too (exact serving mode)."""
+    from pathway_tpu.internals.parse_graph import G
+
+    ann_on = _rankings({}, monkeypatch, env=None)
+    ann_off = _rankings({}, monkeypatch, env="0")
+    # reference: the plain BruteForceKnn retriever
+    G.clear()
+    monkeypatch.delenv("PATHWAY_ANN", raising=False)
+    rng = np.random.default_rng(11)
+    vecs = rng.normal(size=(30, 4)).round(3)
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(vec=object, name=str),
+        [(tuple(vecs[i]), f"doc{i}") for i in range(len(vecs))],
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qvec=object),
+        [(tuple((vecs[i] + 0.01).round(3)),) for i in range(0, 30, 3)],
+    )
+    res = DataIndex(
+        docs, BruteForceKnn(data_column=docs.vec, dimensions=4)
+    ).query_as_of_now(queries.qvec, number_of_matches=5, with_distances=True)
+    df = pw.debug.table_to_pandas(res, include_id=False)
+    brute = (
+        [tuple(r) for r in df["name"]],
+        [tuple(r) for r in df["_pw_index_reply_score"]],
+    )
+    assert ann_off == brute
+    assert ann_on == brute  # 30 docs < train_min: exact mode either way
+
+
+def test_ann_enabled_env_contract(monkeypatch):
+    monkeypatch.delenv("PATHWAY_ANN", raising=False)
+    assert ann_enabled(True) and not ann_enabled(False)
+    monkeypatch.setenv("PATHWAY_ANN", "0")
+    assert not ann_enabled(True) and not ann_enabled(False)
+    monkeypatch.setenv("PATHWAY_ANN", "1")
+    assert ann_enabled(True) and ann_enabled(False)
+
+
+def test_make_knn_searcher_routes_to_ann(monkeypatch):
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops import make_knn_searcher
+
+    monkeypatch.delenv("PATHWAY_ANN", raising=False)
+    docs = _clustered(2000, seed=12)
+    docs /= np.linalg.norm(docs, axis=1, keepdims=True)
+    q = jnp.asarray(docs[:8] + 0.01)
+    ddev = jnp.asarray(docs)
+    exact = make_knn_searcher(10)(q, ddev)
+    ann = make_knn_searcher(10, ann=True)(q, ddev)
+    overlap = np.mean([
+        len(set(np.asarray(ann.indices)[i]) & set(np.asarray(exact.indices)[i]))
+        / 10
+        for i in range(8)
+    ])
+    assert overlap >= 0.9
+    # kill switch vetoes the explicit ann=True
+    monkeypatch.setenv("PATHWAY_ANN", "0")
+    off = make_knn_searcher(10, ann=True)(q, ddev)
+    assert np.array_equal(np.asarray(off.indices), np.asarray(exact.indices))
+
+
+# ------------------------------------------------------- plane discipline
+
+
+def test_ann_device_compile_ledger_stays_flat():
+    """Streaming same-bucket searches must not recompile: every
+    (ann program, bucket) ledger entry stays at exactly 1."""
+    from pathway_tpu.engine.device_plane import get_device_plane
+
+    docs = _clustered(1500, seed=13)
+    ann = IvfPqIndex(dimensions=DIM, background_retrain=False, seed=0)
+    keys = _load(ann, docs)
+    items = [(docs[i], 10, None) for i in range(16)]
+    for round_ in range(5):
+        ann.search_batch(items)
+        # small same-shape churn between searches (delta scatter path)
+        ann.remove(keys[round_])
+        ann.add(keys[round_], docs[round_])
+    counts = {
+        bucket: n
+        for (prog, bucket), n in get_device_plane().compile_counts().items()
+        if prog.startswith("ann_")
+    }
+    assert counts, "ANN must route through the device plane"
+    assert all(n == 1 for n in counts.values()), counts
+
+
+def test_ann_pickle_roundtrip_preserves_results():
+    docs = _clustered(1200, seed=14)
+    ann = IvfPqIndex(dimensions=DIM, background_retrain=False, seed=0)
+    _load(ann, docs)
+    items = [(docs[i], 10, None) for i in range(12)]
+    before = ann.search_batch(items)
+    ann2 = pickle.loads(pickle.dumps(ann))
+    assert ann2.search_batch(items) == before
+
+
+def test_ann_metrics_published_to_registry():
+    from pathway_tpu.internals import observability as obs
+
+    obs.enable()
+    try:
+        docs = _clustered(1000, seed=15)
+        ann = IvfPqIndex(dimensions=DIM, background_retrain=False, seed=0)
+        _load(ann, docs)
+        ann.search_batch([(docs[0], 10, None)])
+        ann.measured_recall(k=10)
+        snap = obs.PLANE.metrics.snapshot()
+        for name in (
+            "pathway_index_size_rows",
+            "pathway_index_lists",
+            "pathway_index_tombstone_frac",
+            "pathway_index_retrain_seconds",
+            "pathway_index_recall_at_k",
+        ):
+            assert name in snap, f"{name} missing from the registry"
+            series = snap[name]["series"]
+            assert any(s["labels"].get("index") == ann.name for s in series)
+        recall_series = snap["pathway_index_recall_at_k"]["series"]
+        val = next(
+            s["value"] for s in recall_series
+            if s["labels"].get("index") == ann.name
+        )
+        assert 0.0 <= val <= 1.0
+        rows = next(
+            s["value"] for s in snap["pathway_index_size_rows"]["series"]
+            if s["labels"].get("index") == ann.name
+        )
+        assert rows == len(docs)
+    finally:
+        obs.disable()
+
+
+# ----------------------------------------------------- hybrid fusion fix
+
+
+class _StubIndex:
+    """Fixed-ranking sub-index for fusion tests."""
+
+    def __init__(self, ranking: list[Key]):
+        self.ranking = ranking
+
+    def add(self, key, data, metadata=None):
+        pass
+
+    def remove(self, key):
+        pass
+
+    def search(self, query, k, metadata_filter=None):
+        return [(key, float(i)) for i, key in enumerate(self.ranking[:k])]
+
+
+def test_hybrid_fusion_robust_to_short_sublists():
+    """Regression (satellite): a sub-index returning fewer than k hits
+    must not outrank every other sub's strong matches. With the
+    short-list pad, a doc at rank 0+1 across full lists beats a doc
+    whose only evidence is one short list's lone hit."""
+    from pathway_tpu.stdlib.indexing.hybrid_index import _HybridHostIndex
+
+    a, b, c = Key(1), Key(2), Key(3)
+    knn = _StubIndex([a, b, c])  # full list
+    bm25 = _StubIndex([c])  # short list: one rare-term hit
+    hybrid = _HybridHostIndex([knn, bm25], rrf_k=60.0)
+    res = hybrid.search(("q", "q"), k=3)
+    ranked = [key for key, _ in res]
+    assert len(ranked) == 3
+    # c: bm25 rank-0 + knn rank-2; a: knn rank-0 + pad — c's two real
+    # signals win, but a (rank-0 vector hit) must beat b (rank-1) and
+    # stay well inside the fused top set rather than being starved
+    assert ranked.index(a) < ranked.index(b)
+    scores = {key: -s for key, s in res}
+    assert scores[a] > 1.0 / 61  # pad contributed (not just its knn rank)
+
+
+def test_hybrid_fusion_deterministic_tie_break():
+    from pathway_tpu.stdlib.indexing.hybrid_index import _HybridHostIndex
+
+    a, b = Key(7), Key(9)
+    # perfectly symmetric evidence: a and b swap ranks across subs
+    s1 = _StubIndex([a, b])
+    s2 = _StubIndex([b, a])
+    res1 = _HybridHostIndex([s1, s2], rrf_k=60.0).search(("q", "q"), k=2)
+    res2 = _HybridHostIndex([s2, s1], rrf_k=60.0).search(("q", "q"), k=2)
+    assert res1 == res2  # key tie-break, not dict insertion order
+    assert [key for key, _ in res1] == sorted([a, b], key=lambda k: k.value)
