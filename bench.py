@@ -404,14 +404,54 @@ class E(pw.Schema):
     uid: int
     amount: float
 
-u = pw.io.fs.read({users!r}, format="json", schema=U, mode="static")
-e = pw.io.fs.read({events!r}, format="json", schema=E, mode="static")
+u = pw.io.fs.read({users!r}, format="json", schema=U, mode="static",
+                  _eager_static=True)
+e = pw.io.fs.read({events!r}, format="json", schema=E, mode="static",
+                  _eager_static=True)
 t0 = time.time()  # rows already interned: the clock sees only the engine
 j = e.join(u, e.uid == u.uid).select(name=u.name, amount=e.amount)
 agg = j.groupby(j.name).reduce(j.name, total=pw.reducers.sum(j.amount))
 pw.io.csv.write(agg, {out!r})
 pw.run()
 print("ROWS_PER_SEC", {n} / (time.time() - t0))
+"""
+
+# Plan-optimizer rung (docs/planner.md): a 6-stage map/filter chain into
+# a groupby — the shape the chain-fusion pass collapses into ONE
+# FusedRowwiseNode (single source decode, no intermediate intern-table
+# writes, one final row build) with scan key elision on the source.
+# Measured against a PATHWAY_FUSE=0 A/B control over the same input;
+# acceptance: fused >= 1.5x unfused. PLAN_NODES reports the lowered node
+# counts before/after fusion (from the session's plan report).
+_FUSED_CHAIN_SCRIPT = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import pathway_tpu as pw
+
+class S(pw.Schema):
+    a: int
+    b: int
+
+t0 = time.time()
+t = pw.io.fs.read({inp!r}, format="json", schema=S, mode="static")
+t1 = t.select(a=pw.this.a, b=pw.this.b, s=pw.this.a + pw.this.b)
+t2 = t1.filter(pw.this.s % 7 != 0)
+t3 = t2.select(a=pw.this.a, b=pw.this.b, s=pw.this.s,
+               v=pw.this.s * 2 - pw.this.b)
+t4 = t3.filter(pw.this.v % 11 != 3)
+t5 = t4.select(g=pw.this.b % 100, w=pw.this.v + pw.this.a % 13)
+t6 = t5.filter(pw.this.w % 5 != 4)
+res = t6.groupby(t6.g).reduce(
+    t6.g, total=pw.reducers.sum(t6.w), n=pw.reducers.count())
+pw.io.csv.write(res, {out!r})
+pw.run()
+print("ROWS_PER_SEC", {n} / (time.time() - t0))
+from pathway_tpu.internals import planner
+rep = planner.last_report()
+import json
+with open({plan_out!r}, "w") as f:
+    json.dump({{"nodes_before": rep["nodes_before"],
+               "nodes_after": rep["nodes_after"]}}, f)
 """
 
 _REGRESSION_SCRIPT = r"""
@@ -1132,6 +1172,56 @@ def bench_dataflow(repo: str) -> dict:
             out["join_profile_attributed_pct"] = None
             out["join_profile_ingest_share"] = None
             out["join_profile_skip_reason"] = f"failed: {e}"
+
+        # plan-optimizer rung: fused chain vs its PATHWAY_FUSE=0 control
+        # (same input, same subprocess harness; docs/planner.md)
+        n_chain = 2_000_000
+        cinp = os.path.join(tmp, "chain.jsonl")
+        rng_c = np.random.default_rng(5)
+        ca = rng_c.integers(0, 1_000_000, n_chain)
+        cb = rng_c.integers(0, 1000, n_chain)
+        with open(cinp, "w") as f:
+            chunkw = []
+            for i in range(n_chain):
+                chunkw.append('{"a": %d, "b": %d}' % (ca[i], cb[i]))
+                if len(chunkw) == 200_000:
+                    f.write("\n".join(chunkw) + "\n")
+                    chunkw = []
+            if chunkw:
+                f.write("\n".join(chunkw) + "\n")
+        plan_out = os.path.join(tmp, "chain_plan.json")
+        cs = _FUSED_CHAIN_SCRIPT.format(
+            repo=repo, inp=cinp, out=os.path.join(tmp, "chain_out.csv"),
+            n=n_chain, plan_out=plan_out,
+        )
+        out["fused_chain_rows_per_sec"] = round(
+            _run_engine_script(
+                cs, {"PATHWAY_THREADS": "1"},
+                stats=stats, rung="fused_chain_rows_per_sec",
+            ),
+            1,
+        )
+        try:
+            with open(plan_out) as f:
+                plan_counts = json.load(f)
+            out["fused_chain_plan_nodes_before"] = plan_counts["nodes_before"]
+            out["fused_chain_plan_nodes_after"] = plan_counts["nodes_after"]
+        except (OSError, ValueError, KeyError) as e:
+            out["fused_chain_plan_nodes_before"] = None
+            out["fused_chain_plan_nodes_after"] = None
+            out["fused_chain_plan_skip_reason"] = f"failed: {e}"
+        out["fused_chain_unfused_rows_per_sec"] = round(
+            _run_engine_script(
+                cs, {"PATHWAY_THREADS": "1", "PATHWAY_FUSE": "0"},
+                stats=stats, rung="fused_chain_unfused_rows_per_sec",
+            ),
+            1,
+        )
+        out["fused_chain_speedup"] = round(
+            out["fused_chain_rows_per_sec"]
+            / out["fused_chain_unfused_rows_per_sec"],
+            2,
+        )
 
         rinp = os.path.join(tmp, "reg.jsonl")
         _gen_regression_input(rinp, REGRESSION_ROWS)

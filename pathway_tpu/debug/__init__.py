@@ -195,6 +195,9 @@ def table_from_pandas(
 
 def _run_capture(table: Table) -> CaptureNode:
     session = Session()
+    # captures observe row keys, so id elision self-vetoes; chain fusion
+    # still applies (single-consumer proofs over this table's spec DAG)
+    session.attach_plan_roots([table], sink_meta=[(table, True)])
     cap = session.capture(table)
     session.execute()
     return cap

@@ -1001,6 +1001,430 @@ class FilterNode(Node):
         self._filter_entries(time, entries)
 
 
+class _NativeProgramBuilder:
+    """Composes per-stage native plans (MapNode-style specs/plans,
+    FilterNode cond plans) into one fused vectorized program. Tracks the
+    compile-time virtual schema: each stage-output column is either a
+    passthrough of a SOURCE column or a computed slot, so the fused
+    runtime decodes exactly the source columns any plan can reach and
+    never interns intermediate rows. Shared by lowering's static fusion
+    and the AdaptivePolicy's runtime re-fusion."""
+
+    def __init__(self) -> None:
+        self.virt: list | None = None  # None = identity over the source
+        self.stages: list = []
+        self.needed_src: set[int] = set()
+
+    def _resolve(self, j: int):
+        return ("src", j) if self.virt is None else self.virt[j]
+
+    def _need(self, cols) -> None:
+        for c in cols:
+            it = self._resolve(c)
+            if it[0] == "src":
+                self.needed_src.add(it[1])
+
+    def adopt(self, program: dict) -> None:
+        """Seed from a stored (source-relative) program — chain head."""
+        assert self.virt is None and not self.stages
+        self.stages = list(program["stages"])
+        self.needed_src = set(program["needed_src"])
+        self.virt = program.get("final_env")
+
+    def adopt_rebased(self, program: dict) -> bool:
+        """Append a stored program mid-chain: its source IS the current
+        virtual schema, so stage items compose through the runtime env
+        unchanged; only the needed-source set and the final schema rebase
+        through the current virt. "keycols" items can't rebase (they
+        blake the ORIGINAL source tokens), so such programs only compose
+        as the chain head."""
+        if self.virt is None and not self.stages:
+            self.adopt(program)
+            return True
+        for st in program["stages"]:
+            if st[0] == "map" and any(it[0] == "keycols" for it in st[1]):
+                return False
+        for c in program["needed_src"]:
+            it = self._resolve(c)
+            if it[0] == "src":
+                self.needed_src.add(it[1])
+        self.stages.extend(program["stages"])
+        fe = program.get("final_env")
+        if fe is not None:
+            self.virt = [
+                self._resolve(it[1]) if it[0] == "src" else ("slot",)
+                for it in fe
+            ]
+        return True
+
+    def add_map(self, specs: list, plans: list) -> bool:
+        from pathway_tpu.internals.expression_numpy import KeyColsPlan
+
+        items: list = []
+        new_virt: list = []
+        for kind, idx in specs:
+            if kind == "col":
+                items.append(("env", idx))
+                new_virt.append(self._resolve(idx))
+                continue
+            p = plans[idx]
+            if isinstance(p, KeyColsPlan):
+                src_cols: list[int] = []
+                for c in p.cols:
+                    it = self._resolve(c)
+                    if it[0] != "src":
+                        return False  # pointer_from over a computed value
+                    src_cols.append(it[1])
+                items.append(("keycols", src_cols))
+            else:
+                self._need(p.needed_cols)
+                items.append(("plan", p))
+            new_virt.append(("slot",))
+        self.stages.append(("map", items))
+        self.virt = new_virt
+        return True
+
+    def add_filter(self, plan) -> bool:
+        self._need(plan.needed_cols)
+        self.stages.append(("filter", plan))
+        return True
+
+    def build(self) -> dict:
+        return {
+            "needed_src": sorted(self.needed_src),
+            "stages": self.stages,
+            "final_env": self.virt,
+        }
+
+
+class FusedRowwiseNode(Node):
+    """One engine node for a fused linear chain of rowwise operators
+    (select / with_columns / filter, optionally terminated by a reindex
+    on the object plane) — the plan optimizer's chain-fusion target
+    (internals/planner.py, docs/planner.md).
+
+    ``stages``: list of ``("map", row_fn)`` / ``("filter", pred)``
+    steps; ``rekey`` an optional final key function (object plane only).
+
+    ``native_program`` (every stage numpy-plannable over a native-plane
+    source) evaluates the composed program per wave with intermediate
+    values held as column arrays: ONE source decode, no intermediate
+    intern-table writes, one final row build — versus one decode + row
+    build + intern per chain node unfused. Rows any stage flags BAD run
+    the composed per-row path from the original row, reproducing the
+    unfused per-node fallback semantics exactly.
+
+    ``stateful=True`` (object-plane chains containing at least one
+    rowwise stage) reproduces RowwiseNode's keyed delta-suppression: the
+    node arranges the input by key and re-emits per affected key, so the
+    fused stream is byte-identical to the chain of suppressing
+    RowwiseNodes it replaces (suppression composes: suppressing only at
+    the chain tail is equivalent to suppressing at every stage for
+    healthy keyed streams). Stateless mode streams entries through like
+    MapNode/FilterNode do.
+    """
+
+    _state_routing = {"_main_state_": "key", "emitted": "key"}
+
+    def __init__(
+        self,
+        graph: Graph,
+        inp: Node,
+        stages: list,
+        *,
+        stateful: bool = False,
+        native_program: dict | None = None,
+        rekey: Callable | None = None,
+        detail: str = "",
+    ):
+        super().__init__(graph, [inp])
+        self.stages = stages
+        self.rekey = rekey
+        self.detail = detail
+        self._stateful = stateful
+        self._program = native_program if _nb_type() is not None else None
+        if self._program is not None:
+            from pathway_tpu.engine.native import dataplane as _dp
+
+            self._dp = _dp
+        if stateful:
+            self._persist_attrs = ("_main_state_", "emitted")
+            self._main_state_ = KeyedState()
+            self.emitted: dict[Key, tuple] = {}
+
+    def describe(self) -> str:
+        base = f"FusedRowwiseNode[{self.detail or 'fused'}]"
+        if self.trace:
+            base += f"@{self.trace}"
+        return f"{base}#{self.node_id}"
+
+    def persist_signature(self) -> str:
+        kinds = "+".join(k for k, _f in self.stages)
+        return (
+            f"FusedRowwiseNode/{kinds}/stateful={int(self._stateful)}"
+            f"/native={int(self._program is not None)}"
+            f"/rekey={int(self.rekey is not None)}"
+        )
+
+    # ------------------------------------------------------ per-row path
+
+    def _run_row(self, key: Key, row: tuple) -> tuple | None:
+        """Composed program on one row; None = dropped by a filter.
+        Map fns are per-column guarded (ERROR poison + log) by lowering;
+        filter errors reproduce FilterNode's log-and-drop."""
+        for kind, fn in self.stages:
+            if kind == "map":
+                row = fn(key, row)
+            else:
+                try:
+                    keep = fn(key, row)
+                except Exception as e:  # noqa: BLE001
+                    self.log_error(f"filter: {type(e).__name__}: {e}")
+                    return None
+                if isinstance(keep, ErrorValue):
+                    self.log_error("filter: Error value in condition")
+                    return None
+                if not keep:
+                    return None
+        return row
+
+    def _emit_entries(self, time: int, out: list[Entry]) -> None:
+        if self.rekey is not None:
+            rekeyed: list[Entry] = []
+            for key, row, diff in out:
+                try:
+                    nk = self.rekey(key, row)
+                except Exception as e:  # noqa: BLE001
+                    self.log_error(f"reindex: {type(e).__name__}: {e}")
+                    continue
+                rekeyed.append((nk, row, diff))
+            self.emit(time, consolidate(rekeyed))
+            return
+        self.emit(time, out)
+
+    def _stream_entries(self, time: int, entries: list[Entry]) -> None:
+        out: list[Entry] = []
+        for key, row, diff in entries:
+            new = self._run_row(key, row)
+            if new is not None:
+                out.append((key, new, diff))
+        self._emit_entries(time, out)
+
+    # ------------------------------------------------------- native path
+
+    def _run_batch(self, time: int, b) -> None:
+        """Vectorized composed program over one NativeBatch. Maintains a
+        row selection (indices into the batch) plus an environment of
+        virtual columns: ("src", i) passthrough of source column i, or
+        ("slot", s) computed (vals_i, vals_f, tags) arrays aligned to
+        the current selection."""
+        prog = self._program
+        dp_mod = self._dp
+        n = len(b)
+        decoded = decode_cols_dict(dp_mod, b.tab, b.token, prog["needed_src"])
+        if decoded is None:
+            self._stream_entries(time, b.materialize())
+            return
+        sel = np.arange(n)
+        slots: list = []  # (vi, vf, tg) aligned to sel
+        env: list | None = None  # None = identity over source columns
+        fallback: list = []  # original-row indices for the per-row path
+
+        def arrays(item):
+            if item[0] == "src":
+                vi, vf, tg = decoded[item[1]]
+                return vi[sel], vf[sel], tg[sel]
+            return slots[item[1]]
+
+        def env_item(j):
+            return ("src", j) if env is None else env[j]
+
+        for step in prog["stages"]:
+            if not len(sel):
+                break
+            if step[0] == "filter":
+                plan = step[1]
+                dec = {j: arrays(env_item(j)) for j in plan.needed_cols}
+                keep, bad = plan.eval_mask(dec, len(sel))
+                if bad.any():
+                    fallback.extend(sel[bad].tolist())
+                m = keep & ~bad
+                if not m.all():
+                    sel = sel[m]
+                    slots = [
+                        (vi[m], vf[m], tg[m]) for (vi, vf, tg) in slots
+                    ]
+                continue
+            # map step: build the next environment
+            new_env: list = []
+            for item in step[1]:
+                if item[0] == "env":
+                    new_env.append(env_item(item[1]))
+                elif item[0] == "keycols":
+                    rk = dp_mod.rekey(b.tab, b.token[sel], item[1])
+                    if rk is None:
+                        self._stream_entries(time, b.materialize())
+                        return
+                    lo, hi = rk
+                    badk = (lo == 0) & (hi == 0)
+                    slots.append((
+                        lo.view(np.int64), hi.view(np.float64),
+                        np.where(badk, np.uint8(255), np.uint8(4)),
+                    ))
+                    new_env.append(("slot", len(slots) - 1))
+                else:  # ("plan", plan)
+                    plan = item[1]
+                    dec = {j: arrays(env_item(j)) for j in plan.needed_cols}
+                    vi, vf, tg = plan.eval_map(dec, len(sel))
+                    slots.append((vi, vf, tg))
+                    new_env.append(("slot", len(slots) - 1))
+            env = new_env
+        if len(sel):
+            if env is None:
+                # pure filter chain: tokens pass through untouched
+                mask = np.zeros(n, bool)
+                mask[sel] = True
+                self.emit(time, b.select(mask))
+            else:
+                specs: list = []
+                used: list[int] = []
+                for item in env:
+                    if item[0] == "src":
+                        specs.append(("col", item[1]))
+                    else:
+                        specs.append(("val", len(used)))
+                        used.append(item[1])
+                n_sel = len(sel)
+                vals_i = np.zeros((max(len(used), 1), n_sel), np.int64)
+                vals_f = np.zeros((max(len(used), 1), n_sel), np.float64)
+                vtag = np.zeros((max(len(used), 1), n_sel), np.uint8)
+                for pos, s in enumerate(used):
+                    vals_i[pos], vals_f[pos], vtag[pos] = slots[s]
+                out_tok, status = dp_mod.build_rows(
+                    b.tab, b.token[sel], specs, vals_i, vals_f, vtag
+                )
+                ok = status == 0
+                if (~ok).any():
+                    fallback.extend(sel[~ok].tolist())
+                if ok.any():
+                    self.emit(
+                        time,
+                        dp_mod.NativeBatch(
+                            b.tab,
+                            np.ascontiguousarray(b.key_lo[sel][ok]),
+                            np.ascontiguousarray(b.key_hi[sel][ok]),
+                            np.ascontiguousarray(out_tok[ok]),
+                            np.ascontiguousarray(b.diff[sel][ok]),
+                            distinct_hint=b.distinct_hint,
+                        ),
+                    )
+        if fallback:
+            fallback.sort()
+            mask = np.zeros(n, bool)
+            mask[np.asarray(fallback, np.int64)] = True
+            self._stream_entries(time, b.select(mask).materialize())
+
+    # ---------------------------------------------------- stateful path
+
+    def _finish_stateful(self, time: int) -> None:
+        entries = self.take_input()
+        if not entries:
+            return
+        state: KeyedState = self._main_state_
+        affected: dict[Key, None] = {}
+        for key, _row, _diff in entries:
+            affected[key] = None
+        state.update(entries)
+        out: list[Entry] = []
+        for key in affected:
+            row0 = state.get(key)
+            new = self._run_row(key, row0) if row0 is not None else None
+            delta_emit(self.emitted, out, key, new)
+        self._emit_entries(time, out)
+
+    def finish_time(self, time: int) -> None:
+        if self._stateful:
+            self._finish_stateful(time)
+            return
+        if self._program is not None:
+            batches, entries = self.take_segments()
+            for b in batches:
+                self._run_batch(time, b)
+            if entries:
+                self._stream_entries(time, entries)
+            return
+        entries = self.take_input()
+        if entries:
+            self._stream_entries(time, entries)
+
+    # --------------------------------------------------- runtime fusion
+
+    @classmethod
+    def from_live_nodes(cls, graph: Graph, chain: list) -> "FusedRowwiseNode | None":
+        """Fuse a linear run of live stateless nodes (MapNode /
+        FilterNode / stateless FusedRowwiseNode) in the running graph —
+        the AdaptivePolicy's re-fusion action, applied at a drained
+        epoch fence. Returns None when the run doesn't compose (a member
+        with a native plan that the composed program can't absorb would
+        be a perf regression, stateful/rekey members change semantics)."""
+        stages: list = []
+        builder = _NativeProgramBuilder()
+        any_plan = False
+        native = True
+        for pos, node in enumerate(chain):
+            if isinstance(node, FusedRowwiseNode):
+                if node._stateful or node.rekey is not None:
+                    return None
+                stages.extend(node.stages)
+                if node._program is not None:
+                    any_plan = True
+                    if native:
+                        native = builder.adopt_rebased(node._program)
+                else:
+                    native = False
+            elif isinstance(node, MapNode):
+                stages.append(("map", node.fn))
+                if node._plan is not None:
+                    any_plan = True
+                    if native:
+                        native = builder.add_map(
+                            node._plan["specs"], node._plan["plans"]
+                        )
+                else:
+                    native = False
+            elif isinstance(node, FilterNode):
+                stages.append(("filter", node.predicate))
+                if node._plan is not None:
+                    any_plan = True
+                    if native:
+                        native = builder.add_filter(node._plan)
+                else:
+                    native = False
+            else:
+                return None
+        program = builder.build() if native and builder.stages else None
+        if any_plan and program is None:
+            return None  # would demote a vectorized run to per-row
+        head, tail = chain[0], chain[-1]
+        inp = head.inputs[0]
+        fused = cls(
+            graph, inp, stages, native_program=program,
+            detail="refused:" + "+".join(k for k, _ in stages),
+        )
+        fused.label = "fused"
+        fused.trace = head.trace
+        inp.downstream = [
+            (d, i) for (d, i) in inp.downstream if d is not head
+        ]
+        fused.downstream = list(tail.downstream)
+        for d, i in fused.downstream:
+            d.inputs[i] = fused
+        tail.downstream = []
+        for node in chain:
+            node._replaced = True
+        return fused
+
+
 def _emit_merged(node: Node, time: int, batches: list, entries: list[Entry]) -> None:
     """Shared wave emission for nodes that re-key or merge streams: keeps
     token-resident batches native when the whole wave is native, and
@@ -2157,7 +2581,7 @@ class JoinNode(Node):
         tok = np.array([tok_map[int(t)] for t in dump["tok"]], np.uint64)
         arr.update(jk, dump["klo"], dump["khi"], tok, dump["cnt"])
 
-    _ID_MODES = {"hash": 0, "left": 1, "right": 2}
+    _ID_MODES = {"hash": 0, "left": 1, "right": 2, "cheap": 3}
 
     def __init__(
         self,
@@ -2206,6 +2630,36 @@ class JoinNode(Node):
             self._dp = _dp
             self._tab = _dp.default_table()
             self._arrs = (_dp.NativeJoinArr(), _dp.NativeJoinArr())
+        self._sketch_cache = {
+            "left": {"distinct_jk": 0}, "right": {"distinct_jk": 0},
+        }
+        if id_mode == "cheap":
+            # bound once: a per-emitted-row import lookup would hand back
+            # a slice of the very nanoseconds id elision exists to save
+            from pathway_tpu.internals.keys import cheap_join_key
+
+            self._cheap_join_key = cheap_join_key
+
+    def sketch(self) -> dict:
+        """Incremental cardinality sketch of both arrangements (distinct
+        join keys held) — the planner's runtime signal for join
+        orientation costing (/statistics surfaces it per join node).
+        Served from a snapshot the PUMP thread refreshes after each
+        wave: the scrape thread must never walk the live C arrangement
+        (dj_len iterates a map a concurrent dj_update may rehash)."""
+        return self._sketch_cache
+
+    def _refresh_sketch(self) -> None:
+        if self._plan is not None:
+            self._sketch_cache = {
+                "left": {"distinct_jk": len(self._arrs[0])},
+                "right": {"distinct_jk": len(self._arrs[1])},
+            }
+        else:
+            self._sketch_cache = {
+                "left": {"distinct_jk": len(self.left_state.groups)},
+                "right": {"distinct_jk": len(self.right_state.groups)},
+            }
 
     def _jk_of(self, side: int, key: Key, row: tuple) -> Any:
         fn = self.left_jk if side == 0 else self.right_jk
@@ -2227,6 +2681,12 @@ class JoinNode(Node):
             key = lkey
         elif self.id_mode == "right" and rkey is not None:
             key = rkey
+        elif (
+            self.id_mode == "cheap" and lkey is not None and rkey is not None
+        ):
+            # plan-gated id elision (inner joins whose output ids are
+            # provably unobservable): SplitMix pair mix instead of blake
+            key = self._cheap_join_key(lkey, rkey)
         else:
             key = Key(hash_values(lkey, rkey))
         # output rows carry both side keys so pw.left.id / pw.right.id resolve
@@ -2265,10 +2725,13 @@ class JoinNode(Node):
                 parts.append((lo[:keep], hi[:keep], tok[:keep], diff[:keep]))
         if not parts:
             return None
-        lo = np.concatenate([p[0] for p in parts])
-        hi = np.concatenate([p[1] for p in parts])
-        tok = np.concatenate([p[2] for p in parts])
-        diff = np.concatenate([p[3] for p in parts])
+        if len(parts) == 1:
+            lo, hi, tok, diff = parts[0]  # no-copy fast path (common wave)
+        else:
+            lo = np.concatenate([p[0] for p in parts])
+            hi = np.concatenate([p[1] for p in parts])
+            tok = np.concatenate([p[2] for p in parts])
+            diff = np.concatenate([p[3] for p in parts])
         cols = self._plan["l_cols" if side == 0 else "r_cols"]
         # forbid_error: ERROR join keys drop, like the object plane's
         # _jk_of (rows with ERROR in PAYLOAD columns join normally)
@@ -2301,6 +2764,15 @@ class JoinNode(Node):
             return
         out_lo, out_hi, out_tok = res
         keep = diffs != 0
+        if keep.all():  # no zero-product matches: skip the subset copies
+            self.emit(
+                time,
+                self._dp.NativeBatch(
+                    self._tab, out_lo, out_hi, out_tok,
+                    np.ascontiguousarray(diffs),
+                ),
+            )
+            return
         self.emit(
             time,
             self._dp.NativeBatch(
@@ -2336,6 +2808,8 @@ class JoinNode(Node):
                 cnt * diff[idx],
             )
             r_arr.update(jk, lo, hi, tok, diff)
+        if lw is not None or rw is not None:
+            self._refresh_sketch()
 
     def finish_time(self, time: int) -> None:
         if self._plan is not None:
@@ -2384,6 +2858,7 @@ class JoinNode(Node):
                     if not rmatches and self.mode in ("left", "outer", "full"):
                         out.append(self._out_entry(lkey, lrow, None, None, dc))
             self.emit(time, consolidate(out))
+            self._refresh_sketch()
             return
         # dL ⋈ R_old
         for jk, dls in ldelta.items():
@@ -2435,6 +2910,7 @@ class JoinNode(Node):
                         for (rkey, rrow), c in rrows_now:
                             out.append(self._out_entry(None, None, rkey, rrow, c))
         self.emit(time, consolidate(out))
+        self._refresh_sketch()
 
 
 def _rollback(

@@ -379,6 +379,29 @@ class FrontierScheduler:
     def fully_drained(self) -> bool:
         return not any(self._pending.values()) and not self._async_waves
 
+    def replan_refresh(self) -> None:
+        """Refresh every topology-derived cache after the adaptive
+        planner rewired the live graph (internals/planner.py re-fusion
+        at a drained epoch fence): node list, reachability, per-slot
+        descendant cones, source cones, and the upstream summaries.
+        Caller must hold the fence (fully_drained() — no in-flight
+        notifications reference the old cones)."""
+        assert self.fully_drained(), "replan requires a drained scheduler"
+        self.nodes = list(self.graph.nodes)
+        self.reach = ReachabilityIndex(self.graph)
+        self._desc.clear()
+        for token, kind in self._kind.items():
+            node = self._node_of[token]
+            self._token_cone[token] = self.reach.cone(
+                node.node_id, include_self=kind != "remote"
+            )
+        self._upstream.clear()
+        for nid in range(len(self.nodes)):
+            self._upstream[nid] = set()
+        for tok, cone in self._token_cone.items():
+            for nid in cone:
+                self._upstream[nid].add(tok)
+
     def global_frontier(self) -> float:
         """Min over every source watermark and in-flight notification —
         the fully-retired time: state at or below it can never change

@@ -226,6 +226,7 @@ struct InternTable {
         }
     }
 
+
     // caller must hold mu
     uint64_t intern_locked(const char* data, int64_t len) {
         uint64_t hv = row_hash(data, static_cast<size_t>(len));
@@ -646,6 +647,39 @@ bool json_value_piece(JsonCursor& c, std::string& piece, uint8_t declared) {
 constexpr uint64_t SEQ_SALT_LO = 0xF39CC0605CEDC834ull;
 constexpr uint64_t SEQ_SALT_HI = 0x9E3779B97F4A7C15ull;
 
+// --------------------------------------------------------- cheap key mixes
+//
+// Plan-gated key elision (internals/planner.py): when the optimizer
+// proves a source's row identities are unobservable in any output, scans
+// may derive sequential keys with a SplitMix64-based 128-bit mix instead
+// of blake2b (measured 175 ns/key — about half the whole jsonl parse).
+// Same for join output ids (id_mode 3). The Python mirrors
+// (internals/keys.py cheap_sequential_key_at / cheap_join_key) must stay
+// bit-identical; tests pin the equality. Keys only need distinctness +
+// run-to-run determinism — never derivable content.
+
+inline uint64_t smix64(uint64_t z) {
+    z += 0x9E3779B97F4A7C15ull;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+}
+
+inline void cheap_seq_key(uint64_t base, uint64_t n, uint64_t* lo,
+                          uint64_t* hi) {
+    uint64_t x = smix64(base ^ SEQ_SALT_LO);
+    *lo = smix64(x ^ n);
+    *hi = smix64(*lo + n + SEQ_SALT_HI);
+    if (*lo == 0 && *hi == 0) *lo = 1;  // (0,0) is the ERROR sentinel
+}
+
+inline void cheap_join_key(uint64_t llo, uint64_t lhi, uint64_t rlo,
+                           uint64_t rhi, uint64_t* lo, uint64_t* hi) {
+    *lo = smix64(llo ^ smix64(rlo + SEQ_SALT_LO));
+    *hi = smix64(lhi ^ smix64(rhi + SEQ_SALT_HI) + *lo);
+    if (*lo == 0 && *hi == 0) *lo = 1;
+}
+
 // Pending rows of one ingest call: parsed row bytes are accumulated
 // lock-free; the intern table's mutex is taken ONCE at the end for the
 // whole batch (concurrent chunk parses then overlap almost fully — only
@@ -672,14 +706,18 @@ struct PendingRows {
 };
 
 // Key computation shared by json/csv ingest (no lock needed).
+// key_mode 1 = cheap sequential keys (plan-gated id elision; pk sources
+// always blake — their keys are content-derived and user-visible).
 inline void row_key(const std::string* pieces, const int64_t* pk_idx,
                     int64_t n_pk, uint64_t seq_base, uint64_t seq_no,
-                    uint64_t* out_lo, uint64_t* out_hi) {
+                    int64_t key_mode, uint64_t* out_lo, uint64_t* out_hi) {
     if (n_pk > 0) {
         std::string kb;
         for (int64_t j = 0; j < n_pk; ++j) kb += pieces[pk_idx[j]];
         blake2b_128(reinterpret_cast<const uint8_t*>(kb.data()), kb.size(),
                     out_lo, out_hi);
+    } else if (key_mode == 1) {
+        cheap_seq_key(seq_base, seq_no, out_lo, out_hi);
     } else {
         // sequential_key: blake2b(pack("<QQ", base, n) + SALT_16LE)
         uint8_t kb[32];
@@ -692,6 +730,18 @@ inline void row_key(const std::string* pieces, const int64_t* pk_idx,
 }
 
 }  // namespace
+
+// Cheap-key mixes exported for the Python-mirror equality tests
+// (internals/keys.cheap_sequential_key_at / cheap_join_key pin
+// bit-identity against these).
+void dp_cheap_seq_key(uint64_t base, uint64_t n, uint64_t* lo, uint64_t* hi) {
+    cheap_seq_key(base, n, lo, hi);
+}
+
+void dp_cheap_join_key(uint64_t llo, uint64_t lhi, uint64_t rlo, uint64_t rhi,
+                       uint64_t* lo, uint64_t* hi) {
+    cheap_join_key(llo, lhi, rlo, rhi, lo, hi);
+}
 
 // Parse a chunk of JSON-lines into interned rows.
 //
@@ -706,7 +756,7 @@ int64_t dp_ingest_jsonl(void* h, const char* data, int64_t len, int64_t n_cols,
                         const char** col_names, const int64_t* col_name_lens,
                         const uint8_t* col_tags, const int64_t* pk_idx,
                         int64_t n_pk, uint64_t seq_base, uint64_t seq_start,
-                        uint64_t* out_token, uint64_t* out_lo,
+                        int64_t key_mode, uint64_t* out_token, uint64_t* out_lo,
                         uint64_t* out_hi, uint8_t* out_status,
                         int64_t* line_start, int64_t* line_end, int64_t cap) {
     auto* tab = static_cast<InternTable*>(h);
@@ -798,7 +848,8 @@ int64_t dp_ingest_jsonl(void* h, const char* data, int64_t len, int64_t n_cols,
         }
         pend.add(row_bytes, i);
         row_key(pieces.data(), pk_idx, n_pk, seq_base,
-                seq_start + static_cast<uint64_t>(i), &out_lo[i], &out_hi[i]);
+                seq_start + static_cast<uint64_t>(i), key_mode, &out_lo[i],
+                &out_hi[i]);
         out_status[i] = 0;
     }
     pend.intern_all(tab, out_token);
@@ -815,8 +866,8 @@ int64_t dp_ingest_csv(void* h, const char* data, int64_t len, char delim,
                       int64_t n_cols, const int64_t* field_idx,
                       const uint8_t* dtypes, const uint8_t* opt,
                       const int64_t* pk_idx, int64_t n_pk, uint64_t seq_base,
-                      uint64_t seq_start, uint64_t* out_token, uint64_t* out_lo,
-                      uint64_t* out_hi, uint8_t* out_status,
+                      uint64_t seq_start, int64_t key_mode, uint64_t* out_token,
+                      uint64_t* out_lo, uint64_t* out_hi, uint8_t* out_status,
                       int64_t* line_start, int64_t* line_end, int64_t cap) {
     auto* tab = static_cast<InternTable*>(h);
     PendingRows pend;
@@ -973,7 +1024,8 @@ int64_t dp_ingest_csv(void* h, const char* data, int64_t len, char delim,
         for (int64_t j = 0; j < n_cols; ++j) row_bytes += pieces[j];
         pend.add(row_bytes, i);
         row_key(pieces.data(), pk_idx, n_pk, seq_base,
-                seq_start + static_cast<uint64_t>(i), &out_lo[i], &out_hi[i]);
+                seq_start + static_cast<uint64_t>(i), key_mode, &out_lo[i],
+                &out_hi[i]);
         out_status[i] = 0;
     }
     pend.intern_all(tab, out_token);
@@ -1624,9 +1676,72 @@ struct JRowHash {
     }
 };
 
+// One join-key group: rows in INSERTION order (deterministic probe
+// emission, unlike unordered_map bucket order) with tombstoning counts.
+// Small groups linear-scan; past GROUP_INDEX_MIN entries a flat
+// open-addressing index (vector-backed, no per-insert allocation — the
+// measured cost of the old nested unordered_map was its per-node
+// mallocs on the 1M-row static build) keeps find O(1). Tombstones
+// (cnt==0) stay until their whole group empties; heavy per-group churn
+// would scan them — acceptable for arrangement workloads, revisit with
+// compaction if a bench says otherwise.
+struct JGroup {
+    std::vector<JRow> rows;
+    std::vector<int64_t> cnt;
+    std::vector<uint32_t> slots;  // row idx + 1; 0 = empty
+    size_t mask = 0;              // 0 = unindexed (linear scan)
+    int64_t live = 0;
+
+    static constexpr size_t GROUP_INDEX_MIN = 16;
+
+    int64_t find(const JRow& r) const {
+        if (mask) {
+            size_t i = JRowHash{}(r) & mask;
+            while (slots[i]) {
+                uint32_t k = slots[i] - 1;
+                if (rows[k] == r && cnt[k] != 0)
+                    return static_cast<int64_t>(k);
+                i = (i + 1) & mask;
+            }
+            return -1;
+        }
+        for (size_t k = 0; k < rows.size(); ++k)
+            if (cnt[k] != 0 && rows[k] == r) return static_cast<int64_t>(k);
+        return -1;
+    }
+
+    void index_insert(uint32_t k) {
+        size_t i = JRowHash{}(rows[k]) & mask;
+        while (slots[i]) i = (i + 1) & mask;
+        slots[i] = k + 1;
+    }
+
+    void reindex(size_t want_slots) {
+        mask = want_slots - 1;
+        slots.assign(want_slots, 0);
+        for (size_t k = 0; k < rows.size(); ++k)
+            if (cnt[k] != 0) index_insert(static_cast<uint32_t>(k));
+    }
+
+    void add(const JRow& r, int64_t diff) {
+        rows.push_back(r);
+        cnt.push_back(diff);
+        ++live;
+        if (mask) {
+            if ((rows.size() + 1) * 2 >= mask + 1)
+                reindex(2 * (mask + 1));
+            else
+                index_insert(static_cast<uint32_t>(rows.size() - 1));
+        } else if (rows.size() >= GROUP_INDEX_MIN) {
+            size_t want = 2 * GROUP_INDEX_MIN;
+            while (want < rows.size() * 2) want *= 2;
+            reindex(want);
+        }
+    }
+};
+
 struct JoinArr {
-    std::unordered_map<uint64_t,
-                       std::unordered_map<JRow, int64_t, JRowHash>> groups;
+    std::unordered_map<uint64_t, JGroup> groups;
 };
 
 }  // namespace
@@ -1640,10 +1755,15 @@ void dj_update(void* h, int64_t n, const uint64_t* jk, const uint64_t* klo,
     for (int64_t i = 0; i < n; ++i) {
         auto& g = arr->groups[jk[i]];
         JRow r{klo[i], khi[i], tok[i]};
-        int64_t c = (g[r] += diff[i]);
-        if (c == 0) {
-            g.erase(r);
-            if (g.empty()) arr->groups.erase(jk[i]);
+        int64_t k = g.find(r);
+        if (k >= 0) {
+            g.cnt[k] += diff[i];
+            if (g.cnt[k] == 0) {
+                --g.live;
+                if (g.live == 0) arr->groups.erase(jk[i]);
+            }
+        } else {
+            g.add(r, diff[i]);
         }
     }
 }
@@ -1659,13 +1779,15 @@ int64_t dj_probe(void* other_h, int64_t n, const uint64_t* jk, int64_t cap,
     for (int64_t i = 0; i < n; ++i) {
         auto it = other->groups.find(jk[i]);
         if (it == other->groups.end()) continue;
-        for (const auto& kv : it->second) {
+        const JGroup& g = it->second;
+        for (size_t k = 0; k < g.rows.size(); ++k) {
+            if (g.cnt[k] == 0) continue;  // tombstone
             if (m < cap) {
                 out_idx[m] = i;
-                out_klo[m] = kv.first.lo;
-                out_khi[m] = kv.first.hi;
-                out_tok[m] = kv.first.tok;
-                out_cnt[m] = kv.second;
+                out_klo[m] = g.rows[k].lo;
+                out_khi[m] = g.rows[k].hi;
+                out_tok[m] = g.rows[k].tok;
+                out_cnt[m] = g.cnt[k];
             }
             ++m;
         }
@@ -1676,7 +1798,7 @@ int64_t dj_probe(void* other_h, int64_t n, const uint64_t* jk, int64_t cap,
 int64_t dj_len(void* h) {
     auto* arr = static_cast<JoinArr*>(h);
     int64_t n = 0;
-    for (const auto& g : arr->groups) n += static_cast<int64_t>(g.second.size());
+    for (const auto& g : arr->groups) n += g.second.live;
     return n;
 }
 
@@ -1686,12 +1808,14 @@ int64_t dj_export(void* h, uint64_t* jk, uint64_t* klo, uint64_t* khi,
     auto* arr = static_cast<JoinArr*>(h);
     int64_t m = 0;
     for (const auto& g : arr->groups) {
-        for (const auto& kv : g.second) {
+        const JGroup& gr = g.second;
+        for (size_t k = 0; k < gr.rows.size(); ++k) {
+            if (gr.cnt[k] == 0) continue;
             jk[m] = g.first;
-            klo[m] = kv.first.lo;
-            khi[m] = kv.first.hi;
-            tok[m] = kv.first.tok;
-            cnt[m] = kv.second;
+            klo[m] = gr.rows[k].lo;
+            khi[m] = gr.rows[k].hi;
+            tok[m] = gr.rows[k].tok;
+            cnt[m] = gr.cnt[k];
             ++m;
         }
     }
@@ -1757,6 +1881,11 @@ int64_t dp_join_rows(void* h, int64_t n, const uint64_t* l_lo,
     }
     std::vector<const char*> lst(l_cols.size()), len_(l_cols.size());
     std::vector<const char*> rst(r_cols.size()), ren(r_cols.size());
+    // probe-row memo: dj_probe emits matches contiguously per probe
+    // row, so one side's token repeats across its whole match run —
+    // re-splitting the same row bytes per match was measurable on the
+    // 1M-match bench wave (tokens start at 1; 0 = no memo yet)
+    uint64_t memo_l = 0, memo_r = 0;
     {
         std::shared_lock<std::shared_mutex> rg(tab->mu);
         for (int64_t i = 0; i < n; ++i) {
@@ -1774,16 +1903,20 @@ int64_t dp_join_rows(void* h, int64_t n, const uint64_t* l_lo,
                 row_bytes.append(lrow, static_cast<size_t>(llen));
                 row_bytes.append(rrow, static_cast<size_t>(rlen));
             } else {
-                if (!l_cols.empty() &&
-                    !find_cols(lrow, llen, l_cols.data(),
-                               static_cast<int64_t>(l_cols.size()),
-                               lst.data(), len_.data()))
-                    return -1 - i;
-                if (!r_cols.empty() &&
-                    !find_cols(rrow, rlen, r_cols.data(),
-                               static_cast<int64_t>(r_cols.size()),
-                               rst.data(), ren.data()))
-                    return -1 - i;
+                if (!l_cols.empty() && l_tok[i] != memo_l) {
+                    if (!find_cols(lrow, llen, l_cols.data(),
+                                   static_cast<int64_t>(l_cols.size()),
+                                   lst.data(), len_.data()))
+                        return -1 - i;
+                    memo_l = l_tok[i];
+                }
+                if (!r_cols.empty() && r_tok[i] != memo_r) {
+                    if (!find_cols(rrow, rlen, r_cols.data(),
+                                   static_cast<int64_t>(r_cols.size()),
+                                   rst.data(), ren.data()))
+                        return -1 - i;
+                    memo_r = r_tok[i];
+                }
                 for (size_t j = 0; j < sel_side.size(); ++j) {
                     switch (sel_side[j]) {
                         case 0: piece_key(row_bytes, l_lo[i], l_hi[i]); break;
@@ -1811,6 +1944,11 @@ int64_t dp_join_rows(void* h, int64_t n, const uint64_t* l_lo,
             } else if (id_mode == 2) {
                 out_lo[i] = r_lo[i];
                 out_hi[i] = r_hi[i];
+            } else if (id_mode == 3) {
+                // plan-gated cheap ids: join output identities proven
+                // unobservable, so skip the per-match blake2b
+                cheap_join_key(l_lo[i], l_hi[i], r_lo[i], r_hi[i],
+                               &out_lo[i], &out_hi[i]);
             } else {
                 keys_bytes.clear();
                 piece_key(keys_bytes, l_lo[i], l_hi[i]);

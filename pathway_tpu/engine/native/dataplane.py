@@ -98,14 +98,20 @@ def _load() -> ctypes.CDLL | None:
         lib.dp_ingest_jsonl.argtypes = [
             c.c_void_p, c.c_char_p, c.c_int64, c.c_int64,
             c.POINTER(c.c_char_p), i64p, u8p, i64p, c.c_int64,
-            c.c_uint64, c.c_uint64, u64p, u64p, u64p, u8p, i64p, i64p,
-            c.c_int64,
+            c.c_uint64, c.c_uint64, c.c_int64, u64p, u64p, u64p, u8p,
+            i64p, i64p, c.c_int64,
         ]
         lib.dp_ingest_csv.restype = c.c_int64
         lib.dp_ingest_csv.argtypes = [
             c.c_void_p, c.c_char_p, c.c_int64, c.c_char, c.c_int64,
             i64p, u8p, u8p, i64p, c.c_int64, c.c_uint64, c.c_uint64,
-            u64p, u64p, u64p, u8p, i64p, i64p, c.c_int64,
+            c.c_int64, u64p, u64p, u64p, u8p, i64p, i64p, c.c_int64,
+        ]
+        lib.dp_cheap_seq_key.argtypes = [
+            c.c_uint64, c.c_uint64, c_u64_p, c_u64_p,
+        ]
+        lib.dp_cheap_join_key.argtypes = [
+            c.c_uint64, c.c_uint64, c.c_uint64, c.c_uint64, c_u64_p, c_u64_p,
         ]
         lib.dp_decode_num_cols.restype = c.c_int64
         lib.dp_decode_num_cols.argtypes = [
@@ -617,11 +623,13 @@ def ingest_jsonl(
     seq_base: int,
     seq_start: int,
     col_tags: list[int] | None = None,
+    key_mode: int = 0,
 ):
     """Parse a jsonlines chunk. Returns (batch_arrays, statuses,
     line_offsets): tokens/keys are valid where status==0; status==1 lines
     need the Python fallback parser; 2 = blank. col_tags: declared dtype
-    tag per column (2=int 3=float, 0=any) for lossless literal coercion."""
+    tag per column (2=int 3=float, 0=any) for lossless literal coercion.
+    key_mode 1 = cheap sequential keys (plan-gated id elision)."""
     lib = _load()
     n_cols = len(col_names)
     name_bufs = [n.encode("utf-8") for n in col_names]
@@ -639,7 +647,7 @@ def ingest_jsonl(
     n = lib.dp_ingest_jsonl(
         tab._h, data, len(data), n_cols,
         ctypes.cast(name_arr, ctypes.POINTER(ctypes.c_char_p)), name_lens,
-        tags, pk, len(pk_idx), seq_base, seq_start,
+        tags, pk, len(pk_idx), seq_base, seq_start, key_mode,
         out_tok, out_lo, out_hi, status, ls, le, cap,
     )
     return (
@@ -659,6 +667,7 @@ def ingest_csv(
     seq_base: int,
     seq_start: int,
     delim: bytes = b",",
+    key_mode: int = 0,
 ):
     """Parse CSV records (header already consumed by the caller)."""
     lib = _load()
@@ -676,7 +685,7 @@ def ingest_csv(
         np.asarray(field_idx, np.int64),
         np.asarray(dtypes, np.uint8),
         np.asarray([1 if o else 0 for o in optional], np.uint8),
-        pk, len(pk_idx), seq_base, seq_start,
+        pk, len(pk_idx), seq_base, seq_start, key_mode,
         out_tok, out_lo, out_hi, status, ls, le, cap,
     )
     return (
@@ -684,6 +693,31 @@ def ingest_csv(
         status[:n],
         (ls[:n], le[:n]),
     )
+
+
+_M64 = (1 << 64) - 1
+
+
+def cheap_seq_key(base: int, n: int) -> int:
+    """The C cheap sequential key as a 128-bit int (mirror-equality
+    tests against internals.keys.cheap_sequential_key_at)."""
+    lib = _load()
+    lo = ctypes.c_uint64()
+    hi = ctypes.c_uint64()
+    lib.dp_cheap_seq_key(base, n, ctypes.byref(lo), ctypes.byref(hi))
+    return (hi.value << 64) | lo.value
+
+
+def cheap_join_key_c(lkey: int, rkey: int) -> int:
+    """The C cheap join id as a 128-bit int (mirror-equality tests)."""
+    lib = _load()
+    lo = ctypes.c_uint64()
+    hi = ctypes.c_uint64()
+    lib.dp_cheap_join_key(
+        lkey & _M64, lkey >> 64, rkey & _M64, rkey >> 64,
+        ctypes.byref(lo), ctypes.byref(hi),
+    )
+    return (hi.value << 64) | lo.value
 
 
 # ------------------------------------------------------------ node helpers

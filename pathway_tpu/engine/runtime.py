@@ -274,6 +274,20 @@ class Runtime:
         kicks = self._kick_sources(sched)
         closed: set = set()
         ckpt_dirty = False
+        # metrics-fed re-planning at safe epoch fences (fully-drained
+        # scheduler): needs the observability plane for its signal and
+        # the optimizer enabled (docs/planner.md)
+        policy = None
+        from pathway_tpu.internals import planner as _planner
+
+        if (
+            _obs.PLANE is not None
+            and _planner.fuse_enabled()
+            and _planner.adaptive_enabled()
+        ):
+            policy = _planner.AdaptivePolicy(
+                self.graph, getattr(self.graph, "plan_report", None)
+            )
         while True:
             plane = _obs.PLANE
             if plane is None:
@@ -338,6 +352,15 @@ class Runtime:
                         "checkpoint", _time.perf_counter() - t0
                     )
                 ckpt_dirty = False
+            # adaptive re-planning: only at a true epoch fence (nothing
+            # in flight, nothing deferred) so a rewired cone can never
+            # strand a staged wave on a replaced node
+            if (
+                policy is not None
+                and sched.fully_drained()
+                and not sched.has_async()
+            ):
+                policy.maybe_replan(sched)
             if len(closed) == len(self.connectors):
                 # final drain: anything staged between the last poll and
                 # the connector finishing

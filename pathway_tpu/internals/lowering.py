@@ -27,6 +27,7 @@ from pathway_tpu.internals.expression_compiler import (
     referenced_tables,
 )
 from pathway_tpu.internals.keys import Key, hash_values, key_for_values
+from pathway_tpu.internals import planner as _planner
 from pathway_tpu.internals.table import OpSpec, Table
 
 
@@ -131,6 +132,53 @@ class Session:
         # segments (native fs sources and the map/filter nodes downstream
         # of them) — drives MapNode/FilterNode plan selection
         self._native_specs: set[int] = set()
+        # ---- plan optimizer (internals/planner.py; PATHWAY_FUSE=0
+        # bypasses every pass and reproduces the unoptimized plans
+        # byte-identically). plan_ctx (consumer counts + id-observability
+        # over the reachable spec DAG) is attached by the session owner
+        # (run.py / debug / the iterate body builder) BEFORE lowering;
+        # without it the optimizer stays inert.
+        self.fuse = _planner.fuse_enabled()
+        self.plan_ctx = None
+        self.plan_report = _planner.new_report()
+        self.graph.plan_report = self.plan_report
+        self._fusing: set[int] = set()
+
+    def attach_plan_roots(
+        self, roots: list, sink_meta: list | None = None,
+        persistent: bool = False,
+    ) -> None:
+        """Build the optimizer's DAG-wide context from the tables this
+        session will lower (sinks/subscribes/captures). Analysis failure
+        downgrades to the unoptimized plans rather than erroring."""
+        if not self.fuse or not roots:
+            return
+        try:
+            self.plan_ctx = _planner.PlanContext(
+                roots, sink_meta=sink_meta, persistent=persistent
+            )
+        except Exception:  # noqa: BLE001 — optimizer must never break lowering
+            self.plan_ctx = None
+            self.plan_report["elision"]["veto"] = "plan analysis failed"
+            return
+        rep = self.plan_report["elision"]
+        rep["veto"] = self.plan_ctx.elision_veto_reason
+        if not self._elision_session_ok():
+            self.plan_ctx.cheap_key_sources.clear()
+            self.plan_ctx.cheap_id_joins.clear()
+            if rep["veto"] is None:
+                rep["veto"] = "multi-worker / mesh session"
+
+    def _elision_session_ok(self) -> bool:
+        """Cheap keys reshard rows under worker/process exchanges (the
+        route hash changes), which permutes shard-merged emission order —
+        id elision therefore stays single-worker, single-process."""
+        return (
+            self.plan_ctx is not None
+            and self.plan_ctx.elision_ok
+            and self.n_workers <= 1
+            and self.mesh is None
+        )
 
     def _next_wire_id(self) -> int:
         """Cross-process-stable, cross-session-unique exchange channel id:
@@ -207,7 +255,15 @@ class Session:
         if spec.id in self.cache:
             return self.cache[spec.id]
         n_before = len(self.graph.nodes)
-        node = self._build(table, spec)
+        node = None
+        if (
+            self.fuse
+            and self.plan_ctx is not None
+            and spec.id not in self._fusing
+        ):
+            node = self._try_fuse_chain(table, spec)
+        if node is None:
+            node = self._build(table, spec)
         # user-frame trace for runtime error messages (trace.py parity)
         trace = getattr(spec, "trace", None)
         if trace and node.trace is None:
@@ -378,25 +434,11 @@ class Session:
             seen.add(key.value)
         return True
 
-    def _try_native_map(
-        self, main: Table, exprs: dict, spec: OpSpec
-    ) -> eng.Node | None:
-        """Select on a native-plane table whose expressions are all plain
-        column projections or vectorizable numerics lowers to a stateless
-        MapNode: rows stay token-resident (keys pass through, new rows
-        build in C), with no sharded exchange at all. Returns None when
-        the shape doesn't qualify (general RowwiseNode path)."""
-        main_node = self.node_of(main)  # building it registers native-ness
-        if main._spec.id not in self._native_specs:
-            return None
-        expr_list = list(exprs.values())
-        side = [
-            t
-            for t in referenced_tables(expr_list)
-            if isinstance(t, Table) and t is not main
-        ]
-        if side or _collect_async(expr_list):
-            return None
+    def _native_map_specs(self, main: Table, exprs: dict) -> dict | None:
+        """MapNode-style vectorized plan for a select's expressions over
+        `main` (plain column picks, C-blakeable pointer_from, numpy-
+        compilable numerics). None = not fully plannable. Shared by the
+        single-node MapNode path and the optimizer's chain fusion."""
         from pathway_tpu.internals.expression_numpy import (
             KeyColsPlan,
             compile_numpy,
@@ -425,6 +467,30 @@ class Session:
             specs.append(("val", len(plans)))
             plans.append(plan)
             needed |= plan.needed_cols
+        return {"specs": specs, "plans": plans, "needed_cols": sorted(needed)}
+
+    def _try_native_map(
+        self, main: Table, exprs: dict, spec: OpSpec
+    ) -> eng.Node | None:
+        """Select on a native-plane table whose expressions are all plain
+        column projections or vectorizable numerics lowers to a stateless
+        MapNode: rows stay token-resident (keys pass through, new rows
+        build in C), with no sharded exchange at all. Returns None when
+        the shape doesn't qualify (general RowwiseNode path)."""
+        main_node = self.node_of(main)  # building it registers native-ness
+        if main._spec.id not in self._native_specs:
+            return None
+        expr_list = list(exprs.values())
+        side = [
+            t
+            for t in referenced_tables(expr_list)
+            if isinstance(t, Table) and t is not main
+        ]
+        if side or _collect_async(expr_list):
+            return None
+        native_plan = self._native_map_specs(main, exprs)
+        if native_plan is None:
+            return None
         resolver = Resolver([main])
         fns = [compile_expression(e, resolver) for e in exprs.values()]
         grf = self._guarded_row_fn(fns, getattr(spec, "trace", None))
@@ -432,14 +498,387 @@ class Session:
             self.graph,
             main_node,
             lambda key, row: grf(key, row),
-            native_plan={
-                "specs": specs,
-                "plans": plans,
-                "needed_cols": sorted(needed),
-            },
+            native_plan=native_plan,
         )
         self._native_specs.add(spec.id)
         return node
+
+    # ------------------------------------------------------ chain fusion
+    #
+    # Plan-optimizer pass (internals/planner.py, docs/planner.md): linear
+    # runs of rowwise operators collapse into one FusedRowwiseNode per
+    # maximal same-plane group. Intermediates must be provably single-
+    # consumer over the reachable spec DAG; object-plane chains need a
+    # single-worker, single-process session (sharded RowwiseNodes merge
+    # emissions shard-major, so unsharding them would permute bytes).
+
+    def _fusible_spec(self, spec: OpSpec) -> bool:
+        if spec.kind == "rowwise":
+            exprs = list(spec.params["exprs"].values())
+        elif spec.kind == "filter":
+            exprs = [spec.params["cond"]]
+        else:
+            return False
+        if _collect_async(exprs):
+            return False
+        main = spec.inputs[0]
+        return not any(
+            isinstance(t, Table) and t is not main
+            for t in referenced_tables(exprs)
+        )
+
+    def _rekey_fusible(self, spec: OpSpec) -> bool:
+        """Reindex terminates an object-plane fusion group (its rekey +
+        consolidate runs on the fused node's output entries). Pointer-
+        instance/native machinery keeps the standalone ReindexNode."""
+        return spec.kind == "reindex"
+
+    def _compile_fused_stage(self, t: Table, s: OpSpec):
+        """(kind, row_fn) object step for one chain member."""
+        main = s.inputs[0]
+        resolver = Resolver([main])
+        if s.kind == "rowwise":
+            exprs = s.params["exprs"]
+            fns = [compile_expression(e, resolver) for e in exprs.values()]
+            grf = self._guarded_row_fn(fns, getattr(s, "trace", None))
+            return ("map", lambda key, row: grf(key, row))
+        cf = compile_expression(s.params["cond"], resolver)
+        return ("filter", lambda key, row: cf(key, (row,)))
+
+    def _try_fuse_chain(self, table: Table, spec: OpSpec) -> eng.Node | None:
+        ctx = self.plan_ctx
+        head_rekey = self._rekey_fusible(spec)
+        if not head_rekey and not self._fusible_spec(spec):
+            return None
+        chain: list[tuple[Table, OpSpec]] = [(table, spec)]
+        while True:
+            t_in = chain[-1][1].inputs[0]
+            s_in = t_in._spec
+            if (
+                s_in.id in self.cache
+                or not self._fusible_spec(s_in)
+                or ctx.consumer_count(s_in) != 1
+            ):
+                break
+            chain.append((t_in, s_in))
+        if len(chain) < 2:
+            # a lone sargable filter directly above a native scan still
+            # pushes into the parse (no node saved, rows dropped at the
+            # source); anything else is not worth a fused node
+            src_spec = chain[-1][1].inputs[0]._spec
+            if not (
+                spec.kind == "filter"
+                and src_spec.params.get("scan_tuning") is not None
+                and (
+                    src_spec.kind == "static_native"
+                    or src_spec.params.get("native_plane")
+                )
+            ):
+                return None
+        chain.reverse()  # bottom-up; chain[-1] is the requested head
+        self._fusing.update(s.id for _t, s in chain)
+        try:
+            return self._build_fused(chain, head_rekey)
+        finally:
+            self._fusing.difference_update(s.id for _t, s in chain)
+
+    def _flush_fused_group(
+        self, group: list, builder, native: bool, rekey=None
+    ) -> eng.Node | None:
+        """Build one fusion group (>= 2 stages, or 1 stage + a rekey
+        terminator) on top of the already-built node of its input.
+        Cached under the group head's spec id UNLESS the group carries a
+        rekey (the node then embodies the reindex ABOVE the head spec —
+        node_of caches it under the reindex's own id). Returns None when
+        the group is too small to fuse."""
+        if not group or (len(group) < 2 and rekey is None):
+            return None
+        src_table = group[0][1].inputs[0]
+        src_node = self.cache[src_table._spec.id]
+        stages = [st for (_t, _s, st) in group]
+        head_s = group[-1][1]
+        stateful = (not native) and any(k == "map" for k, _f in stages)
+        if stateful and (self.n_workers > 1 or self.mesh is not None):
+            # unfused, these stages lower to SHARDED RowwiseNodes whose
+            # emissions merge shard-major — unsharding them would
+            # permute output bytes vs PATHWAY_FUSE=0. Native chains and
+            # pure-filter object chains were never sharded, so they
+            # fuse at any worker count.
+            return None
+        program = builder.build() if native and builder is not None else None
+        node = eng.FusedRowwiseNode(
+            self.graph,
+            src_node,
+            stages,
+            stateful=stateful,
+            native_program=program,
+            rekey=rekey,
+            detail="+".join(k for k, _f in stages)
+            + ("+reindex" if rekey else ""),
+        )
+        node.label = "fused"
+        node.trace = getattr(head_s, "trace", None)
+        if native:
+            for _t, s, _st in group:
+                self._native_specs.add(s.id)
+        if rekey is None:
+            self.cache[head_s.id] = node
+        self.plan_report["fusion_groups"].append({
+            "head": head_s.kind,
+            "stages": [k for k, _f in stages] + (["reindex"] if rekey else []),
+            "native": bool(program),
+            "nodes_saved": len(stages) - 1 + (1 if rekey else 0),
+            "trace": getattr(head_s, "trace", None),
+        })
+        return node
+
+    def _build_fused(
+        self, chain: list, head_rekey: bool
+    ) -> eng.Node | None:
+        from pathway_tpu.internals.expression_numpy import compile_numpy
+
+        src_table = chain[0][1].inputs[0]
+        src_spec = src_table._spec
+        # scan filter pushdown: a native scan feeding this chain alone
+        # can pre-filter at parse time — decide BEFORE building the
+        # source so the tuning reaches the parser (claiming resets any
+        # previous session's decisions first)
+        tuning = self._claim_scan_tuning(src_spec)
+        scan_native = src_spec.kind == "static_native" or (
+            src_spec.kind == "connector"
+            and src_spec.params.get("native_plane")
+        )
+        if (
+            tuning is not None
+            and scan_native
+            and self.plan_ctx.consumer_count(src_spec) == 1
+        ):
+            names = src_table._column_names()
+            for _t, s in chain:
+                if s.kind != "filter":
+                    break
+                plan = compile_numpy(s.params["cond"], names)
+                if plan is None:
+                    break
+                # advisory plans only: rows a plan can't judge stay in
+                # and the FilterNode above keeps the exact semantics
+                tuning.setdefault("filters", []).append(plan)
+                self.plan_report["pushdowns"].append({
+                    "kind": "scan-filter",
+                    "source": src_spec.params.get("name") or src_spec.kind,
+                    "trace": getattr(s, "trace", None),
+                })
+        src_node = self.node_of(src_table)
+        assert src_node is not None
+        cur_native = src_table._spec.id in self._native_specs
+        group: list = []  # (table, spec, (kind, fn))
+        builder = eng._NativeProgramBuilder() if cur_native else None
+        head_node: eng.Node | None = None
+
+        def lower_single(t: Table) -> None:
+            nonlocal cur_native
+            self.node_of(t)  # _fusing guard forces the normal path
+            cur_native = t._spec.id in self._native_specs
+
+        def flush(rekey=None) -> None:
+            nonlocal group, builder, cur_native, head_node
+            node = self._flush_fused_group(group, builder, cur_native, rekey)
+            if node is None:
+                for t, _s, _st in group:
+                    lower_single(t)
+            else:
+                cur_native = cur_native and node._program is not None
+            group = []
+            builder = eng._NativeProgramBuilder() if cur_native else None
+            head_node = node
+
+        for t, s in chain:
+            if head_rekey and s is chain[-1][1]:
+                # reindex head: terminates an OBJECT group; native plans
+                # keep the standalone ReindexNode's C rekey paths
+                if group and not cur_native:
+                    resolver = Resolver([s.inputs[0]])
+                    kf = compile_expression(s.params["key_expr"], resolver)
+
+                    def key_fn(key: Key, row: tuple) -> Key:
+                        v = kf(key, (row,))
+                        if not isinstance(v, Key):
+                            v = key_for_values(v)
+                        return v
+
+                    flush(rekey=key_fn)
+                    if head_node is not None:
+                        return head_node  # node_of caches it as `s`
+                flush()
+                lower_single(t)
+                return self.cache[s.id]
+            stage = self._compile_fused_stage(t, s)
+            if cur_native:
+                if builder is None:
+                    # a singly-lowered mid-chain stage flipped the plane
+                    # back to native (aligned-select marking): start a
+                    # fresh program over its output
+                    builder = eng._NativeProgramBuilder()
+                ok = False
+                if s.kind == "rowwise":
+                    plan = self._native_map_specs(
+                        s.inputs[0], s.params["exprs"]
+                    )
+                    if plan is not None:
+                        ok = builder.add_map(plan["specs"], plan["plans"])
+                else:
+                    cplan = compile_numpy(
+                        s.params["cond"], s.inputs[0]._column_names()
+                    )
+                    if cplan is not None:
+                        ok = builder.add_filter(cplan)
+                if not ok:
+                    # plane break: flush what we have, lower this stage
+                    # normally, and continue grouping on its output plane
+                    flush()
+                    lower_single(t)
+                    builder = (
+                        eng._NativeProgramBuilder() if cur_native else None
+                    )
+                    continue
+            group.append((t, s, stage))
+        flush()
+        if head_node is not None:
+            return head_node
+        return self.cache.get(chain[-1][1].id)
+
+    # -------------------------------------------------- pushdown helpers
+
+    def _claim_scan_tuning(self, spec: OpSpec) -> dict | None:
+        """The scan-tuning dict is shared by every session that lowers
+        this Table (it lives on the spec, and connector factories close
+        over it). The FIRST toucher in each session resets the previous
+        session's decisions — a pushed filter or cheap-key choice from
+        run 1 must never leak into run 2's plan (run 2 may not have the
+        filter above the scan at all, or may run with PATHWAY_FUSE=0)."""
+        tuning = spec.params.get("scan_tuning")
+        if tuning is None or tuning.get("pinned"):
+            return None
+        if tuning.get("session") != self._session_seq:
+            tuning["session"] = self._session_seq
+            tuning["key_mode"] = 0
+            tuning["filters"] = []
+        return tuning
+
+    def _apply_scan_tuning(self, spec: OpSpec) -> None:
+        """Decide the scan-level optimizations for a native source
+        (consumed by io/fs.py at parse time through the shared tuning
+        dict): cheap sequential keys when the plan proves this source's
+        row ids unobservable. Pushed filters were added by the fusion
+        pass before the source was built."""
+        tuning = self._claim_scan_tuning(spec)
+        if tuning is None or not self.fuse or self.plan_ctx is None:
+            return
+        if (
+            spec.id in self.plan_ctx.cheap_key_sources
+            and self._elision_session_ok()
+            and not tuning.get("key_mode")
+        ):
+            tuning["key_mode"] = 1
+            self.plan_report["pushdowns"].append({
+                "kind": "scan-key-elision",
+                "source": spec.params.get("name") or spec.kind,
+            })
+
+    def _try_filter_pushdown(
+        self, table: Table, spec: OpSpec
+    ) -> eng.Node | None:
+        """filter(join(L, R)) with a single-side sargable condition
+        lowers as join(filter(L), R): surviving rows keep their keys and
+        relative order (byte-identical), while dropped rows never enter
+        the join's arrangements or cross its exchange wire."""
+        if not self.fuse or self.plan_ctx is None:
+            return None
+        main = spec.inputs[0]
+        jspec = main._spec
+        if (
+            jspec.kind != "join"
+            or jspec.id in self.cache
+            or jspec.params["mode"] != "inner"
+            or jspec.params.get("asof_now")
+            or self.plan_ctx.consumer_count(jspec) != 1
+        ):
+            return None
+        cond = spec.params["cond"]
+        if _collect_async([cond]):
+            return None
+        if any(
+            isinstance(t, Table) and t is not main
+            for t in referenced_tables([cond])
+        ):
+            return None
+        out_exprs = jspec.params["exprs"]
+        left_t, right_t = jspec.inputs
+        refs: list[ex.ColumnReference] = []
+        seen: set[int] = set()
+
+        def collect(e) -> bool:
+            if id(e) in seen:
+                return True
+            seen.add(id(e))
+            if isinstance(e, ex.IdReference):
+                return False  # output ids are not pushable
+            if isinstance(e, ex.ColumnReference):
+                refs.append(e)
+                return True
+            return all(collect(s) for s in e._sub_expressions())
+
+        if not collect(cond) or not refs:
+            return None
+        side: int | None = None
+        mapping: dict[int, ex.ColumnExpression] = {}
+        for r in refs:
+            target = out_exprs.get(r.name)
+            if not isinstance(target, ex.ColumnReference) or isinstance(
+                target, ex.IdReference
+            ):
+                return None
+            ttab = target.table
+            if isinstance(ttab, ex.ThisMarker):
+                ttab = left_t if ttab._side in ("this", "left") else right_t
+            if ttab is left_t:
+                s = 0
+            elif ttab is right_t:
+                s = 1
+            else:
+                return None
+            if side is None:
+                side = s
+            elif side != s:
+                return None
+            mapping[id(r)] = target
+        if side is None:
+            return None
+        side_t = (left_t, right_t)[side]
+        new_cond = _clone_replace(cond, mapping)
+        side_node = self.node_of(side_t)
+        resolver = Resolver([side_t])
+        cf = compile_expression(new_cond, resolver)
+        native_plan = None
+        if side_t._spec.id in self._native_specs:
+            from pathway_tpu.internals.expression_numpy import compile_numpy
+
+            native_plan = compile_numpy(new_cond, side_t._column_names())
+        fnode = eng.FilterNode(
+            self.graph, side_node,
+            lambda key, row: cf(key, (row,)),
+            native_plan=native_plan,
+        )
+        fnode.label = "filter:pushdown"
+        fnode.trace = getattr(spec, "trace", None)
+        self.plan_report["pushdowns"].append({
+            "kind": "filter-through-join",
+            "side": "left" if side == 0 else "right",
+            "trace": getattr(spec, "trace", None),
+        })
+        return self._build_join(
+            main, jspec, side_nodes={side: fnode}
+        )
 
     def _build_async_node(self, main: Table, ae: ex.AsyncApplyExpression) -> eng.Node:
         resolver = Resolver([main])
@@ -497,8 +936,20 @@ class Session:
         if kind == "static_native":
             node = eng.InputNode(g)
             self._native_specs.add(spec.id)
+            self._apply_scan_tuning(spec)
             if self.mesh is not None and self.mesh.process_id != 0:
                 return node  # process 0 owns static rows (see "static")
+            parse = spec.params.get("parse")
+            if parse is not None:
+                # lazy static scan (io/fs.py): parse at lowering, once
+                # the optimizer's scan tuning (key mode, pushed filters)
+                # is decided — and only on the owning process
+                batches, seq_rows = parse()
+                for b in batches:
+                    self.static_batches.append((0, node, b))
+                if seq_rows:
+                    self.static_batches.append((0, node, list(seq_rows)))
+                return node
             for b in spec.params.get("batches", []):
                 self.static_batches.append((0, node, b))
             rows = spec.params.get("rows", [])
@@ -513,6 +964,7 @@ class Session:
             node = eng.InputNode(g)
             if spec.params.get("native_plane"):
                 self._native_specs.add(spec.id)
+                self._apply_scan_tuning(spec)
             ordinal = self._connector_seq
             self._connector_seq += 1
             if self.mesh is not None and ordinal % self.mesh.n != self.mesh.process_id:
@@ -537,6 +989,11 @@ class Session:
                 # NativeBatch waves: let the body's operators plan native
                 self._native_specs.add(spec.id)
             return node
+
+        if kind == "filter":
+            node = self._try_filter_pushdown(table, spec)
+            if node is not None:
+                return node
 
         if kind == "rowwise":
             exprs = spec.params["exprs"]
@@ -1139,11 +1596,56 @@ class Session:
 
     # ---------------------------------------------------------------- join
 
-    def _build_join(self, table: Table, spec: OpSpec) -> eng.Node:
+    def _build_join(
+        self, table: Table, spec: OpSpec, side_nodes: dict | None = None
+    ) -> eng.Node:
+        # ---- plan optimizer (internals/planner.py): sketch-costed
+        # orientation + id elision. The orientation swap is multiset-
+        # equivalent but permutes intra-wave emission order, so it only
+        # applies under the PATHWAY_JOIN_REORDER opt-in; the advice and
+        # its sketches are always recorded in the plan report.
+        ctx = self.plan_ctx
+        use_cheap_ids = False
+        if self.fuse and ctx is not None:
+            inner = (
+                spec.params["mode"] == "inner"
+                and not spec.params.get("asof_now", False)
+            )
+            elidable = spec.id in ctx.cheap_id_joins and inner and (
+                spec.params["id_mode"] == "hash"
+            )
+            if side_nodes is None:
+                l_sk = ctx.static_sketch(spec.inputs[0])
+                r_sk = ctx.static_sketch(spec.inputs[1])
+                advise_swap = (
+                    inner
+                    and elidable
+                    and l_sk["rows"] is not None
+                    and r_sk["rows"] is not None
+                    and l_sk["rows"] < r_sk["rows"]
+                )
+                applied = False
+                if advise_swap and _planner.join_reorder_enabled():
+                    _planner._swap_join_spec(spec)
+                    applied = True
+                self.plan_report["join_orders"].append({
+                    "join": spec.id,
+                    "left": l_sk,
+                    "right": r_sk,
+                    "advice": "swap" if advise_swap else "keep",
+                    "applied": applied,
+                    "trace": getattr(spec, "trace", None),
+                })
+            if elidable and self._elision_session_ok():
+                use_cheap_ids = True
+                self.plan_report["pushdowns"].append({
+                    "kind": "join-id-elision",
+                    "trace": getattr(spec, "trace", None),
+                })
         left_t, right_t = spec.inputs
         on = spec.params["on"]
         mode = spec.params["mode"]
-        id_mode = spec.params["id_mode"]
+        id_mode = "cheap" if use_cheap_ids else spec.params["id_mode"]
         out_exprs: dict[str, ex.ColumnExpression] = spec.params["exprs"]
 
         lres = Resolver([left_t])
@@ -1164,13 +1666,19 @@ class Session:
         # Token-resident inner join (dataplane dj_* arrangements): applies
         # when both sides are native-plane and every join key is a plain
         # stably-typed scalar column (same identity gate as groupby).
-        left_node = self.node_of(left_t)
-        right_node = self.node_of(right_t)
+        if side_nodes is not None and 0 in side_nodes:
+            left_node = side_nodes[0]  # filter-through-join pushdown
+        else:
+            left_node = self.node_of(left_t)
+        if side_nodes is not None and 1 in side_nodes:
+            right_node = side_nodes[1]
+        else:
+            right_node = self.node_of(right_t)
         native_plan = None
         if (
             mode == "inner"
             and not asof_now
-            and id_mode in ("hash", "left", "right")
+            and id_mode in ("hash", "left", "right", "cheap")
             and left_t._spec.id in self._native_specs
             and right_t._spec.id in self._native_specs
         ):
@@ -1305,6 +1813,12 @@ class Session:
         # inheriting the mesh would plant exchange barriers inside the
         # loop that the other processes never step — deadlock
         sub.mesh = None
+        # body chains fuse too (the scope's captures translate by key,
+        # so id elision self-vetoes via observes_ids=True)
+        sub.attach_plan_roots(
+            list(it_spec.results.values()),
+            sink_meta=[(t, True) for t in it_spec.results.values()],
+        )
         captures: dict[str, eng.CaptureNode] = {}
         for name, t in it_spec.results.items():
             captures[name] = eng.CaptureNode(
@@ -1370,6 +1884,17 @@ class Session:
         node.label = "output"
 
     def execute(self) -> None:
+        # finalize + publish the plan report (plan visibility: bench,
+        # /statistics and the profiler JSON read it off the graph)
+        rep = self.plan_report
+        rep["nodes_after"] = len(self.graph.nodes)
+        rep["nodes_before"] = rep["nodes_after"] + sum(
+            g["nodes_saved"] for g in rep["fusion_groups"]
+        )
+        if self.plan_ctx is not None:
+            rep["elision"]["sources"] = len(self.plan_ctx.cheap_key_sources)
+            rep["elision"]["joins"] = len(self.plan_ctx.cheap_id_joins)
+        _planner.publish_report(rep)
         runtime = Runtime(self.graph, autocommit_ms=self.autocommit_ms)
         runtime.monitors = list(self.monitors)
         runtime.checkpointer = getattr(self, "checkpointer", None)
@@ -1427,6 +1952,51 @@ def _collect_async(exprs: list) -> list[ex.AsyncApplyExpression]:
     for e in exprs:
         rec(e)
     return out
+
+
+def _clone_replace(
+    e: ex.ColumnExpression, mapping: dict[int, ex.ColumnExpression]
+) -> ex.ColumnExpression:
+    """Copy an expression tree, replacing the nodes in `mapping` (by
+    identity) with their targets. Unlike `_substitute` this never
+    mutates the original — the filter-through-join pushdown rewrites a
+    condition against the join output into one against a join input
+    while the original spec stays intact."""
+    import copy
+
+    if id(e) in mapping:
+        return mapping[id(e)]
+    c = copy.copy(e)
+    for name, val in list(vars(c).items()):
+        if isinstance(val, ex.ColumnExpression):
+            setattr(c, name, _clone_replace(val, mapping))
+        elif isinstance(val, tuple) and any(
+            isinstance(v, ex.ColumnExpression) for v in val
+        ):
+            setattr(
+                c,
+                name,
+                tuple(
+                    _clone_replace(v, mapping)
+                    if isinstance(v, ex.ColumnExpression)
+                    else v
+                    for v in val
+                ),
+            )
+        elif isinstance(val, dict) and any(
+            isinstance(v, ex.ColumnExpression) for v in val.values()
+        ):
+            setattr(
+                c,
+                name,
+                {
+                    k: _clone_replace(v, mapping)
+                    if isinstance(v, ex.ColumnExpression)
+                    else v
+                    for k, v in val.items()
+                },
+            )
+    return c
 
 
 def _substitute(

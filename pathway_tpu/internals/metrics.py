@@ -244,9 +244,25 @@ def render_statistics(session: Any, started_at: float) -> dict:
                 "rows_in": n.rows_in,
                 "rows_out": n.rows_out,
                 "latency_ms": round(n.time_ns / 1e6, 3),
+                **(
+                    {"replaced": True}
+                    if getattr(n, "_replaced", False)
+                    else {}
+                ),
+                **(
+                    {"sketch": n.sketch()}
+                    if hasattr(n, "sketch")
+                    else {}
+                ),
             }
             for n in graph.nodes
         ]
+        # plan visibility (docs/planner.md): the optimizer's decisions —
+        # fusion groups, pushdowns, join-order advice, adaptive replans —
+        # so a fused plan is debuggable instead of opaque
+        plan = getattr(graph, "plan_report", None)
+        if plan is not None:
+            stats["plan"] = plan
         stats["errors"] = len(getattr(graph.error_log, "entries", []))
         sched = getattr(graph, "scheduler", None)
         if sched is not None:

@@ -238,6 +238,25 @@ class MetricsRegistry:
         with self._lock:
             return self._counters.get(k, 0.0)
 
+    def histogram_stats(
+        self, name: str, labels: dict | None = None
+    ) -> tuple[int, float]:
+        """(observation count, value sum) of a histogram — one labeled
+        series, or aggregated across every series of `name` when labels
+        is None. The adaptive planner reads the per-operator wave-latency
+        histograms through this to find hot chains
+        (internals/planner.py AdaptivePolicy)."""
+        with self._lock:
+            if labels is not None:
+                h = self._histograms.get(self._key(name, labels))
+                return (h.count, h.sum) if h is not None else (0, 0.0)
+            count, total = 0, 0.0
+            for k, h in self._histograms.items():
+                if k[0] == name:
+                    count += h.count
+                    total += h.sum
+            return count, total
+
     def max_gauge(
         self,
         name: str,
@@ -440,6 +459,15 @@ class Profiler:
             "ingest_share": round(ingest_total / total, 4) if total > 0 else 0.0,
             "stages": stages,
             "operators": operators,
+            # plan visibility: the optimizer's decisions for this run
+            # (fusion groups, pushdowns, join-order advice, replans) —
+            # see docs/planner.md
+            **(
+                {"plan": graph.plan_report}
+                if graph is not None
+                and getattr(graph, "plan_report", None) is not None
+                else {}
+            ),
         }
 
 

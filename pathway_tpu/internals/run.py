@@ -87,6 +87,22 @@ def run(
         session.autocommit_ms = autocommit_duration_ms
     for hook in G.pre_run_hooks:
         hook()
+    # plan optimizer context: the whole sink set is registered before
+    # lowering starts, so the optimizer sees the full reachable spec DAG
+    # (consumer counts for fusion, id observability for key elision).
+    # subscribe callbacks receive row keys; output sinks declare whether
+    # they do (io/fs file writers don't).
+    session.attach_plan_roots(
+        [s.table for s in G.sinks],
+        sink_meta=[
+            (
+                s.table,
+                s.kind != "output" or s.params.get("observes_ids", True),
+            )
+            for s in G.sinks
+        ],
+        persistent=persistence_config is not None,
+    )
     for sink in G.sinks:
         if sink.kind == "subscribe":
             session.subscribe(

@@ -24,6 +24,7 @@ from pathway_tpu.internals.datasink import CallbackDataSink
 from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.keys import (
     Key,
+    cheap_sequential_key_at,
     key_for_values,
     reserve_sequential,
     sequential_key,
@@ -62,6 +63,12 @@ def _native_info(format: str, schema, csv_settings, with_metadata: bool):  # noq
         "pk_idx": [names.index(c) for c in pk],
         "pk": pk,
         "schema": schema,
+        # scan-tuning channel (internals/planner.py): the plan optimizer
+        # mutates this dict at lowering time — key_mode 1 = cheap
+        # sequential keys (id elision), filters = numpy cond plans pushed
+        # below the graph into the parse (advisory row reduction: rows a
+        # plan can't judge stay in, the real FilterNode above decides)
+        "tuning": {"key_mode": 0, "filters": []},
     }
     if format in ("json", "jsonlines"):
         info["kind"] = "json"
@@ -196,6 +203,31 @@ def _chunk_bodies(path: str, info: dict, start_pos: int = 0):
                 return
 
 
+def _scan_filter_batch(dp, tab, batch, plans):
+    """Pushed-down scan filters: advisory row reduction at the parse.
+    Rows a plan flags BAD (or whole batches the decode can't judge) are
+    KEPT — the FilterNode/FusedRowwiseNode above re-applies the exact
+    per-row semantics, so pushing filters never changes results or
+    error-log behavior, it only stops provably-dropped rows from ever
+    entering the dataflow."""
+    for plan in plans:
+        cols = sorted(plan.needed_cols)
+        if not cols:
+            continue
+        dec = dp.decode_num_cols(tab, batch.token, cols)
+        if dec is None:
+            return batch
+        vi, vf, tg = dec
+        decoded = {c: (vi[j], vf[j], tg[j]) for j, c in enumerate(cols)}
+        keep, bad = plan.eval_mask(decoded, len(batch))
+        mask = keep | bad
+        if not mask.all():
+            batch = batch.select(mask)
+        if not len(batch):
+            return batch
+    return batch
+
+
 def _parse_body(info: dict, tab, body: bytes, seq_start: int):
     """CPU part of one chunk (GIL-released C call). Returns
     (NativeBatch|None, fallback entries). A chunk containing ANY Python-
@@ -206,15 +238,18 @@ def _parse_body(info: dict, tab, body: bytes, seq_start: int):
 
     dp = info["dp"]
     pk_idx = info["pk_idx"]
+    tuning = info.get("tuning") or {}
+    key_mode = int(tuning.get("key_mode", 0))
     if info["kind"] == "csv":
         (lo, hi, tok), status, (ls, le) = dp.ingest_csv(
             tab, body, info["field_idx"], info["dtypes"],
             info["optional"], pk_idx, 0, seq_start, info["delim"],
+            key_mode=key_mode,
         )
     else:
         (lo, hi, tok), status, (ls, le) = dp.ingest_jsonl(
             tab, body, info["names"], pk_idx, 0, seq_start,
-            info.get("json_tags"),
+            info.get("json_tags"), key_mode=key_mode,
         )
     ok = status == 0
     if not (status == 1).any():
@@ -229,6 +264,10 @@ def _parse_body(info: dict, tab, body: bytes, seq_start: int):
                 # sequential keys are globally unique; pk keys can repeat
                 distinct_hint=not pk_idx,
             )
+            if tuning.get("filters"):
+                batch = _scan_filter_batch(dp, tab, batch, tuning["filters"])
+                if not len(batch):
+                    batch = None
         return batch, []
     entries = []
     for i in range(len(status)):
@@ -243,6 +282,9 @@ def _parse_body(info: dict, tab, body: bytes, seq_start: int):
             continue
         if pk_idx:
             key = key_for_values(*[row[j] for j in pk_idx])
+        elif key_mode == 1:
+            # the cheap-key mirror of the C parser's id-elided keys
+            key = cheap_sequential_key_at(seq_start + int(i))
         else:
             key = sequential_key_at(seq_start + int(i))
         entries.append((key, row))
@@ -635,17 +677,51 @@ def read(
         if native_info is not None and not pk:
             from pathway_tpu.engine.native import dataplane as dp
 
-            tab = dp.default_table()
-            batches: list = []
-            data: list = []
-            for f in _list_files(path):
-                _native_parse_file(
-                    f, native_info, tab,
-                    batches.append,
-                    lambda kr: data.append((0, kr[0], kr[1], 1)),
+            # LAZY static scan: the parse runs at lowering time, after
+            # the plan optimizer has decided this scan's tuning (cheap
+            # keys, pushed filters) — and only on the process that owns
+            # the rows. Cached per tuning state so a second pw.run over
+            # the same parse graph doesn't re-read the files.
+            tuning = native_info["tuning"]
+            cache: dict[tuple, tuple] = {}
+
+            def parse():
+                # plan objects key the cache by IDENTITY (and are kept
+                # alive by it): two sessions pushing different filters
+                # must never share a parse, while the common no-tuning
+                # rerun still hits
+                sig = (
+                    tuning.get("key_mode", 0),
+                    tuple(tuning.get("filters", ())),
                 )
-            spec = OpSpec("static_native", [], rows=data, batches=batches)
-            _obs.pretime("ingest", _time.perf_counter() - _ingest_t0)
+                hit = cache.get(sig)
+                if hit is not None:
+                    return hit
+                t0 = _time.perf_counter()
+                tab = dp.default_table()
+                batches: list = []
+                data: list = []
+                for f in _list_files(path):
+                    _native_parse_file(
+                        f, native_info, tab,
+                        batches.append,
+                        lambda kr: data.append((kr[0], kr[1], 1)),
+                    )
+                _obs.pretime("ingest", _time.perf_counter() - t0)
+                cache[sig] = (batches, data)
+                return cache[sig]
+
+            if kwargs.get("_eager_static"):
+                # parse NOW with default tuning and pin it (benchmarks
+                # that clock the engine after ingest; the optimizer must
+                # not re-tune an already-materialized scan — a tuning
+                # change would force a second parse)
+                tuning["pinned"] = True
+                parse()
+            spec = OpSpec(
+                "static_native", [], parse=parse,
+                scan_tuning=tuning, name=os.fspath(path),
+            )
             return Table(spec, schema, univ.Universe())
         rows = []
         for f in _list_files(path):
@@ -824,6 +900,10 @@ def read(
     spec = OpSpec(
         "connector", [], factory=factory, upsert=pk is not None, name=name,
         native_plane=native_info is not None and not pk,
+        scan_tuning=(
+            native_info["tuning"] if native_info is not None and not pk
+            else None
+        ),
     )
     return Table(spec, schema, univ.Universe())
 
@@ -903,4 +983,8 @@ def write(table: Table, filename: str | os.PathLike, *, format: str = "csv", **k
         flush=writer.flush,
         close=writer.close,
         write_native=writer.native_writer(),
+        # the file writer emits column values + time + diff, never row
+        # ids — lets the planner's id-elision analysis keep cheap keys
+        # for cones that end here (internals/planner.py)
+        observes_ids=False,
     )

@@ -91,6 +91,17 @@ class DeviceExchanger:
         self.rows_exchanged = 0
         self._auto_ok = auto_eligible_mesh(self.mesh)
         self._auto_min = auto_min_elems()  # parsed once, not per batch
+        # _auto_min_base anchors the adaptive planner's retuning: each
+        # run's policy restores it and bounds its doublings RELATIVE to
+        # it, so tuning can never ratchet across pw.run invocations of
+        # this process-wide exchanger (internals/planner.py).
+        self._auto_min_base = self._auto_min
+        # mode cached at construction too: try_exchange runs per batch
+        # and an env read per batch is measurable on the wave path.
+        # enabled() still reads the env per engine_exchanger() call, so
+        # flipping PATHWAY_DEVICE_EXCHANGE=0 between runs is honored;
+        # auto<->force flips refresh at the adaptive policy's fences.
+        self._mode = mode()
 
     # ------------------------------------------------------------ detection
 
@@ -128,7 +139,9 @@ class DeviceExchanger:
         shapes = [first_row[c].shape for c in vcols]
         dtypes = [first_row[c].dtype for c in vcols]
         n = len(entries)
-        if mode() == "auto":
+        if self._mode == "off":
+            return None
+        if self._mode == "auto":
             n_elems = n * sum(
                 int(np.prod(s)) for s in shapes
             )
@@ -159,6 +172,21 @@ class DeviceExchanger:
         )
         self.invocations += 1
         self.rows_exchanged += n
+        # wire-cost visibility for the adaptive planner (and /metrics):
+        # rows-per-invocation below threshold triggers an _auto_min
+        # retune at the next epoch fence
+        from pathway_tpu.internals import observability as _obs
+
+        if _obs.PLANE is not None:
+            m = _obs.PLANE.metrics
+            m.counter(
+                "pathway_device_exchange_invocations",
+                help="device-mesh batch exchanges dispatched",
+            )
+            m.counter(
+                "pathway_device_exchange_rows", inc=n,
+                help="rows moved over the device-mesh exchange",
+            )
         out: list[list] = [[] for _ in range(n_shards)]
         for d in range(n_shards):
             for vec_row, i in zip(pays[d], srcs[d]):

@@ -33,6 +33,12 @@ Leg 8 (ann): the indexing suites with the ANN kill switch thrown
 the exact slab search with byte-identical ranking semantics
 (docs/retrieval.md); the ANN-on side of the same suites already runs
 inside legs 1-2.
+Leg 9 (fusion-off): the engine suites with the plan optimizer killed
+(PATHWAY_FUSE=0) — chain fusion, pushdowns, id elision and the adaptive
+policy all bypassed; the unoptimized lowering must stay byte-identical
+to what it was before the optimizer existed (docs/planner.md). The
+optimizer-on side runs inside legs 1-2, and the per-pipeline fused-vs-
+unfused A/B comparisons live in tests/test_plan_optimizer.py.
 
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
@@ -186,6 +192,21 @@ def main() -> int:
                 "tests/test_indexing_relevance.py",
                 "tests/test_vector_store.py",
                 "tests/test_ml.py",
+            ],
+        ),
+        # plan optimizer killed: the unoptimized lowering is the
+        # byte-identity baseline every optimizer pass is pinned against
+        run_leg(
+            "fusion-off", {"PATHWAY_FUSE": "0"}, extra,
+            [
+                "tests/test_plan_optimizer.py",
+                "tests/test_common.py",
+                "tests/test_table_ops_matrix.py",
+                "tests/test_join_matrix.py",
+                "tests/test_io_formats.py",
+                "tests/test_filters.py",
+                "tests/test_expression_matrix.py",
+                "tests/test_native_plane.py",
             ],
         ),
     ]

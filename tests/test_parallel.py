@@ -162,15 +162,22 @@ def test_device_exchange_auto_mode_policy(monkeypatch):
     assert dx.mode() == "off" and not dx.enabled()
 
     # the virtual CPU mesh is never auto-eligible (measured always-lose:
-    # in-process routing passes references; the device hop only copies)
-    ex = dx.DeviceExchanger()
-    assert not ex._auto_ok
+    # in-process routing passes references; the device hop only copies).
+    # The mode is CACHED at construction (one env read per exchanger,
+    # not per batch) — build under auto, then prove a later env flip
+    # does not leak into the running exchanger.
     monkeypatch.delenv("PATHWAY_DEVICE_EXCHANGE", raising=False)
+    ex = dx.DeviceExchanger()
+    assert not ex._auto_ok and ex._mode == "auto"
     entries = [
         (key_for_values(i), (i, np.ones(1024, np.float32)), 1)
         for i in range(1024)
     ]
     assert ex.try_exchange(entries, lambda k, r: k.value % 2, 2) is None
+    monkeypatch.setenv("PATHWAY_DEVICE_EXCHANGE", "1")
+    assert ex._mode == "auto"  # construction-time cache, not per batch
+    assert ex.try_exchange(entries, lambda k, r: k.value % 2, 2) is None
+    monkeypatch.delenv("PATHWAY_DEVICE_EXCHANGE", raising=False)
     # an auto-eligible mesh above the crossover would engage: simulate
     # eligibility; 1024 rows x 1024 dims = 1M elems >= 262144
     ex._auto_ok = True
