@@ -35,6 +35,17 @@ Examples::
     PATHWAY_FAULTS="seed=3;io.retry.src~0.2"       # 20% flaky reads
     PATHWAY_FAULTS="persistence.metadata.torn@2"   # tear the 2nd commit
     PATHWAY_FAULTS="device.dispatch.*@1+"          # every dispatch fails
+    PATHWAY_FAULTS="sink.outbox.post_seal@3"       # die between the epoch
+                                                   # seal and the sink flush
+    PATHWAY_FAULTS="sink.flush.torn@5"             # die mid-flush, part of
+                                                   # a sealed range delivered
+
+The sink-side windows (``sink.outbox.pre_seal``, ``sink.outbox.post_seal``,
+``sink.flush.torn`` — probed in persistence/__init__.py and io/outbox.py)
+exercise the transactional-sink protocol: staged-but-unsealed output must
+be discarded and regenerated, sealed-but-unacked output must replay from
+the outbox WAL, and a torn flush must be absorbed by idempotent delivery
+(atomic fs segments / content-keyed dedup).
 
 Probabilistic decisions are a pure function of ``(seed, pattern, point,
 hit)``, so each point's fault sequence is fixed by the schedule alone —

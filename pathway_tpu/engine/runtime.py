@@ -1738,7 +1738,17 @@ class OutputNode(Node):
 
     The retry loop rides the unified ``pw.io.RetryPolicy`` (same default
     timings as the old hand-rolled loop: 5 attempts, 10 ms apart), which
-    makes every sink fault-injectable at ``io.retry.sink``."""
+    makes every sink fault-injectable at ``io.retry.sink``.
+
+    Exactly-once mode (persistence attached + PATHWAY_EXACTLY_ONCE!=0):
+    ``attach_outbox`` reroutes every wave into a per-sink transactional
+    outbox WAL (io/outbox.py) — writes happen at checkpoint fences,
+    after the epoch's metadata commit sealed them, and the writer close
+    waits for the final ack. ``write_keyed`` (optional) is the
+    idempotent delivery surface: like ``write_batch`` plus a per-record
+    content-key list for consumer-side dedup of replays; ``txn``
+    (optional) carries a sink's atomic-commit hooks (the fs writer's
+    offset-named temp+fsync+rename segments)."""
 
     RETRIES = 5
 
@@ -1751,6 +1761,8 @@ class OutputNode(Node):
         close: Callable[[], None] | None = None,
         write_native: Callable[[int, Any], None] | None = None,
         retry_policy: Any = None,
+        write_keyed: Callable[[int, list[Entry], list], None] | None = None,
+        txn: dict | None = None,
     ):
         super().__init__(graph, [inp])
         self.write_batch = write_batch
@@ -1760,6 +1772,9 @@ class OutputNode(Node):
         # formats whole batches in C (e.g. the csv writer); sinks without
         # it get materialized entries as before
         self.write_native = write_native
+        self.write_keyed = write_keyed
+        self.txn = txn
+        self._outbox: Any = None
         self._closed = False
         if retry_policy is None:
             # lazy import: pathway_tpu.io's package init imports modules
@@ -1790,7 +1805,22 @@ class OutputNode(Node):
                 f"{self.retry_policy.max_attempts} retries: {e}"
             )
 
+    def attach_outbox(self, outbox: Any) -> None:
+        """Switch to transactional staging: waves journal to the outbox
+        WAL; delivery happens at epoch fences (io/outbox.py)."""
+        self._outbox = outbox
+        if self.txn and self.txn.get("enable") is not None:
+            self.txn["enable"]()
+
     def finish_time(self, time: int) -> None:
+        if self._outbox is not None:
+            # exactly-once: stage in object form (the WAL's codec
+            # domain); the native formatting fast path is a direct-write
+            # optimization and does not apply to journaled delivery
+            entries = self.take_input()
+            if entries:
+                self._outbox.stage(time, consolidate(entries))
+            return
         if self.write_native is not None:
             batches, entries = self.take_segments()
             for b in batches:
@@ -1806,6 +1836,11 @@ class OutputNode(Node):
         self._write_retrying(self.write_batch, time, consolidate(entries))
 
     def on_end(self, time: int) -> None:
+        if self._outbox is not None:
+            # the final wave is staged but not yet sealed: the runtime's
+            # end-of-stream checkpoint delivers it, and the outbox closes
+            # the writer after that ack (CheckpointManager.close)
+            return
         if not self._closed and self.close is not None:
             self._closed = True
             self.close()

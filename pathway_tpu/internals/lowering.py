@@ -1876,10 +1876,12 @@ class Session:
     def output(
         self, table: Table, write_batch: Callable, flush=None, close=None,
         write_native: Callable | None = None,
+        write_keyed: Callable | None = None,
+        txn: dict | None = None,
     ) -> None:
         node = OutputNode(
             self.graph, self.node_of(table), write_batch, flush, close,
-            write_native=write_native,
+            write_native=write_native, write_keyed=write_keyed, txn=txn,
         )
         node.label = "output"
 

@@ -118,6 +118,11 @@ def run(
                 sink.params.get("flush"),
                 sink.params.get("close"),
                 write_native=sink.params.get("write_native"),
+                # transactional-sink surfaces (io/outbox.py): keyed
+                # idempotent writes + atomic epoch-commit hooks; dormant
+                # unless persistence + exactly-once arm the outbox
+                write_keyed=sink.params.get("write_keyed"),
+                txn=sink.params.get("exactly_once"),
             )
         else:
             raise ValueError(f"unknown sink kind {sink.kind}")

@@ -7,7 +7,7 @@ API surface and fail at use-time if their client library is missing
 descriptor/api layer is real).
 """
 
-from pathway_tpu.io import csv, fs, jsonlines, null, plaintext, python
+from pathway_tpu.io import csv, fs, jsonlines, null, outbox, plaintext, python
 from pathway_tpu.io._retry import CircuitOpen, RetryPolicy
 from pathway_tpu.io._subscribe import subscribe
 
@@ -36,8 +36,8 @@ from pathway_tpu.io import (  # noqa: E402
 )
 
 __all__ = [
-    "csv", "fs", "jsonlines", "null", "plaintext", "python", "subscribe",
-    "RetryPolicy", "CircuitOpen",
+    "csv", "fs", "jsonlines", "null", "outbox", "plaintext", "python",
+    "subscribe", "RetryPolicy", "CircuitOpen",
     "kafka", "redpanda", "s3", "s3_csv", "minio", "deltalake", "sqlite",
     "nats", "postgres", "elasticsearch", "mongodb", "debezium", "bigquery",
     "pubsub", "pyfilesystem", "logstash", "http", "gdrive", "slack", "airbyte",

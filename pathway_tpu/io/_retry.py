@@ -138,6 +138,13 @@ class RetryPolicy:
             self._last_error = None
         if closed and _obs.PLANE is not None:
             _obs.PLANE.record("breaker.close", policy=self.name)
+            # the recovery twin of pathway_breaker_opens_total: without
+            # it a breaker that re-closed after its half-open probe was
+            # invisible in the metrics registry
+            _obs.PLANE.metrics.counter(
+                "pathway_retry_breaker_closes_total", {"policy": self.name},
+                help="circuit-breaker close (recovery) transitions",
+            )
 
     def _record_failure(self, err: BaseException) -> None:
         if _obs.PLANE is not None:

@@ -909,12 +909,30 @@ def read(
 
 
 class _FileWriter:
+    """File sink with two durability modes.
+
+    * **direct** (default): rows append to the open file per wave —
+      fast, but a crash can leave a torn trailing line and a resumed
+      run re-delivers uncheckpointed waves (at-least-once).
+    * **atomic** (``enable_atomic``, armed by the exactly-once outbox,
+      io/outbox.py): waves buffer in memory; the outbox commits each
+      sealed range as an offset-named segment written temp + fsync +
+      rename (``{filename}.pw-{offset}.seg``), so a segment either
+      exists whole or not at all — torn sink lines are impossible, and
+      a replay of the same range rewrites the same segment
+      byte-identically (idempotent). ``close`` consolidates the
+      segments back into the single ``filename`` users asked for, via
+      the same temp + fsync + rename.
+    """
+
     def __init__(self, filename: str, format: str):
         self.filename = filename
         self.format = format
         self._file = None
         self._csv_writer = None
         self._names: list[str] | None = None
+        self._atomic = False
+        self._pending: list[str] = []
 
     def open(self, names: list[str]) -> None:
         self._names = names
@@ -923,18 +941,84 @@ class _FileWriter:
             self._csv_writer = _csv.writer(self._file)
             self._csv_writer.writerow(names + ["time", "diff"])
 
-    def write(self, time: int, entries: list) -> None:
-        assert self._file is not None
-        for _key, row, diff in entries:
-            if self.format == "csv":
-                self._csv_writer.writerow(list(row) + [time, diff])
-            elif self.format in ("json", "jsonlines"):
+    def _format(self, time: int, entries: list) -> str:
+        if self.format == "csv":
+            import io as _io
+
+            buf = _io.StringIO()
+            w = _csv.writer(buf)
+            for _key, row, diff in entries:
+                w.writerow(list(row) + [time, diff])
+            return buf.getvalue()
+        if self.format in ("json", "jsonlines"):
+            out = []
+            for _key, row, diff in entries:
                 rec = dict(zip(self._names, row))
                 rec["time"] = time
                 rec["diff"] = diff
-                self._file.write(Json.dumps(rec) + "\n")
-            else:  # plaintext
-                self._file.write(str(row[0]) + "\n")
+                out.append(Json.dumps(rec) + "\n")
+            return "".join(out)
+        return "".join(str(row[0]) + "\n" for _key, row, _diff in entries)
+
+    def write(self, time: int, entries: list) -> None:
+        if self._atomic:
+            self._pending.append(self._format(time, entries))
+            return
+        assert self._file is not None
+        self._file.write(self._format(time, entries))
+
+    # ------------------------------------------------ atomic epoch commits
+
+    def enable_atomic(self) -> None:
+        """Switch to segment-buffered transactional mode (called by the
+        outbox wiring before any wave flows)."""
+        self._atomic = True
+
+    def abort_pending(self) -> None:
+        """Drop uncommitted buffered output (a delivery that failed will
+        be replayed whole from the outbox WAL)."""
+        self._pending.clear()
+
+    def reset_segments(self) -> None:
+        """A fresh outbox (nothing ever sealed or acked) owns no
+        segments: drop orphans an unrelated previous run may have left
+        beside the output path, or close() would consolidate their
+        stale rows into this run's file."""
+        for seg in self._segment_paths():
+            try:
+                os.unlink(seg)
+            except OSError:
+                pass
+
+    def _segment_paths(self) -> list[str]:
+        pre = os.path.basename(self.filename) + ".pw-"
+        d = os.path.dirname(self.filename) or "."
+        out = []
+        for fn in os.listdir(d):
+            if fn.startswith(pre) and fn.endswith(".seg"):
+                out.append(os.path.join(d, fn))
+        return sorted(out)
+
+    def commit_segment(self, seq: int) -> None:
+        """Make the buffered range durable as ONE atomic segment named
+        by its outbox offset: write-temp + fsync + rename. A replayed
+        range re-commits the same name with the same bytes."""
+        data = "".join(self._pending).encode("utf-8")
+        self._pending.clear()
+        if not data:
+            return
+        seg = f"{self.filename}.pw-{seq:012d}.seg"
+        tmp = seg + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, seg)
+        dirfd = os.open(os.path.dirname(seg) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
 
     def native_writer(self):
         """write_native(time, NativeBatch) when this format has a C
@@ -962,6 +1046,8 @@ class _FileWriter:
         return write_native
 
     def flush(self) -> None:
+        if self._atomic:
+            return  # durability is per committed segment
         if self._file:
             self._file.flush()
 
@@ -969,6 +1055,33 @@ class _FileWriter:
         if self._file:
             self._file.close()
             self._file = None
+        if not self._atomic:
+            return
+        # consolidate segments into the single output file (temp +
+        # fsync + rename): the clean-finish contract stays "one file",
+        # while a crash mid-run leaves only whole segments behind
+        segs = self._segment_paths()
+        tmp = self.filename + ".pw-consolidate.tmp"
+        with open(tmp, "w", newline="") as f:
+            if self.format == "csv" and self._names is not None:
+                w = _csv.writer(f)
+                w.writerow(self._names + ["time", "diff"])
+            for seg in segs:
+                with open(seg, "r", newline="") as sf:
+                    f.write(sf.read())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.filename)
+        dirfd = os.open(os.path.dirname(self.filename) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+        for seg in segs:
+            try:
+                os.unlink(seg)
+            except OSError:
+                pass
 
 
 def write(table: Table, filename: str | os.PathLike, *, format: str = "csv", **kwargs: Any) -> None:  # noqa: A002
@@ -987,4 +1100,13 @@ def write(table: Table, filename: str | os.PathLike, *, format: str = "csv", **k
         # ids — lets the planner's id-elision analysis keep cheap keys
         # for cones that end here (internals/planner.py)
         observes_ids=False,
+        # transactional hooks (io/outbox.py): under exactly-once the
+        # outbox buffers waves and commits each sealed range as ONE
+        # offset-named atomic segment — replay-idempotent, no torn lines
+        exactly_once={
+            "enable": writer.enable_atomic,
+            "commit": writer.commit_segment,
+            "abort": writer.abort_pending,
+            "reset": writer.reset_segments,
+        },
     )

@@ -378,18 +378,27 @@ def write(
             breaker_threshold=None,
         )
 
-    def write_batch(time: int, entries: list) -> None:
-        for _key, row, diff in entries:
+    def _write(time: int, entries: list, ids: list | None = None) -> None:
+        for i, (_key, row, diff) in enumerate(entries):
             payload = dict(zip(names, row))
             payload["time"] = time
             payload["diff"] = diff
+            hdrs = headers
+            if ids is not None:
+                # exactly-once replay safety (io/outbox.py): a stable
+                # content key per request — receivers drop exact repeats
+                hdrs = {**(headers or {}), "X-Pathway-Msg-Id": str(ids[i])}
             retry_policy.call(
                 _requests.request,
                 method, url, json=_json.loads(Json.dumps(payload)),
-                headers=headers, timeout=30,
+                headers=hdrs, timeout=30,
             )
 
-    G.add_sink("output", table, write_batch=write_batch)
+    G.add_sink(
+        "output", table,
+        write_batch=lambda time, entries: _write(time, entries),
+        write_keyed=_write,
+    )
 
 
 def read(

@@ -229,13 +229,17 @@ def write(
             state["producer"] = ck.Producer(dict(rdkafka_settings))
         return state["producer"]
 
-    def write_batch(time: int, entries: list) -> None:
+    def _write(time: int, entries: list, ids: list | None = None) -> None:
         producer = _producer()
-        for _k, row, diff in entries:
+        for i, (_k, row, diff) in enumerate(entries):
             hdrs = [
                 ("pathway_time", str(time).encode()),
                 ("pathway_diff", str(diff).encode()),
             ] + [(c, str(row[names.index(c)]).encode()) for c in header_cols]
+            if ids is not None:
+                # exactly-once replay safety (io/outbox.py): a stable
+                # content key per record — consumers drop exact repeats
+                hdrs.append(("pathway_msg_id", str(ids[i]).encode()))
             if format == "json":
                 payload = Json.dumps(dict(zip(names, row))).encode()
             elif format == "dsv":
@@ -254,11 +258,35 @@ def write(
             producer.produce(topic_name, payload, key=kbytes, headers=hdrs)
         producer.flush(10)
 
+    def drain() -> None:
+        # produce() only queues locally; the outbox must not ack a
+        # sealed range until the broker actually holds it. flush()
+        # returning a nonzero remainder means messages are still
+        # queued — raising keeps the range sealed for the next fence
+        # instead of silently downgrading exactly-once to at-most-once.
+        # Outbox-only on purpose: in the direct per-wave path a raise
+        # here would make the retry loop re-produce the whole batch
+        # (duplicates with no crash), so the pre-outbox contract there
+        # stays "queue locally, drain on close"
+        if state["producer"] is not None:
+            remaining = state["producer"].flush(10)
+            if remaining:
+                raise ConnectionError(
+                    f"kafka producer still holds {remaining} "
+                    "undelivered message(s) after flush timeout"
+                )
+
     def close() -> None:
         if state["producer"] is not None:
             state["producer"].flush(10)
 
-    G.add_sink("output", table, write_batch=write_batch, close=close)
+    G.add_sink(
+        "output", table,
+        write_batch=lambda time, entries: _write(time, entries),
+        write_keyed=_write,
+        close=close,
+        exactly_once={"flush": drain},
+    )
 
 
 __all__ = ["read", "simple_read", "write"]

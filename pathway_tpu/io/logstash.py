@@ -24,7 +24,13 @@ def write(
 
     ``retry_policy`` takes a :class:`pw.io.RetryPolicy` governing the
     per-request retries (backoff, jitter, circuit breaker); when omitted,
-    ``n_retries`` builds the legacy fixed-spacing policy."""
+    ``n_retries`` builds the legacy fixed-spacing policy.
+
+    Under exactly-once mode (persistence + the transactional outbox,
+    io/outbox.py) deliveries ride the HTTP writer's keyed path: every
+    record carries a stable ``X-Pathway-Msg-Id`` content key, so a
+    replay after a crash re-sends the same ids and the Logstash
+    pipeline can drop exact duplicates (docs/robustness.md)."""
     from pathway_tpu.io.http import write as http_write
 
     http_write(

@@ -319,11 +319,15 @@ def write(
             state["conn"] = NatsConnection(uri, name="pathway-writer")
         return state["conn"]
 
-    def write_batch(time: int, entries: list) -> None:
+    def _write(time: int, entries: list, ids: list | None = None) -> None:
         conn = _conn()
         try:
-            for _key, row, diff in entries:
+            for i, (_key, row, diff) in enumerate(entries):
                 hdr = {"pathway_time": str(time), "pathway_diff": str(diff)}
+                if ids is not None:
+                    # exactly-once replay safety (io/outbox.py): stable
+                    # per-record content key for consumer-side dedup
+                    hdr["pathway_msg_id"] = str(ids[i])
                 for col in header_cols:
                     hdr[col] = str(row[names.index(col)])
                 if format == "json":
@@ -346,7 +350,12 @@ def write(
         if state["conn"] is not None:
             state["conn"].close()
 
-    G.add_sink("output", table, write_batch=write_batch, close=close)
+    G.add_sink(
+        "output", table,
+        write_batch=lambda time, entries: _write(time, entries),
+        write_keyed=_write,
+        close=close,
+    )
 
 
 __all__ = ["read", "write", "NatsConnection", "NatsError"]

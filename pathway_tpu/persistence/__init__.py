@@ -438,6 +438,39 @@ class SegmentedJournal:
             os.path.join(self.root, f"{_safe(name)}.{start}.seg"), start
         )
 
+    def truncate_to(self, name: str, offset: int) -> None:
+        """Remove journaled events at or past `offset`: whole segments
+        unlink, the covering segment rewrites in place (atomic) keeping
+        its prefix byte-exactly. The outbox uses this to discard a
+        staged-but-unsealed WAL tail on recovery (io/outbox.py)."""
+        for start, path in self._segments(name):
+            if start >= offset:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            with open(path, "rb") as f:
+                buf = f.read()
+            recs = list(codec.read_records(buf, with_magic=True))
+            if start + len(recs) <= offset:
+                continue
+            keep = recs[: offset - start]
+            blob = codec.MAGIC + b"".join(
+                codec.encode_record(r) for r in keep
+            )
+            _fsync_write(path, blob)
+
+    def size_bytes(self, name: str) -> int:
+        """On-disk bytes held by this connector's surviving segments."""
+        total = 0
+        for _start, path in self._segments(name):
+            try:
+                total += os.path.getsize(path)
+            except OSError:
+                pass
+        return total
+
     def compact(self, name: str, committed: int) -> int:
         """Delete segments whose every event is < committed (covered by a
         durable operator snapshot). Returns number of segments removed."""
@@ -557,6 +590,7 @@ class MetadataStore:
         prev: "dict | None | object" = _UNSET,
         frontiers: dict | None = None,
         op_snapshots: list[str] | None = None,
+        outbox: dict[str, int] | None = None,
     ) -> None:
         record = {
             "epoch": epoch,
@@ -569,6 +603,12 @@ class MetadataStore:
             "frontiers": frontiers or {},
             "committed_at": _time.time(),
         }
+        if outbox is not None:
+            # per-sink SEALED outbox offsets: this commit is the
+            # transactional-sink linearization point — staged output at
+            # or below these offsets WILL be delivered exactly once
+            # (io/outbox.py), anything past them is discarded on restart
+            record["outbox"] = outbox
         if op_snapshots is not None:
             # manifest of operator snapshots this epoch WROTE: restore
             # distinguishes "stateless node" (absent here) from "snapshot
@@ -586,7 +626,7 @@ class MetadataStore:
             record["history"] = [
                 {k: prev[k] for k in
                  ("epoch", "offsets", "signature", "finalized_time",
-                  "frontiers", "op_snapshots")
+                  "frontiers", "op_snapshots", "outbox")
                  if k in prev}
             ]
         blob = _json.dumps(record).encode()
@@ -717,6 +757,11 @@ class CheckpointManager:
         # sources seek here instead of journal replay)
         self.restored_frontiers: dict[str, dict] = {}
         self.restored = False
+        # transactional sinks (io/outbox.py): set by attach_persistence
+        # when exactly-once mode is on; sealed offsets of the restored
+        # epoch drive the replay negotiation
+        self.outboxes: Any = None
+        self.restored_outbox: dict[str, int] = {}
 
     # ------------------------------------------------------------ restore
 
@@ -818,6 +863,9 @@ class CheckpointManager:
                 self.restored = True
                 self._restored_offsets = offs
                 self.restored_frontiers = dict(rec.get("frontiers", {}))
+                self.restored_outbox = {
+                    k: int(v) for k, v in rec.get("outbox", {}).items()
+                }
                 if epoch is not None or i > 0:
                     # rollback OR history fallback: rewrite the on-disk
                     # record to the epoch actually restored NOW, else the
@@ -832,6 +880,7 @@ class CheckpointManager:
                         prev=None,
                         frontiers=self.restored_frontiers,
                         op_snapshots=rec.get("op_snapshots"),
+                        outbox=rec.get("outbox"),
                     )
                 return offs
         # fall back to full journal replay — only sound if the head exists
@@ -930,6 +979,16 @@ class CheckpointManager:
             if fr is not None:
                 frontiers[c.name] = dict(fr)
         self._committed_frontiers = frontiers
+        # 1b. transactional sinks: fsync the staged outbox WAL and take
+        # the per-sink sealed offsets the metadata commit will pin
+        outbox_offsets = None
+        if self.outboxes is not None:
+            outbox_offsets = self.outboxes.seal_all()
+            # crash window: output staged + durable but NOT sealed — the
+            # committed metadata still points at the previous offsets, so
+            # recovery discards this tail and the replayed inputs
+            # regenerate it (their offsets were not committed either)
+            faults.crash("sink.outbox.pre_seal")
         # 2. operator snapshots for the next epoch
         epoch = self.epoch + 1
         wrote_ops = False
@@ -955,11 +1014,19 @@ class CheckpointManager:
         self.metadata.commit(
             epoch, offsets, self.signature, finalized_time, prev=prev_record,
             frontiers=frontiers, op_snapshots=sorted(op_manifest),
+            outbox=outbox_offsets,
         )
         self.epoch = epoch
         # crash window B: committed but not compacted — recovery resumes
         # from THIS epoch; stale epoch-(N-1) artifacts are inert
         faults.crash("persistence.checkpoint.post_commit")
+        # 3b. the epoch's sink output is now SEALED: flush it through the
+        # writers, ack, and GC fully-acked outbox segments (io/outbox.py)
+        if self.outboxes is not None:
+            # crash window: sealed but nothing delivered — restart
+            # replays the whole sealed-unacked range from the outbox
+            faults.crash("sink.outbox.post_seal")
+            self.outboxes.deliver_all(epoch)
         # 4. compaction — keep TWO epochs of snapshots and the journal
         # back to the previous epoch's offsets, so multi-process recovery
         # can roll back one epoch when peers crashed between commits
@@ -985,6 +1052,10 @@ class CheckpointManager:
     def close(self) -> None:
         for w in self._writers.values():
             w.close()
+        if self.outboxes is not None:
+            # writers close only now, after the final checkpoint's
+            # delivery + ack (OutputNode.on_end defers to the outbox)
+            self.outboxes.close()
 
 
 def attach_persistence(session: Any, config: Config) -> None:
@@ -1166,6 +1237,30 @@ def attach_persistence(session: Any, config: Config) -> None:
         PersistentConnector(c, c.name) for c in session.connectors
     ]
     session.checkpointer = manager
+    # end-to-end exactly-once: thread every output sink through the
+    # transactional outbox (io/outbox.py) — stage to a WAL, seal at the
+    # metadata commit, deliver + ack after it, replay on restart.
+    # PATHWAY_EXACTLY_ONCE=0 keeps the direct per-wave writes (today's
+    # at-least-once) byte-identically; static pipelines (no streaming
+    # connectors) never cut checkpoints, so they also write directly.
+    from pathway_tpu.io.outbox import exactly_once_enabled
+
+    if session.connectors and exactly_once_enabled():
+        from pathway_tpu.engine.runtime import OutputNode
+        from pathway_tpu.io.outbox import OutboxManager
+
+        out_nodes = [
+            n for n in session.graph.nodes if isinstance(n, OutputNode)
+        ]
+        if out_nodes:
+            obm = OutboxManager(manager.journal.root)
+            for i, node in enumerate(out_nodes):
+                obm.register(f"sink{i:02d}", node)
+            manager.outboxes = obm
+            # replay negotiation: discard the unsealed WAL tail, then
+            # re-deliver anything sealed by the restored epoch but not
+            # yet acked by a writer flush
+            obm.recover(manager.restored_outbox, manager.epoch)
     if fresh_start:
         # bootstrap commit: a fresh run records epoch 1 (empty operator
         # state, zero offsets) BEFORE any event flows, so a crash at any

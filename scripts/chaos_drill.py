@@ -3,25 +3,37 @@
 
 For a matrix of seeded fault schedules × fault kinds, this harness runs
 the SAME streaming pipeline (a journaled python source → groupby counts →
-a batched device-plane UDF → subscribe sink) three ways:
+a batched device-plane UDF → THREE real sinks: an atomic fs/jsonlines
+file, a kafka producer against a mock broker, and an http writer against
+a mock endpoint) three ways:
 
   1. fault-free baseline (``PATHWAY_FAULTS=0``),
   2. with an injected fault — crash mid-wave, torn metadata commit,
      truncated journal segment, lost operator snapshot, flapping
-     connector reads, failing device dispatches,
+     connector reads, failing device dispatches, and the sink-side
+     crash windows of the transactional outbox (pre-seal, post-seal,
+     torn mid-flush — io/outbox.py),
   3. (for crash kinds) a recovery generation that resumes from the same
      persistence directory.
 
-and asserts the **consolidated final output table is byte-identical** to
-the baseline's — the persistence layer's exactly-once contract, the
-connector retry policy, and the device plane's degradation ladder, all
-proven against deterministic failures (engine/faults.py).
+and asserts the **delivered sink output** — post-replay, post-dedup,
+consolidated to the final table — is **byte-identical** to the
+baseline's, per sink. This is the end-to-end exactly-once contract: not
+just engine state, but what actually reached the fs file / broker /
+endpoint.
+
+With ``PATHWAY_EXACTLY_ONCE=0`` the drill reproduces the pre-outbox
+at-least-once behavior: sink kinds are skipped (their injection points
+never probe), the queue/http sinks must still consolidate to the
+baseline (duplicates absorbed), and the fs file — truncated per
+generation by the direct writer — is excluded from comparison, which is
+exactly the gap the outbox exists to close.
 
 Usage::
 
-    python scripts/chaos_drill.py --quick          # 4 kinds x 1 seed (CI leg)
-    python scripts/chaos_drill.py                  # 6 kinds x 3 seeds
-    python scripts/chaos_drill.py --kinds torn_metadata --seeds 0,1,2
+    python scripts/chaos_drill.py --quick          # 5 kinds x 1 seed (CI leg)
+    python scripts/chaos_drill.py                  # 9 kinds x 3 seeds
+    python scripts/chaos_drill.py --kinds sink_torn_flush --seeds 0,1,2
     python scripts/chaos_drill.py --json /tmp/chaos.json
 """
 
@@ -45,14 +57,56 @@ CRASH_EXIT = 17  # engine/faults.py CRASH_EXIT_CODE
 # One pipeline exercising every failure domain: a paced seekable source
 # whose reads go through pw.io.RetryPolicy (connector domain), journaled
 # persistence with operator snapshots (persistence domain), a groupby
-# (operator state), and a batched UDF dispatching through a DevicePlane
-# program (device domain). Deliveries append to a jsonl the harness
-# consolidates across crash generations.
+# (operator state), a batched UDF dispatching through a DevicePlane
+# program (device domain), and three REAL sink code paths (sink domain):
+# pw.io.jsonlines (atomic segments under exactly-once), pw.io.kafka
+# against an injected mock confluent_kafka producer, and pw.io.http
+# against a mocked requests.request. The mock targets append-log every
+# delivery, so the harness can consolidate exactly what was delivered —
+# across crash generations, after outbox replays, with dedup by the
+# content-key headers.
 
 WORKLOAD = textwrap.dedent(
     """
-    import json, os, sys
+    import json, os, sys, types
     sys.path.insert(0, {repo!r})
+
+    PDIR, OUTDIR, N_EVENTS = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    os.makedirs(OUTDIR, exist_ok=True)
+
+    # ---- mock broker: a confluent_kafka stand-in that append-logs every
+    # produced message (payload + headers) — drives the REAL
+    # pw.io.kafka.write code path, incl. the pathway_msg_id content keys
+    fake_ck = types.ModuleType("confluent_kafka")
+    class _Producer:
+        def __init__(self, settings):
+            self._f = open(os.path.join(OUTDIR, "kafka.jsonl"), "a")
+        def produce(self, topic, payload, key=None, headers=None):
+            self._f.write(json.dumps({{
+                "topic": topic,
+                "payload": payload.decode("utf-8"),
+                "headers": {{k: v.decode("utf-8") for k, v in (headers or [])}},
+            }}) + "\\n")
+            self._f.flush()
+        def flush(self, timeout=None):
+            self._f.flush(); os.fsync(self._f.fileno())
+    fake_ck.Producer = _Producer
+    sys.modules["confluent_kafka"] = fake_ck
+
+    # ---- mock endpoint: requests.request append-logs every delivery
+    try:
+        import requests as _rq
+    except Exception:
+        _rq = types.ModuleType("requests")
+        sys.modules["requests"] = _rq
+    def _fake_request(method, url, json=None, headers=None, timeout=None):
+        with open(os.path.join(OUTDIR, "http.jsonl"), "a") as f:
+            f.write(__import__("json").dumps(
+                {{"url": url, "body": json, "headers": dict(headers or {{}})}}
+            ) + "\\n")
+            f.flush(); os.fsync(f.fileno())
+    _rq.request = _fake_request
+
     import numpy as np
     import pathway_tpu as pw
     from pathway_tpu.engine.device_plane import DeviceProgram, get_device_plane
@@ -60,7 +114,6 @@ WORKLOAD = textwrap.dedent(
     from pathway_tpu.io import RetryPolicy
     from pathway_tpu.io.python import ConnectorSubject
 
-    PDIR, OUT, N_EVENTS = sys.argv[1], sys.argv[2], int(sys.argv[3])
     SPEC = os.environ.get("PATHWAY_FAULTS", "0")
     # arm the flight recorder BEFORE any fault can fire: every shot of
     # the schedule must land in the recorder timeline (harness asserts)
@@ -120,18 +173,14 @@ WORKLOAD = textwrap.dedent(
     counts = counts.select(
         counts.word, counts.count, boosted=boost(counts.count)
     )
-    sink = open(OUT, "a")
-    # newline guard: a previous generation's hard crash may have left a
-    # torn final line; without this, the first record of THIS generation
-    # would concatenate onto it and both would be lost
-    sink.write("\\n")
-    def on_change(key, row, time, is_addition):
-        sink.write(json.dumps({{
-            "w": row["word"], "c": row["count"], "b": row["boosted"],
-            "add": is_addition,
-        }}) + "\\n")
-        sink.flush()
-    pw.io.subscribe(counts, on_change=on_change)
+    # three real sink code paths; delivered output is what the harness
+    # consolidates and compares (no subscribe side-channel, no newline
+    # guards: the atomic fs path makes torn sink lines impossible)
+    pw.io.jsonlines.write(counts, os.path.join(OUTDIR, "fs.jsonl"))
+    pw.io.kafka.write(
+        counts, {{"bootstrap.servers": "mock:9092"}}, "chaos-counts"
+    )
+    pw.io.http.write(counts, "http://chaos.test/sink", n_retries=2)
     pw.run(persistence_config=pw.persistence.Config(
         pw.persistence.Backend.filesystem(PDIR)))
 
@@ -146,13 +195,18 @@ WORKLOAD = textwrap.dedent(
 )
 
 
+def exactly_once_mode() -> bool:
+    return os.environ.get("PATHWAY_EXACTLY_ONCE", "1") != "0"
+
+
 # ------------------------------------------------------------ fault kinds
 #
 # Hit numbers are seeded so each seed crashes at a different wave /
-# commit / journal offset; all stay comfortably inside the run's hit
-# budget (~25+ pumped waves, N_EVENTS journal appends, and — thanks to
-# the source's wait-for-commit pacing — at least N_EVENTS/10 + 2
-# checkpoint commits).
+# commit / journal offset / sink flush; all stay comfortably inside the
+# run's hit budget (~25+ pumped waves, N_EVENTS journal appends, and —
+# thanks to the source's wait-for-commit pacing — at least
+# N_EVENTS/10 + 2 checkpoint commits, each sealing + delivering to all
+# three sinks).
 
 KINDS = {
     "crash_mid_wave": lambda seed: f"seed={seed};runtime.wave@{3 + 3 * seed}",
@@ -172,14 +226,30 @@ KINDS = {
     "device_dispatch": lambda seed: (
         f"seed={seed};device.dispatch.chaos_double@1+2"
     ),
+    # sink-side crash windows of the transactional outbox (io/outbox.py)
+    "sink_pre_seal": lambda seed: (
+        f"seed={seed};sink.outbox.pre_seal@{2 + seed}"
+    ),
+    "sink_post_seal": lambda seed: (
+        f"seed={seed};sink.outbox.post_seal@{2 + seed}"
+    ),
+    "sink_torn_flush": lambda seed: (
+        f"seed={seed};sink.flush.torn@{3 + 2 * seed}"
+    ),
 }
-CRASH_KINDS = {"crash_mid_wave", "torn_metadata", "torn_journal", "lost_snapshot"}
-QUICK_KINDS = ["crash_mid_wave", "torn_metadata", "connector_flap", "device_dispatch"]
+SINK_KINDS = {"sink_pre_seal", "sink_post_seal", "sink_torn_flush"}
+CRASH_KINDS = {
+    "crash_mid_wave", "torn_metadata", "torn_journal", "lost_snapshot",
+} | SINK_KINDS
+QUICK_KINDS = [
+    "crash_mid_wave", "torn_metadata", "connector_flap", "device_dispatch",
+    "sink_post_seal",
+]
 MAX_GENERATIONS = 4  # a schedule may land a crash in the recovery window
 
 
 def _run_workload(
-    pdir: str, out: str, spec: str, n_events: int,
+    pdir: str, outdir: str, spec: str, n_events: int,
     flight_dir: str | None = None,
 ) -> int:
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "PATHWAY_FAULTS": spec}
@@ -191,7 +261,7 @@ def _run_workload(
         env.setdefault("PATHWAY_OBS_RING", "65536")
     r = subprocess.run(
         [sys.executable, "-c", WORKLOAD.format(repo=REPO),
-         pdir, out, str(n_events)],
+         pdir, outdir, str(n_events)],
         capture_output=True, text=True, timeout=240,
         env=env,
     )
@@ -238,26 +308,114 @@ def _check_flight(flight_dir: str, kind: str, seed: int) -> dict:
     }
 
 
-def consolidate(deliveries_path: str) -> bytes:
-    """Canonical bytes of the final output table: consolidate the
-    add/remove delivery stream (possibly spanning crash generations)
-    into final rows, sorted, compact JSON."""
+# ---------------------------------------------------------- consolidation
+#
+# Per-sink canonical bytes of the FINAL delivered table. The dict-based
+# consolidator applies add/remove updates in delivery order and removes
+# only on exact match — the state-convergence contract the docs give
+# at-least-once consumers; under exactly-once the streams contain no
+# duplicates at all (the kafka/http consolidators additionally dedup on
+# the outbox content keys first, proving replays are absorbable).
+
+
+def _apply(state: dict, word: str, value: tuple, diff: int) -> None:
+    if diff > 0:
+        state[word] = value
+    elif state.get(word) == value:
+        del state[word]
+
+
+def consolidate_fs(path: str, strict: bool = True) -> str:
+    """Canonical rows of the fs/jsonlines sink. `strict` (exactly-once
+    mode) tolerates NO torn/blank/unparsable lines — the atomic-segment
+    path guarantees there are none, which is why the old drill's
+    newline guards are gone."""
     state: dict[str, tuple] = {}
-    if os.path.exists(deliveries_path):
-        with open(deliveries_path) as f:
+    if os.path.exists(path):
+        with open(path) as f:
             for line in f:
                 if not line.strip():
-                    continue  # generation-boundary newline guard
+                    if strict:
+                        raise AssertionError(f"blank line in atomic sink {path}")
+                    continue
                 try:
-                    ev = json.loads(line)
+                    rec = json.loads(line)
                 except ValueError:
-                    continue  # torn line from a hard crash
-                if ev["add"]:
-                    state[ev["w"]] = (ev["c"], ev["b"])
-                elif state.get(ev["w"]) == (ev["c"], ev["b"]):
-                    del state[ev["w"]]
+                    if strict:
+                        raise AssertionError(f"torn line in atomic sink {path}")
+                    continue
+                _apply(
+                    state, rec["word"], (rec["count"], rec["boosted"]),
+                    rec["diff"],
+                )
     rows = sorted((w, c, b) for w, (c, b) in state.items())
-    return json.dumps(rows, separators=(",", ":")).encode()
+    return json.dumps(rows, separators=(",", ":"))
+
+
+def _consolidate_keyed_log(path: str, msg_id, record, diff) -> str:
+    """Shared consolidator for the mock queue/endpoint targets: drop
+    exact replays on the outbox content key, then apply signed updates
+    in delivery order."""
+    state: dict[str, tuple] = {}
+    seen: set[str] = set()
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                ev = json.loads(line)
+                mid = msg_id(ev)
+                if mid is not None:
+                    if mid in seen:
+                        continue  # replayed delivery: content-key dedup
+                    seen.add(mid)
+                rec = record(ev)
+                _apply(
+                    state, rec["word"], (rec["count"], rec["boosted"]),
+                    diff(ev, rec),
+                )
+    rows = sorted((w, c, b) for w, (c, b) in state.items())
+    return json.dumps(rows, separators=(",", ":"))
+
+
+def consolidate_kafka(path: str) -> str:
+    return _consolidate_keyed_log(
+        path,
+        msg_id=lambda ev: ev["headers"].get("pathway_msg_id"),
+        record=lambda ev: json.loads(ev["payload"]),
+        diff=lambda ev, rec: int(ev["headers"]["pathway_diff"]),
+    )
+
+
+def consolidate_http(path: str) -> str:
+    return _consolidate_keyed_log(
+        path,
+        msg_id=lambda ev: ev["headers"].get("X-Pathway-Msg-Id"),
+        record=lambda ev: ev["body"],
+        diff=lambda ev, rec: rec["diff"],
+    )
+
+
+def consolidate_outputs(outdir: str, exactly_once: bool) -> dict[str, str]:
+    """All compared sinks' canonical final tables. In at-least-once mode
+    the direct fs writer truncates its file every generation (losing
+    pre-crash deliveries) — the exact gap the outbox closes — so fs is
+    only compared under exactly-once."""
+    out = {
+        "kafka": consolidate_kafka(os.path.join(outdir, "kafka.jsonl")),
+        "http": consolidate_http(os.path.join(outdir, "http.jsonl")),
+    }
+    if exactly_once:
+        import glob as _glob
+
+        fs_path = os.path.join(outdir, "fs.jsonl")
+        leftover = _glob.glob(fs_path + ".pw-*.seg")
+        assert not leftover, (
+            f"fs sink left unconsolidated segments after a clean finish: "
+            f"{leftover}"
+        )
+        out["fs"] = consolidate_fs(fs_path, strict=True)
+    return out
 
 
 def _tamper_lost_snapshot(pdir: str, seed: int) -> str:
@@ -279,13 +437,15 @@ def _tamper_lost_snapshot(pdir: str, seed: int) -> str:
 
 def run_case(kind: str, seed: int, n_events: int, workdir: str) -> dict:
     """One drill: fault run (+ recovery generations) in a fresh
-    persistence dir; returns the case record incl. canonical output."""
+    persistence dir; returns the case record incl. canonical per-sink
+    delivered output."""
+    eo = exactly_once_mode()
     pdir = os.path.join(workdir, f"{kind}-s{seed}-pdir")
-    out = os.path.join(workdir, f"{kind}-s{seed}-deliveries.jsonl")
+    outdir = os.path.join(workdir, f"{kind}-s{seed}-out")
     flight_dir = os.path.join(workdir, f"{kind}-s{seed}-flight")
     spec = KINDS[kind](seed)
     t0 = time.monotonic()
-    rc = _run_workload(pdir, out, spec, n_events, flight_dir=flight_dir)
+    rc = _run_workload(pdir, outdir, spec, n_events, flight_dir=flight_dir)
     generations = 1
     note = ""
     if kind in CRASH_KINDS:
@@ -300,7 +460,7 @@ def run_case(kind: str, seed: int, n_events: int, workdir: str) -> dict:
         while rc == CRASH_EXIT:
             if generations > MAX_GENERATIONS:
                 raise AssertionError(f"{kind} seed {seed}: kept crashing")
-            rc = _run_workload(pdir, out, "0", n_events,
+            rc = _run_workload(pdir, outdir, "0", n_events,
                                flight_dir=flight_dir)
             generations += 1
     assert rc == 0, f"{kind} seed {seed}: final generation rc={rc}"
@@ -313,7 +473,7 @@ def run_case(kind: str, seed: int, n_events: int, workdir: str) -> dict:
         "seconds": round(time.monotonic() - t0, 2),
         "note": note,
         "flight": flight,
-        "output": consolidate(out).decode(),
+        "outputs": consolidate_outputs(outdir, eo),
     }
 
 
@@ -335,25 +495,40 @@ def run_matrix(
 def _run_matrix(
     kinds: list[str], seeds: list[int], n_events: int, workdir: str
 ) -> dict:
+    eo = exactly_once_mode()
+    if not eo:
+        skipped = [k for k in kinds if k in SINK_KINDS]
+        kinds = [k for k in kinds if k not in SINK_KINDS]
+        if skipped:
+            print(
+                "PATHWAY_EXACTLY_ONCE=0: sink-window kinds skipped "
+                f"(outbox disarmed): {skipped}"
+            )
+        assert kinds, (
+            "no fault kinds left to run — sink kinds skip under "
+            "PATHWAY_EXACTLY_ONCE=0; an empty matrix must not report ok"
+        )
     t0 = time.monotonic()
     base_pdir = os.path.join(workdir, "baseline-pdir")
-    base_out = os.path.join(workdir, "baseline-deliveries.jsonl")
+    base_out = os.path.join(workdir, "baseline-out")
     rc = _run_workload(base_pdir, base_out, "0", n_events)
     assert rc == 0, f"baseline rc={rc}"
-    baseline = consolidate(base_out)
-    assert baseline != b"[]", "baseline produced no output"
+    baseline = consolidate_outputs(base_out, eo)
+    assert all(v != "[]" for v in baseline.values()), (
+        f"baseline produced no output: {baseline}"
+    )
     cases = []
     failures = []
     for kind in kinds:
         for seed in seeds:
             case = run_case(kind, seed, n_events, workdir)
-            case["equivalent"] = case["output"].encode() == baseline
+            case["equivalent"] = case["outputs"] == baseline
             cases.append(case)
             if not case["equivalent"]:
                 failures.append(
-                    f"{kind} seed {seed}: output diverged from baseline\n"
-                    f"  baseline: {baseline.decode()}\n"
-                    f"  got:      {case['output']}"
+                    f"{kind} seed {seed}: delivered output diverged from "
+                    f"baseline\n  baseline: {baseline}\n"
+                    f"  got:      {case['outputs']}"
                 )
             status = "OK " if case["equivalent"] else "FAIL"
             print(
@@ -364,7 +539,8 @@ def _run_matrix(
             )
     report = {
         "ok": not failures,
-        "baseline": baseline.decode(),
+        "exactly_once": eo,
+        "baseline": baseline,
         "kinds": kinds,
         "seeds": seeds,
         "n_events": n_events,
@@ -379,7 +555,7 @@ def _run_matrix(
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
-                    help="4 kinds x 1 seed (the tier-1 CI leg, <=60s)")
+                    help="5 kinds x 1 seed (the tier-1 CI leg, <=80s)")
     ap.add_argument("--kinds", default=None,
                     help=f"comma list from {sorted(KINDS)}")
     ap.add_argument("--seeds", default=None, help="comma list of ints")

@@ -39,6 +39,15 @@ policy all bypassed; the unoptimized lowering must stay byte-identical
 to what it was before the optimizer existed (docs/planner.md). The
 optimizer-on side runs inside legs 1-2, and the per-pipeline fused-vs-
 unfused A/B comparisons live in tests/test_plan_optimizer.py.
+Legs 10-11 (exactly-once A/B): the io + chaos suites and the quick
+chaos drill with the transactional sink outbox killed
+(PATHWAY_EXACTLY_ONCE=0) — sinks must reproduce the pre-outbox direct
+per-wave writes (the at-least-once rung of docs/robustness.md's
+exactly-once ladder) byte-identically; sink-side fault kinds skip
+themselves (their injection points never probe). The exactly-once side
+of the same suites — outbox staging/seal/replay, atomic fs segments,
+content-keyed dedup, delivered-output equivalence across the sink crash
+windows — already runs inside legs 1-2 and the leg-5 chaos drill.
 
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
@@ -102,11 +111,12 @@ def run_leg(
     return leg
 
 
-def run_chaos_leg() -> dict:
+def run_chaos_leg(name: str = "chaos-quick", env_extra: dict | None = None) -> dict:
     """The --quick equivalence drill as its own leg: subprocess-driven
     (the drill spawns workload processes itself), JSON-report parsed."""
-    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PATHWAY_FAULTS": "0"}
-    report_path = os.path.join(REPO, ".chaos_quick_report.json")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PATHWAY_FAULTS": "0",
+           **(env_extra or {})}
+    report_path = os.path.join(REPO, f".{name.replace('-', '_')}_report.json")
     t0 = time.time()
     r = subprocess.run(
         [sys.executable, "scripts/chaos_drill.py", "--quick",
@@ -124,7 +134,7 @@ def run_chaos_leg() -> dict:
         pass
     tail = (r.stdout.strip().splitlines() or [""])[-1]
     leg = {
-        "leg": "chaos-quick",
+        "leg": name,
         "rc": r.returncode,
         "passed": equivalent,
         "skipped": 0,
@@ -132,7 +142,7 @@ def run_chaos_leg() -> dict:
         "seconds": round(time.time() - t0, 1),
         "summary": tail,
     }
-    print(f"[chaos-quick] {tail}")
+    print(f"[{name}] {tail}")
     return leg
 
 
@@ -208,6 +218,24 @@ def main() -> int:
                 "tests/test_expression_matrix.py",
                 "tests/test_native_plane.py",
             ],
+        ),
+        # transactional sink outbox killed: the direct per-wave write
+        # path (at-least-once) must be byte-identical to pre-outbox
+        # behavior across the io + chaos suites, and the drill must
+        # still prove crash-recovery equivalence for the engine-side
+        # kinds (sink kinds skip — their injection points never probe)
+        run_leg(
+            "exactly-once-off", {"PATHWAY_EXACTLY_ONCE": "0"}, extra,
+            [
+                "tests/test_outbox.py",
+                "tests/test_chaos.py",
+                "tests/test_io_streaming.py",
+                "tests/test_io_formats.py",
+                "tests/test_persistence_matrix.py",
+            ],
+        ),
+        run_chaos_leg(
+            "chaos-quick-eo-off", {"PATHWAY_EXACTLY_ONCE": "0"}
         ),
     ]
     ok = all(l["rc"] == 0 and l["failed"] == 0 and l["passed"] > 0 for l in legs)

@@ -297,18 +297,25 @@ def test_logstash_flaky_sink_succeeds_on_retry(monkeypatch):
 
 
 def test_chaos_equivalence_matrix(tmp_path):
-    """THE acceptance drill: every fault kind x 3 seeds recovers to a
-    final output table byte-identical to the fault-free baseline."""
+    """THE acceptance drill: every fault kind x 3 seeds — engine windows
+    AND the transactional-sink windows (pre-seal, post-seal, torn
+    mid-flush) — recovers to DELIVERED sink output (fs + kafka-mock +
+    http, post-replay, post-dedup) byte-identical to the fault-free
+    baseline."""
     report = chaos_drill.run_matrix(
         sorted(chaos_drill.KINDS), [0, 1, 2], workdir=str(tmp_path)
     )
     assert report["ok"], "\n".join(report.get("failures", []))
-    assert len(report["cases"]) >= 4 * 3
+    expected_kinds = 9 if report["exactly_once"] else 6
+    assert len(report["cases"]) >= expected_kinds * 3
     crashed = [c for c in report["cases"] if c["generations"] > 1]
-    assert len(crashed) >= 3 * 3, "crash kinds must actually crash"
-    base = report["baseline"].encode()
+    min_crash = (7 if report["exactly_once"] else 4) * 3
+    assert len(crashed) >= min_crash, "crash kinds must actually crash"
+    base = report["baseline"]
+    if report["exactly_once"]:
+        assert set(base) == {"fs", "kafka", "http"}
     for case in report["cases"]:
-        assert case["output"].encode() == base, case["kind"]
+        assert case["outputs"] == base, (case["kind"], case["seed"])
 
 
 # --------------------------------------------- supervised mesh recovery

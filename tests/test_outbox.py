@@ -1,0 +1,380 @@
+"""Transactional-sink outbox (io/outbox.py): stage/seal/deliver unit
+coverage, the compaction + replay-offset negotiation invariants the
+exactly-once ladder rests on (docs/robustness.md), the in-process
+end-to-end fs pipeline, and the breaker-close recovery metric
+(pathway_retry_breaker_closes_total)."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time as _time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import faults
+from pathway_tpu.internals import observability as obs
+from pathway_tpu.internals.keys import Key
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io.outbox import (
+    OutboxManager,
+    SinkOutbox,
+    content_key,
+    exactly_once_enabled,
+)
+from pathway_tpu.persistence import SegmentedJournal
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    G.clear()
+    faults.reset()
+    yield
+    obs.disable()
+    faults.reset()
+    G.clear()
+
+
+class _Target:
+    """A keyed delivery target recording exactly what a consumer sees."""
+
+    def __init__(self, fail_times: int = 0):
+        self.batches: list[tuple[int, list, list]] = []
+        self.flushes = 0
+        self.closed = False
+        self.fail_times = fail_times
+
+    def write_keyed(self, time: int, entries: list, ids: list) -> None:
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise ConnectionError("sink down")
+        self.batches.append((time, list(entries), list(ids)))
+
+    def flush(self) -> None:
+        self.flushes += 1
+
+    def close(self) -> None:
+        self.closed = True
+
+    def offsets(self) -> list[int]:
+        return [int(i.split(":")[0]) for (_t, _e, ids) in self.batches for i in ids]
+
+
+def _mk(root: str, target: _Target, name: str = "s") -> SinkOutbox:
+    journal = SegmentedJournal(os.path.join(root, "wal"))
+    return SinkOutbox(
+        name,
+        journal,
+        root,
+        write_batch=lambda t, e: target.write_keyed(t, e, [""] * len(e)),
+        write_keyed=target.write_keyed,
+        flush=target.flush,
+        close=target.close,
+    )
+
+
+def _entries(lo: int, hi: int, diff: int = 1) -> list:
+    return [(Key(i), (f"w{i}", i), diff) for i in range(lo, hi)]
+
+
+# ------------------------------------------------------- stage/seal/deliver
+
+
+def test_stage_seal_deliver_roundtrip(tmp_path):
+    tgt = _Target()
+    ob = _mk(str(tmp_path), tgt)
+    ob.stage(100, _entries(0, 3))
+    ob.stage(101, _entries(3, 5))
+    assert tgt.batches == [], "nothing may reach the writer before the fence"
+    assert ob.seal() == 5
+    assert ob.deliver(epoch=1)
+    # original per-wave grouping survives the WAL roundtrip
+    assert [t for (t, _e, _i) in tgt.batches] == [100, 101]
+    assert [[e[1] for e in es] for (_t, es, _i) in tgt.batches] == [
+        [("w0", 0), ("w1", 1), ("w2", 2)],
+        [("w3", 3), ("w4", 4)],
+    ]
+    # content keys: offset-prefixed, unique, and recomputable
+    ids = [i for (_t, _e, ids) in tgt.batches for i in ids]
+    assert tgt.offsets() == [0, 1, 2, 3, 4]
+    assert len(set(ids)) == 5
+    assert ids[3] == content_key(3, 101, ("w3", 3), 1)
+    assert ob.acked == 5 and tgt.flushes == 1
+
+
+def test_failed_delivery_stays_sealed_and_retries_next_fence(tmp_path):
+    tgt = _Target(fail_times=1)
+    ob = _mk(str(tmp_path), tgt)
+    ob.stage(10, _entries(0, 3))
+    ob.seal()
+    assert not ob.deliver(epoch=1), "a dead sink must not ack"
+    assert ob.acked == 0 and tgt.batches == []
+    # the range stays sealed; the next fence delivers it exactly once
+    assert ob.deliver(epoch=2)
+    assert tgt.offsets() == [0, 1, 2]
+    assert ob.acked == 3
+
+
+# ------------------------------------------------------------- compaction
+
+
+def test_acked_epochs_are_garbage_collected(tmp_path):
+    tgt = _Target()
+    ob = _mk(str(tmp_path), tgt)
+    for epoch in range(1, 6):
+        lo = (epoch - 1) * 4
+        ob.stage(epoch * 10, _entries(lo, lo + 4))
+        ob.seal()
+        assert ob.deliver(epoch)
+    assert ob.acked == 20
+    # every fully-acked segment is compacted away: the journal head sits
+    # at the ack watermark and only the (empty) open segment survives
+    assert ob.journal.head_offset("s") == 20
+    segs = glob.glob(os.path.join(str(tmp_path), "wal", "*.seg"))
+    assert len(segs) == 1
+
+
+def test_restart_after_compaction_negotiates_replay_offset(tmp_path):
+    """THE satellite invariant: epochs 1-2 delivered + compacted, epoch 3
+    sealed when the process dies (post-seal window). The restarted outbox
+    must replay exactly the sealed-unacked range — with the SAME offsets
+    and content keys an uncrashed delivery would have used — even though
+    the WAL below the ack watermark no longer exists."""
+    obs.enable()
+    tgt = _Target()
+    ob = _mk(str(tmp_path), tgt)
+    ob.stage(10, _entries(0, 4))
+    ob.seal()
+    assert ob.deliver(1)
+    ob.stage(20, _entries(4, 8))
+    ob.seal()
+    assert ob.deliver(2)
+    assert ob.journal.head_offset("s") == 8, "epochs 1-2 must be compacted"
+    ob.stage(30, _entries(8, 11))
+    sealed = ob.seal()
+    assert sealed == 11
+    # crash here: sealed rode the metadata commit, nothing was delivered
+
+    tgt2 = _Target()
+    ob2 = _mk(str(tmp_path), tgt2)
+    assert ob2.staged == 11, "restart must re-count the WAL past compaction"
+    assert ob2.acked == 8, "ack file survives the restart"
+    ob2.recover(sealed, epoch=3)
+    assert tgt2.offsets() == [8, 9, 10]
+    ids = [i for (_t, _e, ids) in tgt2.batches for i in ids]
+    assert ids == [content_key(o, 30, (f"w{o}", o), 1) for o in (8, 9, 10)]
+    assert ob2.acked == 11
+    snap = obs.PLANE.metrics.snapshot()
+    assert "pathway_sink_replays_total" in snap
+
+
+# --------------------------------------------------------------- recovery
+
+
+def test_pre_seal_tail_is_discarded_on_recover(tmp_path):
+    tgt = _Target()
+    ob = _mk(str(tmp_path), tgt)
+    ob.stage(10, _entries(0, 4))
+    sealed = ob.seal()
+    assert ob.deliver(1)
+    ob.stage(20, _entries(4, 9))  # staged, never sealed
+    ob._writer.flush()  # the tail reached the OS, but no seal fsynced it
+    # crash pre-seal: the tail's input offsets were never committed either
+    tgt2 = _Target()
+    ob2 = _mk(str(tmp_path), tgt2)
+    assert ob2.staged == 9
+    ob2.recover(sealed, epoch=1)
+    assert ob2.staged == 4 and tgt2.batches == []
+    # the re-run re-derives the tail; re-staging reuses the SAME offsets,
+    # so the eventual delivery carries the keys the lost tail would have
+    ob2.stage(20, _entries(4, 9))
+    ob2.seal()
+    assert ob2.deliver(2)
+    assert tgt2.offsets() == [4, 5, 6, 7, 8]
+
+
+def test_recover_truncates_mid_segment_tail(tmp_path):
+    """The unsealed tail can share a segment with sealed records: the
+    truncation must keep the sealed prefix byte-exactly and replay it."""
+    tgt = _Target()
+    ob = _mk(str(tmp_path), tgt)
+    ob.stage(10, _entries(0, 3))
+    sealed = ob.seal()  # same segment stays open past the fence
+    ob.stage(20, _entries(3, 6))
+    ob._writer.flush()  # tail reached the OS, but the fence never sealed it
+    # crash: epoch sealed 3, delivery never ran, tail 3..5 unsealed
+    tgt2 = _Target()
+    ob2 = _mk(str(tmp_path), tgt2)
+    assert ob2.staged == 6
+    ob2.recover(sealed, epoch=1)
+    assert ob2.staged == 3
+    assert tgt2.offsets() == [0, 1, 2], "sealed-unacked prefix must replay"
+    assert ob2.acked == 3
+
+
+def test_ack_ahead_of_restored_epoch_rolls_back(tmp_path):
+    """Deep-rung fallback (one-epoch snapshot rollback): the target holds
+    output past the restored epoch's seal; the ack rewinds so the re-run
+    re-delivers the gap with stable content keys, and the overlap is the
+    documented at-least-once residue."""
+    obs.enable()
+    tgt = _Target()
+    ob = _mk(str(tmp_path), tgt)
+    ob.stage(10, _entries(0, 6))
+    ob.seal()
+    assert ob.deliver(1)
+    # the engine rolled back to an epoch that sealed only 3
+    tgt2 = _Target()
+    ob2 = _mk(str(tmp_path), tgt2)
+    ob2.recover(3, epoch=1)
+    assert ob2.acked == 3 and ob2.staged == 3
+    snap = obs.PLANE.metrics.snapshot()
+    assert "pathway_sink_dedup_drops_total" in snap
+
+
+# ------------------------------------------------------- manager + metrics
+
+
+def test_manager_wires_nodes_and_records_seal_metrics(tmp_path):
+    obs.enable()
+
+    class FakeNode:
+        def __init__(self):
+            self.tgt = _Target()
+            self.write_batch = lambda t, e: self.tgt.write_keyed(t, e, [""] * len(e))
+            self.write_keyed = self.tgt.write_keyed
+            self.flush = self.tgt.flush
+            self.close = self.tgt.close
+            self.retry_policy = None
+            self.txn = None
+            self.outbox = None
+
+        def attach_outbox(self, ob):
+            self.outbox = ob
+
+    obm = OutboxManager(str(tmp_path))
+    node = FakeNode()
+    ob = obm.register("sink00", node)
+    assert node.outbox is ob
+    ob.stage(10, _entries(0, 2))
+    assert obm.seal_all() == {"sink00": 2}
+    obm.deliver_all(1)
+    assert node.tgt.offsets() == [0, 1]
+    obm.close()
+    assert node.tgt.closed
+    snap = obs.PLANE.metrics.snapshot()
+    assert "pathway_sink_sealed_epochs_total" in snap
+    assert "pathway_sink_outbox_bytes" in snap
+
+
+# ------------------------------------------------------------- end to end
+
+
+def _run_stream_pipeline(out_path: str, pdir: str) -> None:
+    from pathway_tpu.io.python import ConnectorSubject
+
+    class Src(ConnectorSubject):
+        def run(self):
+            for i in range(20):
+                self.next(g=f"g{i % 4}", v=i)
+
+    t = pw.io.python.read(
+        Src(), schema=pw.schema_from_types(g=str, v=int), name="src"
+    )
+    agg = t.groupby(t.g).reduce(t.g, total=pw.reducers.sum(t.v))
+    pw.io.jsonlines.write(agg, out_path)
+    pw.run(persistence_config=pw.persistence.Config(
+        pw.persistence.Backend.filesystem(pdir)
+    ))
+
+
+def _consolidate(out_path: str) -> dict:
+    state: dict = {}
+    with open(out_path) as f:
+        for line in f:
+            assert line.strip(), "atomic sink must not contain blank lines"
+            rec = json.loads(line)  # a torn line would raise here
+            if rec["diff"] > 0:
+                state[rec["g"]] = rec["total"]
+            elif state.get(rec["g"]) == rec["total"]:
+                del state[rec["g"]]
+    return state
+
+
+def test_exactly_once_fs_pipeline_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_EXACTLY_ONCE", "1")
+    assert exactly_once_enabled()
+    out = str(tmp_path / "out.jsonl")
+    _run_stream_pipeline(out, str(tmp_path / "pdir"))
+    assert _consolidate(out) == {"g0": 40, "g1": 45, "g2": 50, "g3": 55}
+    # clean finish consolidates the atomic segments into the one file
+    assert not glob.glob(out + ".pw-*.seg")
+    # the outbox WAL exists under the persistence root, acked + compacted
+    obdirs = glob.glob(str(tmp_path / "pdir") + "/**/outbox", recursive=True)
+    assert obdirs, "exactly-once run must create the outbox root"
+    acks = glob.glob(os.path.join(obdirs[0], "*.ack"))
+    assert acks, "the final checkpoint must have acked the delivery"
+    with open(acks[0]) as f:
+        ack = json.load(f)
+    assert ack["offset"] > 0
+
+
+def test_fresh_outbox_resets_orphan_fs_segments(tmp_path, monkeypatch):
+    """A fresh outbox (nothing sealed or acked) must drop sink segments
+    an unrelated previous run left beside the output path — otherwise
+    close() would consolidate their stale rows into this run's file."""
+    monkeypatch.setenv("PATHWAY_EXACTLY_ONCE", "1")
+    out = str(tmp_path / "out.jsonl")
+    stale = out + ".pw-000000009999.seg"
+    with open(stale, "w") as f:
+        f.write('{"g": "stale", "total": 1, "time": 0, "diff": 1}\n')
+    _run_stream_pipeline(out, str(tmp_path / "pdir"))
+    assert not os.path.exists(stale)
+    assert _consolidate(out) == {"g0": 40, "g1": 45, "g2": 50, "g3": 55}
+
+
+def test_kill_switch_restores_direct_writes(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_EXACTLY_ONCE", "0")
+    assert not exactly_once_enabled()
+    out = str(tmp_path / "out.jsonl")
+    _run_stream_pipeline(out, str(tmp_path / "pdir"))
+    # same final table, delivered through the direct per-wave path
+    assert _consolidate(out) == {"g0": 40, "g1": 45, "g2": 50, "g3": 55}
+    # and NO outbox machinery was armed
+    assert not glob.glob(str(tmp_path / "pdir") + "/**/outbox", recursive=True)
+
+
+# ------------------------------------------------- breaker recovery metric
+
+
+def test_breaker_close_records_recovery_metric():
+    from pathway_tpu.io import RetryPolicy
+
+    obs.enable()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise ConnectionError("down")
+        return "ok"
+
+    policy = RetryPolicy(
+        "close-test", max_attempts=1, initial_delay_ms=1, jitter_ms=0,
+        breaker_threshold=2, breaker_reset_ms=1,
+    )
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            policy.call(flaky)
+    assert policy.state == "open"
+    _time.sleep(0.02)  # past the cooldown: next attempt is the probe
+    assert policy.call(flaky) == "ok"
+    assert policy.state == "closed"
+    snap = obs.PLANE.metrics.snapshot()
+    assert "pathway_retry_breaker_closes_total" in snap, (
+        "breaker re-close must be visible in the metrics registry"
+    )
+    kinds = [e["k"] for e in obs.PLANE.recorder.snapshot()]
+    assert "breaker.close" in kinds
