@@ -237,6 +237,24 @@ class ShardedNode(Node):
 
             shards = native_shards(batch, plan, self.n_shards)
             if shards is not None:
+                # sharded column plane: the batch's scalar columns cross
+                # as ONE device collective along the host-exact routing
+                # (PATHWAY_DEVICE_EXCHANGE; row order identical to the
+                # select path below)
+                from pathway_tpu.parallel.column_plane import (
+                    engine_column_exchanger,
+                )
+
+                ce = engine_column_exchanger()
+                if ce is not None:
+                    subs = ce.split_batch(batch, shards, self.n_shards)
+                    if subs is not None:
+                        touched = []
+                        for s, sub in enumerate(subs):
+                            if len(sub):
+                                self.replicas[s].accept(input_idx, sub)
+                                touched.append(s)
+                        return touched
                 touched = []
                 for s in np.unique(shards):
                     sub = batch.select(shards == s)
@@ -436,6 +454,17 @@ class ProcessExchangeNode(Node):
         shards = native_shards(batch, self.native_route, n)
         if shards is None:
             return None
+        # device column plane: the wave's bulk columns split through the
+        # mesh collective (host routing, identical order); buckets still
+        # leave this process in wire form — dense ids + unique-row blob
+        # as out-of-band buffers, never per-row pickles
+        from pathway_tpu.parallel.column_plane import engine_column_exchanger
+
+        ce = engine_column_exchanger()
+        if ce is not None:
+            subs = ce.split_batch(batch, shards, n)
+            if subs is not None:
+                return subs
         return [batch.select(shards == p) for p in range(n)]
 
     def _split_wave(self, batches, entries):

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import atexit
 import itertools
+import os
 import threading
 import time
 import weakref
@@ -159,6 +160,7 @@ class IvfPqIndex(VectorSlabIndex):
         background_retrain: bool = True,
         seed: int = 0,
         name: str | None = None,
+        sharded: bool | None = None,
     ):
         super().__init__(
             dimensions=dimensions,
@@ -192,6 +194,20 @@ class IvfPqIndex(VectorSlabIndex):
         self._ann_dirty_slots: set[int] = set()
         self._ann_device_failures = 0
         self._ann_use_device = device
+        # list-sharded mesh search (the pod-scale residual): routing
+        # lists spread across the mesh's data axis with a cross-shard
+        # top-k merge. Opt-in (PATHWAY_ANN_SHARDED=1 or sharded=True) and
+        # only meaningful on a multi-device mesh; the view rebuilds
+        # lazily after mutations, so it suits read-heavy serving.
+        self._shard_search = (
+            sharded
+            if sharded is not None
+            else os.environ.get("PATHWAY_ANN_SHARDED") == "1"
+        ) and device
+        self._mutations = 0
+        self._sharded_view = None
+        self._sharded_key = None
+        self._sharded_failures = 0
         self._metrics_dirty = True
         self.counters = {
             "retrains": 0,
@@ -220,6 +236,8 @@ class IvfPqIndex(VectorSlabIndex):
         st["_ann_full"] = None
         st["_ann_full_slots"] = 0
         st["_ann_dirty_slots"] = set()
+        st["_sharded_view"] = None
+        st["_sharded_key"] = None
         return st
 
     def __setstate__(self, st):
@@ -308,6 +326,7 @@ class IvfPqIndex(VectorSlabIndex):
 
     def _after_mutation(self) -> None:
         self._metrics_dirty = True
+        self._mutations += 1  # invalidates the list-sharded mesh view
         gen = self._gen
         if gen is not None and gen.tombstone_frac() > self.compact_frac:
             self._compact(gen)
@@ -515,6 +534,21 @@ class IvfPqIndex(VectorSlabIndex):
         return out
 
     def _ann_topk(self, qmat: np.ndarray, k: int, gen: _Generation, nprobe: int):
+        if self._shard_search:
+            try:
+                result = self._ann_topk_sharded(qmat, k, gen, nprobe)
+                self._sharded_failures = 0
+                return result
+            except Exception as e:  # noqa: BLE001 — same 3-strike ladder
+                self._sharded_failures += 1
+                if self._sharded_failures >= 3:
+                    self._shard_search = False
+                    # drop the placed view: the sharded codes/cells cube
+                    # would otherwise stay pinned in device memory for an
+                    # index that will never search sharded again
+                    self._sharded_view = None
+                    self._sharded_key = None
+                self._log_device_error(e, permanent=not self._shard_search)
         if self._ann_use_device:
             try:
                 result = self._ann_topk_device(qmat, k, gen, nprobe)
@@ -532,6 +566,35 @@ class IvfPqIndex(VectorSlabIndex):
 
     def _candidates(self, k: int, gen: _Generation) -> int:
         return max(_ivf.auto_candidates(k), gen.cap)
+
+    def _ann_topk_sharded(self, qmat, k, gen: _Generation, nprobe: int):
+        """Search with routing lists sharded across the mesh's `data`
+        axis (ops/ivf.py shard_ivf_pq): each chip scans the probed
+        fraction of its OWN lists, the merge ships k slots per shard.
+        The placed view is cached per (generation, mutation count) —
+        mutations invalidate it lazily, so the rebuild cost lands on the
+        first search after a write, not on the wave path."""
+        import jax
+
+        if len(jax.devices()) < 2:
+            raise NotImplementedError("sharded ANN needs a multi-device mesh")
+        from pathway_tpu.parallel.mesh import default_mesh
+
+        with self._gen_lock:
+            key = (gen.version, self._mutations, self.n_slots)
+            if self._sharded_key != key:
+                self._sharded_view = _ivf.shard_ivf_pq(
+                    gen.as_arrays(self.vectors[: self.n_slots]),
+                    default_mesh(("data",)),
+                )
+                self._sharded_key = key
+            view = self._sharded_view
+            slots_out, dists = _ivf.ivf_pq_search_sharded(
+                qmat.astype(np.float32), view, min(k, len(self.slot_of)),
+                nprobe=nprobe, candidates=self._candidates(k, gen),
+                metric=self.metric if self.metric != "cosine" else "cos",
+            )
+        return self._collect(np.asarray(slots_out), np.asarray(dists))
 
     def _ann_topk_host(self, qmat, k, gen: _Generation, nprobe: int):
         with self._gen_lock:

@@ -474,10 +474,15 @@ class AdaptivePolicy:
         # fresh tuning per run: the exchanger is a process-wide
         # singleton, and a previous run's doublings must not ratchet
         # into this one (same discipline as the scan-tuning claim)
+        from pathway_tpu.parallel import column_plane as cp
         from pathway_tpu.parallel import device_exchange as dx
 
         if dx._ENGINE_EXCHANGER is not None:
             dx._ENGINE_EXCHANGER._auto_min = dx._ENGINE_EXCHANGER._auto_min_base
+        if cp._ENGINE_EXCHANGER is not None:
+            cp._ENGINE_EXCHANGER._auto_min_rows = (
+                cp._ENGINE_EXCHANGER._auto_min_rows_base
+            )
 
     # ------------------------------------------------------------ fences
 
@@ -569,31 +574,79 @@ class AdaptivePolicy:
     # ------------------------------------------------- exchange retune
 
     def _retune_exchange(self, plane) -> int:
+        from pathway_tpu.parallel import column_plane as cp
         from pathway_tpu.parallel import device_exchange as dx
 
         exchanger = dx._ENGINE_EXCHANGER
-        if exchanger is None or self._exchange_tuned >= 4:
+        col = cp._ENGINE_EXCHANGER
+        if (exchanger is None and col is None) or self._exchange_tuned >= 4:
             return 0
-        # honor an auto<->force env flip between runs on the singleton
-        exchanger._mode = dx.mode()
+        # honor an auto<->force env flip between runs on the singletons
+        if exchanger is not None:
+            exchanger._mode = dx.mode()
+        if col is not None:
+            col._mode = dx.mode()
         inv = plane.metrics.counter_value("pathway_device_exchange_invocations")
         rows = plane.metrics.counter_value("pathway_device_exchange_rows")
         if inv < 8:
             return 0
-        if rows / inv >= self.min_rows_per_exchange:
+        rpi = rows / inv
+        tuned = False
+        if rpi < self.min_rows_per_exchange:
+            # thin batches are paying dispatch overhead: raise the bar
+            action = "exchange_retune"
+            if exchanger is not None:
+                # bounded vs the env default; a knob already saturated
+                # at the bound must not burn budget or record a replan
+                bound = min(exchanger._auto_min_base * 16, 1 << 26)
+                if exchanger._auto_min < bound:
+                    exchanger._auto_min = min(exchanger._auto_min * 2, bound)
+                    tuned = True
+            elif col._auto_min_rows < min(
+                col._auto_min_rows_base * 16, 1 << 24
+            ):
+                # scalar-only workloads never build the vector exchanger:
+                # tune the column plane's ROW threshold directly
+                col._auto_min_rows = min(
+                    col._auto_min_rows * 2,
+                    col._auto_min_rows_base * 16,
+                    1 << 24,
+                )
+                tuned = True
+        elif rpi >= 8 * self.min_rows_per_exchange:
+            # sustained wins (fat batches riding the wire every wave):
+            # LOWER the crossover so the column lift engages earlier —
+            # bounded at base/16 so auto can never reach trivial batches
+            action = "exchange_retune_down"
+            if exchanger is not None:
+                floor = max(exchanger._auto_min_base // 16, 4096)
+                if exchanger._auto_min > floor:
+                    exchanger._auto_min = max(exchanger._auto_min // 2, floor)
+                    tuned = True
+            else:
+                floor = max(
+                    col._auto_min_rows_base // 16, 4096 // cp._AUTO_LANES
+                )
+                if col._auto_min_rows > floor:
+                    col._auto_min_rows = max(col._auto_min_rows // 2, floor)
+                    tuned = True
+        if not tuned:
+            # saturated bound or mid-band rpi: record nothing and leave
+            # the retune budget for fences that can still move a knob
             return 0
-        exchanger._auto_min = min(
-            exchanger._auto_min * 2,
-            exchanger._auto_min_base * 16,  # bounded vs the env default
-            1 << 26,
+        if col is not None and exchanger is not None:
+            # one tuned crossover governs both planes: the column plane's
+            # ROW threshold derives from the element threshold / lane count
+            col._auto_min_rows = max(exchanger._auto_min // cp._AUTO_LANES, 1)
+        auto_min = (
+            exchanger._auto_min
+            if exchanger is not None
+            else col._auto_min_rows * cp._AUTO_LANES
         )
         self._exchange_tuned += 1
         plane.metrics.counter("pathway_planner_retunes")
-        plane.record(
-            "replan", action="exchange_retune",
-            auto_min=exchanger._auto_min,
-        )
+        plane.record("replan", action=action, auto_min=auto_min)
         self.report["replans"].append({
-            "action": "exchange_retune", "auto_min": exchanger._auto_min,
+            "action": action, "auto_min": auto_min,
         })
         return 1

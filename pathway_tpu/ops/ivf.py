@@ -38,6 +38,7 @@ import numpy as np
 
 __all__ = [
     "IvfPqArrays",
+    "ShardedIvfPq",
     "auto_lists",
     "auto_nprobe",
     "auto_subvectors",
@@ -49,6 +50,8 @@ __all__ = [
     "build_ivf_pq",
     "ivf_pq_search",
     "ivf_pq_search_host",
+    "shard_ivf_pq",
+    "ivf_pq_search_sharded",
 ]
 
 
@@ -510,6 +513,232 @@ def ivf_pq_search(
         nprobe=nprobe,
         candidates=candidates,
         metric=metric,
+    )
+
+
+class ShardedIvfPq(NamedTuple):
+    """The IVF-PQ layout sharded by ROUTING LIST over a device mesh.
+
+    Lists are the natural shard unit (docs/retrieval.md): each chip holds
+    L/shards whole lists — its slice of the code cube, validity/slot maps,
+    and a LIST-LOCAL copy of the exact rescore rows in cell layout
+    (`cells[l, p] = full[slots[l, p]]`), so probe → ADC scan → rescore all
+    run without touching another chip's memory. Only the per-query local
+    top-k (k slots + k distances per shard) crosses the interconnect in
+    the cross-shard merge — O(q·k·shards) ICI traffic, vs O(q·cap·nprobe)
+    had the scan itself been split mid-list. Centroids and codebooks are
+    tiny and replicated; `slots` keeps GLOBAL row ids so merged results
+    are indistinguishable from the unsharded index's.
+    """
+
+    centroids: "object"  # [Lp, d] f32, replicated (pad lists masked)
+    codes: "object"  # [Lp, cap, m] u8, sharded over `axis`
+    valid: "object"  # [Lp, cap] bool, sharded
+    slots: "object"  # [Lp, cap] i32 global row ids, sharded
+    codebooks: "object"  # [m, 256, d/m] f32, replicated
+    cells: "object"  # [Lp, cap, d] f32 list-local rescore rows, sharded
+    n_lists: int  # real (unpadded) list count
+    mesh: "object"
+    axis: str
+
+
+def shard_ivf_pq(index: IvfPqArrays, mesh, axis: str = "data") -> ShardedIvfPq:
+    """Place an IvfPqArrays layout onto `mesh` sharded by routing list.
+
+    Pads the list dimension to a multiple of the shard count (pad lists
+    are all-invalid and masked out of the probe), re-materializes the
+    rescore rows in list-cell layout so each shard's rescore is local,
+    and device_puts every array with its PartitionSpec.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    s = mesh.shape[axis]
+    centroids = np.asarray(index.centroids, np.float32)
+    codes = np.asarray(index.codes)
+    valid = np.asarray(index.valid)
+    slots = np.asarray(index.slots, np.int32)
+    full = np.asarray(index.full, np.float32)
+    L, cap, m = codes.shape
+    d = centroids.shape[1]
+    Lp = -(-L // s) * s
+    if Lp != L:
+        pad = Lp - L
+        centroids = np.concatenate([centroids, np.zeros((pad, d), np.float32)])
+        codes = np.concatenate([codes, np.zeros((pad, cap, m), np.uint8)])
+        valid = np.concatenate([valid, np.zeros((pad, cap), bool)])
+        slots = np.concatenate([slots, np.full((pad, cap), -1, np.int32)])
+    cells = np.zeros((Lp, cap, d), np.float32)
+    v = valid
+    cells[v] = full[slots[v]]
+
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return ShardedIvfPq(
+        centroids=put(centroids, P()),
+        codes=put(codes, P(axis, None, None)),
+        valid=put(valid, P(axis, None)),
+        slots=put(slots, P(axis, None)),
+        codebooks=put(np.asarray(index.codebooks, np.float32), P()),
+        cells=put(cells, P(axis, None, None)),
+        n_lists=L,
+        mesh=mesh,
+        axis=axis,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_search_program(
+    mesh, axis: str, k: int, nprobe: int, candidates: int, metric: str,
+    n_lists: int,
+):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    n_shards = mesh.shape[axis]
+
+    def local(q, centroids, codes, valid, slots, codebooks, cells):
+        # codes/valid/slots/cells: THIS shard's lists [Ls, cap, ...];
+        # q/centroids/codebooks replicated — the probe is the same
+        # deterministic computation on every shard
+        shard = jax.lax.axis_index(axis)
+        Ls, cap, m = codes.shape
+        B, d = q.shape
+        dsub = d // m
+        q = q.astype(jnp.float32)
+        if metric in ("cos", "cosine"):
+            q = q / jnp.maximum(
+                jnp.linalg.norm(q, axis=1, keepdims=True), 1e-12
+            )
+        if metric == "l2sq":
+            csim = -(
+                (q * q).sum(1, keepdims=True)
+                - 2.0 * q @ centroids.T
+                + (centroids * centroids).sum(1)[None, :]
+            )
+        else:
+            csim = q @ centroids.T
+        Lp = centroids.shape[0]
+        # pad lists (all-invalid) must never win a probe slot
+        csim = jnp.where(jnp.arange(Lp)[None, :] < n_lists, csim, -jnp.inf)
+        Pn = min(nprobe, n_lists)
+        _, probe = jax.lax.top_k(csim, Pn)  # [B, Pn] GLOBAL list ids
+        local_id = probe - shard * Ls
+        owned = (local_id >= 0) & (local_id < Ls)
+        lidx = jnp.clip(local_id, 0, Ls - 1)
+        pcodes = codes[lidx].reshape(B, Pn * cap, m)
+        pvalid = (valid[lidx] & owned[:, :, None]).reshape(B, Pn * cap)
+        pslots = slots[lidx].reshape(B, Pn * cap)
+        qs = q.reshape(B, m, dsub)
+        if metric == "l2sq":
+            lut = (
+                (qs * qs).sum(-1)[:, :, None]
+                - 2.0 * jnp.einsum("bms,mcs->bmc", qs, codebooks)
+                + (codebooks * codebooks).sum(-1)[None, :, :]
+            )
+            lut = -lut
+        else:
+            lut = jnp.einsum("bms,mcs->bmc", qs, codebooks)
+        gathered = jnp.take_along_axis(
+            lut, pcodes.transpose(0, 2, 1).astype(jnp.int32), axis=2
+        )
+        adc = gathered.sum(axis=1)
+        adc = jnp.where(pvalid, adc, -jnp.inf)
+        c = min(candidates, Pn * cap)
+        _, cand = jax.lax.top_k(adc, c)  # [B, c] flat probed-cell index
+        cslots = jnp.take_along_axis(pslots, cand, axis=1)
+        cvalid = jnp.take_along_axis(pvalid, cand, axis=1)
+        # rescore rows come from the LOCAL cell layout: candidate
+        # (probed row, cell) -> this shard's [Ls*cap, d] flat rows
+        probe_row = cand // cap
+        cell = cand % cap
+        cand_list = jnp.take_along_axis(lidx, probe_row, axis=1)
+        flat = cells.reshape(Ls * cap, d)
+        rows = flat[cand_list * cap + cell]  # [B, c, d]
+        if metric == "l2sq":
+            diff = q[:, None, :] - rows
+            exact = -jnp.sum(diff * diff, axis=-1)
+        else:
+            exact = jnp.einsum(
+                "bd,bcd->bc", q, rows, preferred_element_type=jnp.float32
+            )
+        exact = jnp.where(cvalid, exact, -jnp.inf)
+        kk = min(k, c)
+        s_loc, pos = jax.lax.top_k(exact, kk)
+        slots_loc = jnp.take_along_axis(cslots, pos, axis=1)
+        # ---- cross-shard merge: k slots + k scores per shard on the wire
+        all_s = jax.lax.all_gather(s_loc, axis)  # [shards, B, kk]
+        all_slots = jax.lax.all_gather(slots_loc, axis)
+        cand_s = jnp.transpose(all_s, (1, 0, 2)).reshape(B, n_shards * kk)
+        cand_slots = jnp.transpose(all_slots, (1, 0, 2)).reshape(
+            B, n_shards * kk
+        )
+        km = min(k, n_shards * kk)
+        ms, mpos = jax.lax.top_k(cand_s, km)
+        mslots = jnp.take_along_axis(cand_slots, mpos, axis=1)
+        if metric in ("l2sq", "dot"):
+            dist = -ms
+        else:
+            dist = 1.0 - ms
+        hit = jnp.isfinite(ms) & (ms > -jnp.inf)
+        mslots = jnp.where(hit, mslots, -1)
+        dist = jnp.where(hit, dist, jnp.inf)
+        return mslots.astype(jnp.int32), dist.astype(jnp.float32)
+
+    import jax as _jax
+
+    return _jax.jit(
+        _jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(
+                P(), P(), P(axis, None, None), P(axis, None),
+                P(axis, None), P(), P(axis, None, None),
+            ),
+            out_specs=(P(), P()),
+            # after the all_gather every shard holds identical merged
+            # results, which the varying-axes inference cannot prove
+            check_vma=False,
+        )
+    )
+
+
+def ivf_pq_search_sharded(
+    queries,
+    sindex: ShardedIvfPq,
+    k: int,
+    *,
+    nprobe: int | None = None,
+    candidates: int | None = None,
+    metric: str = "cos",
+):
+    """Search a list-sharded index: per-shard probe/ADC/rescore over its
+    own lists, cross-shard top-k merge over the interconnect. Returns
+    (global slot ids [B, k] i32, distances [B, k] f32) with the same
+    -1/+inf empty-rank convention as `ivf_pq_search`; result sets match
+    the unsharded index up to the candidate budget (each shard rescans
+    its own top-`candidates`, a superset of the global budget, so recall
+    can only match or improve)."""
+    import jax.numpy as jnp
+
+    L = sindex.n_lists
+    cap = sindex.codes.shape[1]
+    nprobe = nprobe or auto_nprobe(L)
+    candidates = candidates or max(auto_candidates(k), cap)
+    fn = _sharded_search_program(
+        sindex.mesh, sindex.axis, k, min(nprobe, L), candidates,
+        "cos" if metric == "cosine" else metric, L,
+    )
+    return fn(
+        jnp.asarray(queries, jnp.float32),
+        sindex.centroids,
+        sindex.codes,
+        sindex.valid,
+        sindex.slots,
+        sindex.codebooks,
+        sindex.cells,
     )
 
 

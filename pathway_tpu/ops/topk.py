@@ -258,8 +258,11 @@ def make_knn_searcher(
     searches probe `nprobe` lists instead of scanning every row. The
     `PATHWAY_ANN` env var overrides either way — `0` forces the exact
     scan (the kill-switch discipline), `1` opts unlabeled call sites in.
-    Sharded meshes keep the exact per-shard scan regardless (the ANN
-    tier shards by routing lists across chips — docs/retrieval.md).
+    With a mesh, the ANN tier shards by ROUTING LIST across the mesh's
+    `axis`: each chip scans only the probed fraction of its own lists and
+    the cross-shard top-k merge ships O(q·k·shards) over the interconnect
+    (`ops/ivf.py shard_ivf_pq` / `ivf_pq_search_sharded`;
+    docs/retrieval.md).
     """
     from pathway_tpu.indexing import ann_enabled
 
@@ -268,7 +271,6 @@ def make_knn_searcher(
     use_ann = (
         ann is not False
         and ann_enabled(default=bool(ann))
-        and mesh is None
         and metric in ("cos", "cosine", "dot", "l2sq")
     )
     if not use_ann:
@@ -297,14 +299,23 @@ def make_knn_searcher(
                 index = cached
         if index is None:
             index = _ivf.build_ivf_pq(np.asarray(docs), metric=metric)
+            if mesh is not None:
+                # one placement per trained index: lists sharded over the
+                # mesh axis, rescore rows re-laid list-local per shard
+                index = _ivf.shard_ivf_pq(index, mesh, axis)
             try:
                 ref = weakref.ref(docs)
             except TypeError:  # unweakreferenceable: pin it (still correct)
                 ref = (lambda d=docs: d)
             cache["index"] = (ref, tuple(docs.shape), index)
-        slots, dists = _ivf.ivf_pq_search(
-            queries, index, k, nprobe=nprobe, metric=metric
-        )
+        if mesh is not None:
+            slots, dists = _ivf.ivf_pq_search_sharded(
+                queries, index, k, nprobe=nprobe, metric=metric
+            )
+        else:
+            slots, dists = _ivf.ivf_pq_search(
+                queries, index, k, nprobe=nprobe, metric=metric
+            )
         return TopKResult(indices=slots, distances=dists)
 
     return search_ann

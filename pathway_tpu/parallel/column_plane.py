@@ -1,0 +1,183 @@
+"""Sharded NativeBatch column plane: the engine's key-hash shuffle as ONE
+compiled device collective.
+
+The host exchange moves a wave's rows either as per-row Python entries
+(pickled over the process mesh) or as `NativeBatch.select` masks (thread
+shards). This module lifts the batch's scalar columns — (key_lo, key_hi,
+token, diff), each a flat 64-bit array — onto device arrays sharded over
+the mesh `data` axis and shuffles the whole column set with a single
+`all_to_all` (`parallel/exchange.py exchange_columns_with_respill`):
+the bulk bytes of a shuffle ride the interconnect, while routing stays a
+HOST decision (`engine/workers.native_shards` — the exact 128-bit
+`key % n_shards` / group-key blake2b rule) and frontier/watermark
+control traffic stays on the host ring (`parallel/process_mesh.py`).
+Intern tokens are process-wide, so a column-plane split inside one
+process needs no row blob; cross-process delivery keeps the wire form
+(dense ids + unique-row blob, pickle-5 out-of-band buffers).
+
+Donation lifecycle: near-uniform waves take the exchange's donated
+single-round path — the padded staging columns are donated to XLA, which
+aliases them as the receive buffers, so steady-state waves reuse staging
+memory instead of holding send + receive copies live (see
+`exchange._exchange_program`).
+
+Mode (PATHWAY_DEVICE_EXCHANGE, shared with the vector payload plane):
+"1" forces the column plane on, "0" forces it off, unset = AUTO — on
+only on a real multi-device TPU mesh for batches of at least
+``auto_min_rows()`` rows (the vector plane's measured 262144-element
+crossover divided by the 4 u64 lanes a scalar batch ships; the adaptive
+planner retunes it from the `pathway_device_exchange_rows` counters in
+BOTH directions — see internals/planner.py).
+
+Degradation: the `mesh.device_wire` fault point models the device wire
+dropping a wave. One retry, then the split returns None and the caller
+falls back to the host wire — byte-identical by construction, since the
+collective preserves per-destination global arrival order exactly like
+`batch.select(shards == p)` (the chaos drill's `device_wire` kind pins
+this end to end).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.engine import faults
+from pathway_tpu.parallel import device_exchange as _dx
+from pathway_tpu.parallel.exchange import exchange_columns_with_respill
+from pathway_tpu.parallel.mesh import default_mesh
+
+__all__ = [
+    "ColumnExchanger",
+    "engine_column_exchanger",
+    "auto_min_rows",
+    "stats",
+    "reset_stats",
+]
+
+# the vector plane's measured crossover is in ELEMENTS; a scalar batch
+# ships 4 u64 lanes per row, so rows = elems / 4
+_AUTO_LANES = 4
+
+
+def auto_min_rows() -> int:
+    return max(_dx.auto_min_elems() // _AUTO_LANES, 1)
+
+
+_STATS_LOCK = threading.Lock()
+_STATS = {
+    "invocations": 0,  # column-plane collectives dispatched
+    "rows": 0,  # rows shuffled over the device wire
+    "wire_faults": 0,  # mesh.device_wire shots absorbed (incl. retried)
+    "host_degrades": 0,  # splits that fell back to the host wire
+}
+
+
+def stats() -> dict:
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def reset_stats() -> None:
+    with _STATS_LOCK:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+class ColumnExchanger:
+    """Splits a NativeBatch across shards through the device collective.
+
+    ``split_batch(batch, shards, n_shards)`` returns the per-shard
+    sub-batches (token-valid in this process — thread shards and the
+    local half of a process split share one intern table), or None when
+    the batch must take the host path. The result is row-for-row
+    identical to ``[batch.select(shards == p) for p in range(n_shards)]``.
+    """
+
+    MIN_ROWS = 8  # below this the dispatch overhead always dominates
+
+    def __init__(self, mesh=None, axis: str = "data"):
+        self.mesh = mesh if mesh is not None else default_mesh((axis,))
+        self.axis = axis
+        self._auto_ok = _dx.auto_eligible_mesh(self.mesh)
+        self._auto_min_rows = auto_min_rows()
+        self._auto_min_rows_base = self._auto_min_rows
+        # cached like DeviceExchanger._mode: an env read per wave is
+        # measurable; the adaptive policy refreshes it at its fences
+        self._mode = _dx.mode()
+
+    def split_batch(
+        self, batch: Any, shards: np.ndarray, n_shards: int
+    ) -> "list | None":
+        n = len(batch)
+        if n_shards > self.mesh.shape[self.axis]:
+            return None
+        if self._mode == "off":
+            return None
+        if self._mode == "auto" and not (
+            self._auto_ok
+            and n >= max(self._auto_min_rows, self.MIN_ROWS)
+        ):
+            return None  # below the measured wire crossover
+        if n == 0:
+            return None  # nothing to ship; empty split is the host's
+        cols_per_dest = None
+        for attempt in (0, 1):
+            try:
+                # the injectable wire: a drop retries once (a transient
+                # fault recovers in place), a second shot degrades to
+                # the host wire byte-identically
+                faults.check("mesh.device_wire")
+                cols_per_dest, _srcs = exchange_columns_with_respill(
+                    [batch.key_lo, batch.key_hi, batch.token, batch.diff],
+                    np.asarray(shards, np.int64),
+                    self.mesh,
+                    self.axis,
+                )
+                break
+            except faults.FaultInjected:
+                with _STATS_LOCK:
+                    _STATS["wire_faults"] += 1
+                if attempt == 0:
+                    continue
+            except Exception:  # noqa: BLE001 — no usable devices mid-run
+                pass
+            with _STATS_LOCK:
+                _STATS["host_degrades"] += 1
+            return None
+        with _STATS_LOCK:
+            _STATS["invocations"] += 1
+            _STATS["rows"] += n
+        _dx.note_exchange_metrics(n)
+        from pathway_tpu.engine.native.dataplane import NativeBatch
+
+        out = []
+        for d in range(n_shards):
+            lo, hi, tok, diff = cols_per_dest[d]
+            out.append(
+                NativeBatch(
+                    batch.tab, lo, hi, tok, diff,
+                    # a split of pairwise-distinct +1 rows stays distinct
+                    distinct_hint=batch.distinct_hint,
+                )
+            )
+        return out
+
+
+_ENGINE_EXCHANGER: ColumnExchanger | None = None
+
+
+def engine_column_exchanger() -> ColumnExchanger | None:
+    """Process-wide column exchanger for the engine's exchange sites,
+    when the device plane is enabled and a mesh is constructible."""
+    global _ENGINE_EXCHANGER
+    if not _dx.enabled():
+        return None
+    if _ENGINE_EXCHANGER is None:
+        try:
+            _ENGINE_EXCHANGER = ColumnExchanger()
+        except Exception:  # noqa: BLE001 — no usable devices
+            return None
+    return _ENGINE_EXCHANGER

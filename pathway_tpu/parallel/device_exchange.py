@@ -72,6 +72,25 @@ def auto_eligible_mesh(mesh) -> bool:
     return len(devs) > 1 and getattr(devs[0], "platform", "") == "tpu"
 
 
+def note_exchange_metrics(rows: int) -> None:
+    """Wire-cost visibility for the adaptive planner (and /metrics),
+    shared by the vector-payload and scalar-column planes: one pair of
+    counters governs the AUTO crossover retune at the next epoch fence
+    (internals/planner.py `_retune_exchange`)."""
+    from pathway_tpu.internals import observability as _obs
+
+    if _obs.PLANE is not None:
+        m = _obs.PLANE.metrics
+        m.counter(
+            "pathway_device_exchange_invocations",
+            help="device-mesh batch exchanges dispatched",
+        )
+        m.counter(
+            "pathway_device_exchange_rows", inc=rows,
+            help="rows moved over the device-mesh exchange",
+        )
+
+
 class DeviceExchanger:
     """Routes the ndarray columns of an entry batch over the device mesh.
 
@@ -172,21 +191,7 @@ class DeviceExchanger:
         )
         self.invocations += 1
         self.rows_exchanged += n
-        # wire-cost visibility for the adaptive planner (and /metrics):
-        # rows-per-invocation below threshold triggers an _auto_min
-        # retune at the next epoch fence
-        from pathway_tpu.internals import observability as _obs
-
-        if _obs.PLANE is not None:
-            m = _obs.PLANE.metrics
-            m.counter(
-                "pathway_device_exchange_invocations",
-                help="device-mesh batch exchanges dispatched",
-            )
-            m.counter(
-                "pathway_device_exchange_rows", inc=n,
-                help="rows moved over the device-mesh exchange",
-            )
+        note_exchange_metrics(n)
         out: list[list] = [[] for _ in range(n_shards)]
         for d in range(n_shards):
             for vec_row, i in zip(pays[d], srcs[d]):

@@ -83,9 +83,18 @@ def _bucketize(keys, payloads, dests, valid_in, n_shards: int, cap: int,
 
 
 @functools.lru_cache(maxsize=64)
-def _exchange_program(mesh: Mesh, axis: str, n_shards: int, cap: int):
+def _exchange_program(mesh: Mesh, axis: str, n_shards: int, cap: int,
+                      donate: bool = False):
     """One compiled exchange program per (mesh, axis, capacity): rebuilding
-    the shard_map closure per call would retrace+recompile every batch."""
+    the shard_map closure per call would retrace+recompile every batch.
+
+    `donate=True` donates the keys/payload/valid staging buffers to XLA.
+    Donation aliases input to output storage only when byte sizes match,
+    which holds exactly when the caller pads its rows to
+    ``n_shards * (cap + 1)`` per shard — the steady-state single-round
+    layout `exchange_with_respill` produces for near-uniform waves. The
+    staging memory of wave N is then reused as the receive buffers of the
+    same dispatch instead of accumulating a second copy per wave."""
 
     def local(k, p, d, v):
         bk, bp, bv, overflow = _bucketize(k, p, d, v, n_shards, cap, axis)
@@ -104,14 +113,17 @@ def _exchange_program(mesh: Mesh, axis: str, n_shards: int, cap: int):
             ov.reshape(1),
         )
 
-    return jax.jit(
-        jax.shard_map(
-            local,
-            mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(axis)),
-            out_specs=(P(axis), P(axis), P(axis), P(axis)),
-        )
+    mapped = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis), P(axis)),
     )
+    if donate:
+        # dests (arg 2) has no same-dtype output to alias; donating it
+        # would only draw the "unusable donation" warning
+        return jax.jit(mapped, donate_argnums=(0, 1, 3))
+    return jax.jit(mapped)
 
 
 def exchange_by_key(
@@ -122,6 +134,7 @@ def exchange_by_key(
     capacity: int | None = None,
     dests: Array | None = None,
     valid: Array | None = None,
+    donate: bool = False,
 ) -> ExchangeResult:
     """Shuffle rows so shard s receives every row with dests == s
     (default dests: keys % n_shards).
@@ -152,7 +165,7 @@ def exchange_by_key(
     if valid is None:
         valid = jnp.ones(rows_total, bool)
 
-    fn = _exchange_program(mesh, axis, n_shards, cap)
+    fn = _exchange_program(mesh, axis, n_shards, cap, donate)
     rk, rp, rv, ov = fn(
         keys, payloads, jnp.asarray(dests, jnp.int32), valid
     )
@@ -235,33 +248,62 @@ def exchange_with_respill(
     """
     n_shards = mesh.shape[axis]
     n = len(key_ids)
-    pad = (-n) % n_shards
-    if pad:
-        key_ids = np.concatenate([key_ids, np.zeros(pad, key_ids.dtype)])
-        payloads = np.concatenate(
-            [payloads, np.zeros((pad,) + payloads.shape[1:], payloads.dtype)]
-        )
-        dests = np.concatenate([dests, np.zeros(pad, dests.dtype)])
-    total = len(key_ids)
-    rows_local = total // n_shards
-    src_of = np.arange(total) // rows_local
-    # per-(src,dst) bucket position of every row, vectorized: global index
-    # order IS (src-major, arrival) order, so within-bucket rank is the
-    # running count per (src,dst) pair
-    sd = src_of * n_shards + np.asarray(dests, np.int64)
+    pos = np.arange(n)
+    # contiguous even split of the REAL rows over source shards; bucket
+    # stats are computed on real rows only, BEFORE the padded layout is
+    # chosen, so pad rows can neither consume capacity slots nor inflate
+    # the round count
+    per = -(-n // n_shards) if n else 0
+    shard = pos // per if n else pos
+    dests64 = np.asarray(dests, np.int64)
+    # per-(src,dst) within-bucket rank, vectorized: row order IS
+    # (src-major, arrival) order, so the rank is the running count per
+    # (src,dst) pair
+    sd = shard * n_shards + dests64
     order = np.argsort(sd, kind="stable")
     sorted_sd = sd[order]
     group_start = np.r_[0, np.nonzero(np.diff(sorted_sd))[0] + 1]
-    group_len = np.diff(np.r_[group_start, total])
-    within_sorted = np.arange(total) - np.repeat(group_start, group_len)
-    within = np.empty(total, np.int64)
+    group_len = np.diff(np.r_[group_start, n])
+    within_sorted = np.arange(n) - np.repeat(group_start, group_len)
+    within = np.empty(n, np.int64)
     within[order] = within_sorted
-    row_valid = np.ones(total, bool)
-    if pad:
-        row_valid[n:] = False
-    max_bucket = int(group_len.max()) if total else 0
-    cap = capacity or max(min(max_bucket, max(rows_local // 2, 1)), 1)
-    rounds = max(1, -(-max_bucket // cap))
+    max_bucket = int(group_len.max()) if n else 0
+    # steady-state donation: size the single-round layout from the
+    # measured max bucket — each shard sends n_shards*(max_bucket+1)
+    # slots, which byte-matches the receive buffers, so the donated
+    # program aliases them and steady-state waves reuse staging memory
+    # instead of holding send + receive copies live at once. Taken only
+    # while the staging overhead stays bounded (~25% over the real rows;
+    # the n_shards^2 floor keeps small waves eligible) — the shape
+    # hash-routed waves settle into. Skewed waves fall back to the
+    # multi-round respill below (no donation: the device arrays are
+    # reused across rounds there, so aliasing would corrupt round 2+).
+    donate = (
+        capacity is None
+        and max_bucket >= 1
+        and n_shards * (max_bucket + 1)
+        <= per + max(per // 4, n_shards * n_shards)
+    )
+    if donate:
+        cap, rounds = max_bucket, 1
+        rows_local = n_shards * (cap + 1)
+    else:
+        cap = capacity or max(min(max_bucket, max(per // 2, 1)), 1)
+        rounds = max(1, -(-max_bucket // cap))
+        rows_local = max(per, 1)
+    # per-shard padded layout: shard s holds its run of `per` real rows
+    # followed by invalid pad slots up to rows_local
+    total = rows_local * n_shards
+    padded_pos = shard * rows_local + (pos - shard * per)
+    orig_of = np.full(total, -1, np.int64)
+    orig_of[padded_pos] = pos
+    pk = np.zeros(total, key_ids.dtype)
+    pk[padded_pos] = key_ids
+    ppay = np.zeros((total,) + payloads.shape[1:], payloads.dtype)
+    ppay[padded_pos] = payloads
+    pdests = np.zeros(total, np.int64)
+    pdests[padded_pos] = dests64
+    key_ids, payloads, dests = pk, ppay, pdests
 
     keys_d = jax.device_put(
         jnp.asarray(key_ids, jnp.uint32),
@@ -278,13 +320,14 @@ def exchange_with_respill(
     acc_src: list[list] = [[] for _ in range(n_shards)]
     dests_np = np.asarray(dests, np.int64)
     for r in range(rounds):
-        sel = row_valid & (within >= r * cap) & (within < (r + 1) * cap)
+        sel = np.zeros(total, bool)
+        sel[padded_pos] = (within >= r * cap) & (within < (r + 1) * cap)
         valid_d = jax.device_put(
             jnp.asarray(sel), NamedSharding(mesh, P(axis))
         )
         res = exchange_by_key(
             keys_d, pay_d, mesh, axis, capacity=cap, dests=dest_d,
-            valid=valid_d,
+            valid=valid_d, donate=donate,
         )
         assert not bool(res.overflowed)  # capacity rounds preclude overflow
         rk = np.asarray(res.keys)
@@ -292,11 +335,12 @@ def exchange_with_respill(
         rv = np.asarray(res.valid)
         for d in range(n_shards):
             # received slot order is (src-major, within-bucket arrival) =
-            # ascending global index among this round's selected rows
+            # ascending padded index among this round's selected rows,
+            # mapped back to the caller's pre-padding row indices
             idx = np.nonzero(sel & (dests_np == d))[0]
             acc_keys[d].append(rk[d][rv[d]])
             acc_pay[d].append(rp[d][rv[d]])
-            acc_src[d].append(idx)
+            acc_src[d].append(orig_of[idx])
     out_keys, out_pay, out_src = [], [], []
     for d in range(n_shards):
         k = np.concatenate(acc_keys[d]) if acc_keys[d] else np.empty(0, np.uint32)
@@ -312,6 +356,61 @@ def exchange_with_respill(
         out_pay.append(p[reorder])
         out_src.append(s[reorder])
     return out_keys, out_pay, out_src
+
+
+def exchange_columns_with_respill(
+    columns: "list[np.ndarray]",
+    dests: np.ndarray,
+    mesh: Mesh,
+    axis: str = "data",
+    capacity: int | None = None,
+):
+    """Shuffle a SET of aligned 64-bit scalar columns — a NativeBatch's
+    (key_lo, key_hi, token, diff) plus any extra numeric columns — to
+    their destination shards in ONE collective per round.
+
+    Each uint64/int64 column becomes TWO uint32 lanes of a [n, 2k]
+    payload matrix (a bit-exact little-endian view — JAX truncates u64
+    under the default 32-bit mode, so 64-bit values must never enter XLA
+    as u64; this mirrors the i32-as-f32 transport of the vector plane),
+    so the whole column set crosses the interconnect in a single
+    `all_to_all` instead of one dispatch per column. Returns
+    ``(cols_per_dest, src_per_dest)``: for every destination shard, the
+    column list back in the input dtypes plus the original row indices,
+    both in global arrival order (the engine's same-key ordering
+    invariant).
+    """
+    assert columns, "need at least one column"
+    n = len(columns[0])
+    dtypes = []
+    lanes = []
+    for c in columns:
+        c = np.ascontiguousarray(c)
+        assert c.dtype.itemsize == 8 and c.ndim == 1 and len(c) == n
+        dtypes.append(c.dtype)
+        lanes.append(c.view(np.uint32).reshape(n, 2))
+    payload = (
+        np.stack(lanes, axis=1).reshape(n, 2 * len(columns))
+        if n
+        else np.empty((0, 2 * len(columns)), np.uint32)
+    )
+    ids = (np.arange(n, dtype=np.uint64) & 0xFFFFFFFF).astype(np.uint32)
+    _keys, pays, srcs = exchange_with_respill(
+        ids, payload, np.asarray(dests, np.int64), mesh, axis, capacity
+    )
+    n_shards = mesh.shape[axis]
+    cols_per_dest: list[list[np.ndarray]] = []
+    for d in range(n_shards):
+        p = pays[d]  # [m, 2k] u32, arrival order
+        cols_per_dest.append(
+            [
+                np.ascontiguousarray(p[:, 2 * j : 2 * j + 2])
+                .view(dtypes[j])
+                .reshape(-1)
+                for j in range(len(columns))
+            ]
+        )
+    return cols_per_dest, srcs
 
 
 @functools.partial(jax.jit, static_argnames=("n_shards",))

@@ -36,6 +36,18 @@ per request — `decode_step_slots` is the same math as the scanned
 `decode_step` with the shared scalar position replaced by a per-row
 vector, pinned by ``tests/test_continuous_batching.py``.
 
+**Mesh-spanning slot pools** (``PATHWAY_MESH_SLOTS=1``, or
+``mesh_span=True``): on a multi-device mesh the persistent KV cache's
+slot axis is sharded over the mesh's ``data`` axis and the pool grows to
+``n_slots x shards`` — one slot scheduler drives decode slots spread
+across every chip, so serving concurrency scales with the pod instead of
+one chip's HBM. The decode step stays ONE program (jit partitions the
+per-row vectors along the same axis); scheduling, admission, and the
+step-boundary protocol are unchanged, and per-request tokens are
+byte-identical to the single-device pool (the slot axis is batch — rows
+never read each other's slots). Off by default: behavior without the
+flag is exactly the pre-mesh pool.
+
 Decoding is temperature-0 (argmax) here; sampled generation keeps the
 wave-aligned path (a per-request RNG stream inside a shared step program
 is future work and the chat constructor routes accordingly).
@@ -51,7 +63,7 @@ from typing import Any
 
 from pathway_tpu.internals import observability as _obs
 
-__all__ = ["ContinuousBatcher", "continuous_batching_on"]
+__all__ = ["ContinuousBatcher", "continuous_batching_on", "mesh_slots_on"]
 
 
 def continuous_batching_on() -> bool:
@@ -60,6 +72,12 @@ def continuous_batching_on() -> bool:
     return os.environ.get("PATHWAY_CONTINUOUS_BATCH", "1") not in (
         "0", "false", "no",
     )
+
+
+def mesh_slots_on() -> bool:
+    """PATHWAY_MESH_SLOTS=1 spans the slot pool across the device mesh
+    (default off: single-device pools, pre-mesh behavior)."""
+    return os.environ.get("PATHWAY_MESH_SLOTS", "0") == "1"
 
 
 class _Request:
@@ -101,6 +119,7 @@ class ContinuousBatcher:
         n_slots: int = 8,
         plane: Any = None,
         name: str | None = None,
+        mesh_span: bool | None = None,
     ):
         import functools
 
@@ -113,6 +132,17 @@ class ContinuousBatcher:
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.n_steps = n_steps
+        # mesh-spanning pool: n_slots PER SHARD, the KV cache's slot axis
+        # sharded over the mesh `data` axis (module docstring)
+        self.mesh = None
+        if mesh_span if mesh_span is not None else mesh_slots_on():
+            import jax
+
+            if len(jax.devices()) > 1:
+                from pathway_tpu.parallel.mesh import default_mesh
+
+                self.mesh = default_mesh(("data",))
+                n_slots = n_slots * self.mesh.shape["data"]
         self.n_slots = n_slots
         self.budget = cfg.max_len - n_steps
         self._plane = plane or get_device_plane()
@@ -179,16 +209,44 @@ class ContinuousBatcher:
 
     # ---------------------------------------------------------- decode loop
 
+    def _init_cache(self):
+        """Fresh multi-slot KV cache; with a mesh, the slot axis is
+        sharded over `data` so the pool's rows live across every chip."""
+        from pathway_tpu.models import transformer
+
+        cache = transformer.init_kv_cache(self.cfg, self.n_slots)
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec = NamedSharding(
+                self.mesh, P(None, "data", None, None, None)
+            )
+            cache = {k: jax.device_put(v, spec) for k, v in cache.items()}
+        return cache
+
+    def _step_vectors(self, tok, pos, pad):
+        """The per-slot step vectors as device arrays — sharded along the
+        same `data` axis as the cache rows when the pool spans the mesh
+        (jit then partitions the step program instead of replicating)."""
+        import jax.numpy as jnp
+
+        arrs = [jnp.asarray(a) for a in (tok, pos, pad)]
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            row = NamedSharding(self.mesh, P("data"))
+            arrs = [jax.device_put(a, row) for a in arrs]
+        return arrs
+
     def _loop(self) -> None:
         import jax.numpy as jnp
         import numpy as np
 
         from pathway_tpu.models import transformer
 
-        cache = self._plane.lease(
-            self._cache_key,
-            lambda: transformer.init_kv_cache(self.cfg, self.n_slots),
-        )
+        cache = self._plane.lease(self._cache_key, self._init_cache)
         try:
             while True:
                 # ---- step boundary: re-fill freed slots from the queue
@@ -221,9 +279,10 @@ class ContinuousBatcher:
                     tok[slot] = req.token
                     pos[slot] = req.width + req.steps_done
                     pad[slot] = req.pad_len
+                tok_d, pos_d, pad_d = self._step_vectors(tok, pos, pad)
                 nxt, cache = self._step(
-                    self.params, cache, jnp.asarray(tok), jnp.asarray(pos),
-                    jnp.asarray(pad), bucket=self.n_slots,
+                    self.params, cache, tok_d, pos_d, pad_d,
+                    bucket=self.n_slots,
                 )
                 nxt = np.asarray(nxt)
                 self.stats["decode_steps"] += 1

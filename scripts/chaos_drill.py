@@ -10,9 +10,12 @@ a mock endpoint) three ways:
   1. fault-free baseline (``PATHWAY_FAULTS=0``),
   2. with an injected fault — crash mid-wave, torn metadata commit,
      truncated journal segment, lost operator snapshot, flapping
-     connector reads, failing device dispatches, and the sink-side
-     crash windows of the transactional outbox (pre-seal, post-seal,
-     torn mid-flush — io/outbox.py),
+     connector reads, failing device dispatches, a dropping
+     device-exchange wire (``mesh.device_wire`` — the sharded column
+     plane must degrade to the host wire byte-identically,
+     parallel/column_plane.py), and the sink-side crash windows of the
+     transactional outbox (pre-seal, post-seal, torn mid-flush —
+     io/outbox.py),
   3. (for crash kinds) a recovery generation that resumes from the same
      persistence directory.
 
@@ -31,8 +34,8 @@ exactly the gap the outbox exists to close.
 
 Usage::
 
-    python scripts/chaos_drill.py --quick          # 5 kinds x 1 seed (CI leg)
-    python scripts/chaos_drill.py                  # 9 kinds x 3 seeds
+    python scripts/chaos_drill.py --quick          # 6 kinds x 1 seed (CI leg)
+    python scripts/chaos_drill.py                  # 10 kinds x 3 seeds
     python scripts/chaos_drill.py --kinds sink_torn_flush --seeds 0,1,2
     python scripts/chaos_drill.py --json /tmp/chaos.json
 """
@@ -189,6 +192,11 @@ WORKLOAD = textwrap.dedent(
         assert src_policy.retries_total > 0, "flap schedule never flapped"
     if "device.dispatch" in SPEC:
         assert prog.host_fallbacks > 0, "device schedule never degraded"
+    if "mesh.device_wire" in SPEC:
+        from pathway_tpu.parallel import column_plane
+        assert column_plane.stats()["wire_faults"] > 0, (
+            "device-wire schedule never probed the column plane"
+        )
     # normal-exit black box (hard crashes dump inside faults.hard_crash)
     obs.dump_flight("drill-end")
     """
@@ -236,6 +244,25 @@ KINDS = {
     "sink_torn_flush": lambda seed: (
         f"seed={seed};sink.flush.torn@{3 + 2 * seed}"
     ),
+    # the sharded column plane's wire drops every wave from hit 1+seed on
+    # (both the first shot and its retry fire): every native split must
+    # degrade to the host wire and the delivered output must stay
+    # byte-identical to the unfaulted single-thread baseline
+    # (parallel/column_plane.py; runs under PATHWAY_DEVICE_EXCHANGE=1 +
+    # PATHWAY_THREADS=4 on a virtual 8-device mesh — KIND_ENV)
+    "device_wire": lambda seed: (
+        f"seed={seed};mesh.device_wire@{1 + seed}+"
+    ),
+}
+# per-kind workload environment (applied to the FAULTED runs only; the
+# baseline stays the plain single-thread host-wire run, which is exactly
+# the equivalence the kind claims)
+KIND_ENV = {
+    "device_wire": {
+        "PATHWAY_THREADS": "4",
+        "PATHWAY_DEVICE_EXCHANGE": "1",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    },
 }
 SINK_KINDS = {"sink_pre_seal", "sink_post_seal", "sink_torn_flush"}
 CRASH_KINDS = {
@@ -243,7 +270,7 @@ CRASH_KINDS = {
 } | SINK_KINDS
 QUICK_KINDS = [
     "crash_mid_wave", "torn_metadata", "connector_flap", "device_dispatch",
-    "sink_post_seal",
+    "sink_post_seal", "device_wire",
 ]
 MAX_GENERATIONS = 4  # a schedule may land a crash in the recovery window
 
@@ -251,8 +278,10 @@ MAX_GENERATIONS = 4  # a schedule may land a crash in the recovery window
 def _run_workload(
     pdir: str, outdir: str, spec: str, n_events: int,
     flight_dir: str | None = None,
+    extra_env: dict | None = None,
 ) -> int:
-    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PATHWAY_FAULTS": spec}
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PATHWAY_FAULTS": spec,
+           **(extra_env or {})}
     if flight_dir is not None:
         env["PATHWAY_OBSERVABILITY"] = "1"
         env["PATHWAY_FLIGHT_DIR"] = flight_dir
@@ -444,8 +473,10 @@ def run_case(kind: str, seed: int, n_events: int, workdir: str) -> dict:
     outdir = os.path.join(workdir, f"{kind}-s{seed}-out")
     flight_dir = os.path.join(workdir, f"{kind}-s{seed}-flight")
     spec = KINDS[kind](seed)
+    extra_env = KIND_ENV.get(kind)
     t0 = time.monotonic()
-    rc = _run_workload(pdir, outdir, spec, n_events, flight_dir=flight_dir)
+    rc = _run_workload(pdir, outdir, spec, n_events, flight_dir=flight_dir,
+                       extra_env=extra_env)
     generations = 1
     note = ""
     if kind in CRASH_KINDS:
@@ -461,7 +492,7 @@ def run_case(kind: str, seed: int, n_events: int, workdir: str) -> dict:
             if generations > MAX_GENERATIONS:
                 raise AssertionError(f"{kind} seed {seed}: kept crashing")
             rc = _run_workload(pdir, outdir, "0", n_events,
-                               flight_dir=flight_dir)
+                               flight_dir=flight_dir, extra_env=extra_env)
             generations += 1
     assert rc == 0, f"{kind} seed {seed}: final generation rc={rc}"
     flight = _check_flight(flight_dir, kind, seed)
@@ -508,6 +539,23 @@ def _run_matrix(
             "no fault kinds left to run — sink kinds skip under "
             "PATHWAY_EXACTLY_ONCE=0; an empty matrix must not report ok"
         )
+    if "device_wire" in kinds:
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from pathway_tpu.engine.native import dataplane as _dp
+
+        if not _dp.available():
+            # the column plane lifts NativeBatch columns; under the
+            # object plane (PATHWAY_TPU_NATIVE=0) its wire never probes
+            kinds = [k for k in kinds if k != "device_wire"]
+            print(
+                "native dataplane unavailable: device_wire kind skipped "
+                "(the column plane's wire rides NativeBatch)"
+            )
+            assert kinds, (
+                "no fault kinds left to run — an empty matrix must not "
+                "report ok"
+            )
     t0 = time.monotonic()
     base_pdir = os.path.join(workdir, "baseline-pdir")
     base_out = os.path.join(workdir, "baseline-out")
@@ -555,7 +603,7 @@ def _run_matrix(
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
-                    help="5 kinds x 1 seed (the tier-1 CI leg, <=80s)")
+                    help="6 kinds x 1 seed (the tier-1 CI leg, <=90s)")
     ap.add_argument("--kinds", default=None,
                     help=f"comma list from {sorted(KINDS)}")
     ap.add_argument("--seeds", default=None, help="comma list of ints")

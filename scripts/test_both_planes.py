@@ -48,6 +48,12 @@ themselves (their injection points never probe). The exactly-once side
 of the same suites — outbox staging/seal/replay, atomic fs segments,
 content-keyed dedup, delivered-output equivalence across the sink crash
 windows — already runs inside legs 1-2 and the leg-5 chaos drill.
+Leg 12 (multichip-dryrun): the sharded column plane FORCED ON
+(PATHWAY_DEVICE_EXCHANGE=1) over the virtual 8-device mesh
+(tests/conftest.py's XLA_FLAGS) — every NativeBatch exchange in the
+column-plane, exchange and worker-invariance suites rides the compiled
+all_to_all collective on a CPU-only host, and results must stay
+byte-identical to the host wire (docs/parallelism.md §3).
 
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
@@ -236,6 +242,19 @@ def main() -> int:
         ),
         run_chaos_leg(
             "chaos-quick-eo-off", {"PATHWAY_EXACTLY_ONCE": "0"}
+        ),
+        # device-exchange forced on over the virtual mesh: the collective
+        # column plane is exercised on CPU-only hosts (the multichip
+        # dryrun's CI half); its A/B byte-identity test runs here too
+        run_leg(
+            "multichip-dryrun",
+            {"PATHWAY_DEVICE_EXCHANGE": "1"},
+            extra,
+            [
+                "tests/test_column_plane.py",
+                "tests/test_parallel.py",
+                "tests/test_workers.py",
+            ],
         ),
     ]
     ok = all(l["rc"] == 0 and l["failed"] == 0 and l["passed"] > 0 for l in legs)
