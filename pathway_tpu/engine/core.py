@@ -1014,6 +1014,10 @@ class _NativeProgramBuilder:
         self.virt: list | None = None  # None = identity over the source
         self.stages: list = []
         self.needed_src: set[int] = set()
+        # source schema width when the caller knows it (lowering does;
+        # runtime re-fusion doesn't) — the plan verifier's schema check
+        # resolves stage-boundary references against it
+        self.src_width: int | None = None
 
     def _resolve(self, j: int):
         return ("src", j) if self.virt is None else self.virt[j]
@@ -1094,6 +1098,7 @@ class _NativeProgramBuilder:
             "needed_src": sorted(self.needed_src),
             "stages": self.stages,
             "final_env": self.virt,
+            "src_width": self.src_width,
         }
 
 

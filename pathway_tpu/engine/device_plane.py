@@ -46,6 +46,7 @@ from typing import Any, Callable
 
 from pathway_tpu.engine import faults
 from pathway_tpu.internals import observability as _obs
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 __all__ = [
     "BucketPolicy",
@@ -153,7 +154,9 @@ class DeviceProgram:
         if static_argnames:
             kw["static_argnames"] = tuple(static_argnames)
         self._jit = jax.jit(fn, **kw)
-        self._lock = threading.Lock()
+        self._lock = _lockgraph.register_lock(
+            "device_plane.program", threading.Lock()
+        )
         # bucket key -> compilations charged to it
         self.compile_counts: dict[Any, int] = {}
         self._seen_sigs: set[Any] = set()
@@ -408,7 +411,9 @@ class SlotPool:
             raise ValueError(f"slot pool needs >= 1 slot, got {n_slots}")
         self.name = name
         self.n_slots = n_slots
-        self._lock = threading.Lock()
+        self._lock = _lockgraph.register_lock(
+            "device_plane.slot_pool", threading.Lock()
+        )
         # LIFO keeps hot cache rows hot; slot 0 first for determinism
         self._free = list(range(n_slots))[::-1]
         self.acquired_total = 0
@@ -502,7 +507,9 @@ class DevicePlane:
         # holds the plane lock. A plain Lock deadlocks that thread
         # against itself (observed: jax.jit construction inside
         # program() triggering a dead chat's finalizer).
-        self._lock = threading.RLock()
+        self._lock = _lockgraph.register_lock(
+            "device_plane.plane", threading.RLock(), reentrant=True
+        )
         self._dispatch_pool: ThreadPoolExecutor | None = None
         self._staging_pool: ThreadPoolExecutor | None = None
 
@@ -721,7 +728,9 @@ class DevicePlane:
 
 
 _plane: DevicePlane | None = None
-_plane_lock = threading.Lock()
+_plane_lock = _lockgraph.register_lock(
+    "device_plane.registry", threading.Lock()
+)
 
 
 def get_device_plane() -> DevicePlane:

@@ -61,6 +61,7 @@ import fnmatch
 import os
 import random
 import threading
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 __all__ = [
     "FaultInjected",
@@ -185,7 +186,9 @@ class FaultSchedule:
         self.clauses = [_parse_clause(c, self.seed) for (_k, c) in clauses]
         self._hits: dict[str, int] = {}
         self._fired: list[tuple[str, int]] = []
-        self._lock = threading.Lock()
+        self._lock = _lockgraph.register_lock(
+            "faults.schedule", threading.Lock()
+        )
 
     def decide(self, point: str) -> bool:
         return self.decide_hit(point)[0]
@@ -225,7 +228,9 @@ class FaultSchedule:
 
 _SCHEDULE: FaultSchedule | None = None
 _RESOLVED = False
-_INSTALL_LOCK = threading.Lock()
+_INSTALL_LOCK = _lockgraph.register_lock(
+    "faults.install", threading.Lock()
+)
 
 
 def _resolve() -> FaultSchedule | None:

@@ -39,9 +39,10 @@ import threading
 from pathlib import Path
 
 import numpy as np
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 _HERE = Path(__file__).resolve().parent
-_LOCK = threading.Lock()
+_LOCK = _lockgraph.register_lock("native.batch_resolve", threading.Lock())
 _LIB: ctypes.CDLL | None = None
 _TRIED = False
 

@@ -28,9 +28,12 @@ import numpy as np
 
 from pathway_tpu.engine.native import _cpu_tag
 from pathway_tpu.internals.keys import Key
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 _HERE = Path(__file__).resolve().parent
-_LOCK = threading.Lock()
+_LOCK = _lockgraph.register_lock(
+    "native.dataplane_resolve", threading.Lock()
+)
 _LIB: ctypes.CDLL | None = None
 _TRIED = False
 
@@ -341,7 +344,9 @@ class InternTable:
 
 
 _DEFAULT_TAB: InternTable | None = None
-_DEFAULT_TAB_LOCK = threading.Lock()
+_DEFAULT_TAB_LOCK = _lockgraph.register_lock(
+    "native.default_table", threading.Lock()
+)
 
 
 def default_table() -> InternTable:

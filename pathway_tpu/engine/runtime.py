@@ -38,6 +38,7 @@ from pathway_tpu.engine.core import (
 )
 from pathway_tpu.internals.errors import ERROR
 from pathway_tpu.internals.keys import Key, key_for_values, sequential_key
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 
 class OffsetMark:
@@ -66,7 +67,9 @@ class InputSession:
     def __init__(self, node: InputNode, upsert: bool = False):
         self.node = node
         self.upsert_mode = upsert
-        self._lock = threading.Lock()
+        self._lock = _lockgraph.register_lock(
+            "runtime.input_session", threading.Lock()
+        )
         self._staged: list[Entry] = []
         self._current: dict[Key, tuple] = {}  # for upsert sessions
         self.closed = False
@@ -1679,7 +1682,9 @@ class AsyncApplyNode(Node):
 
 
 _async_loop: asyncio.AbstractEventLoop | None = None
-_async_loop_lock = threading.Lock()
+_async_loop_lock = _lockgraph.register_lock(
+    "runtime.async_loop", threading.Lock()
+)
 
 
 def _get_async_loop() -> asyncio.AbstractEventLoop:

@@ -32,13 +32,14 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Sequence
 
 from pathway_tpu.engine.core import Entry, Graph, InputNode, Node
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 # Route functions map (key, row) -> an int or hashable token; the shard is
 # token % n_shards (ints, e.g. Key.value) or hash(token) % n_shards.
 RouteFn = Callable[[Any, tuple], Any]
 
 _POOL: ThreadPoolExecutor | None = None
-_POOL_LOCK = threading.Lock()
+_POOL_LOCK = _lockgraph.register_lock("workers.pool", threading.Lock())
 
 
 def worker_threads() -> int:

@@ -51,6 +51,7 @@ import numpy as np
 
 from pathway_tpu.ops import ivf as _ivf
 from pathway_tpu.stdlib.indexing.host_indexes import VectorSlabIndex
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 _GEN_SEQ = itertools.count(1)
 _NAME_SEQ = itertools.count(1)
@@ -179,8 +180,12 @@ class IvfPqIndex(VectorSlabIndex):
         self.seed = seed
         self.name = name or f"ivfpq-{next(_NAME_SEQ)}"
         self._gen: _Generation | None = None
-        self._gen_lock = threading.RLock()
-        self._retrain_mutex = threading.Lock()  # one retrain at a time
+        self._gen_lock = _lockgraph.register_lock(
+            "ann.generation", threading.RLock(), reentrant=True
+        )
+        self._retrain_mutex = _lockgraph.register_lock(
+            "ann.retrain", threading.Lock()
+        )  # one retrain at a time
         self._retrain_thread: threading.Thread | None = None
         self._changed_since_snapshot: set[int] | None = None
         self._adds_since_train = 0
@@ -242,8 +247,12 @@ class IvfPqIndex(VectorSlabIndex):
 
     def __setstate__(self, st):
         self.__dict__.update(st)
-        self._gen_lock = threading.RLock()
-        self._retrain_mutex = threading.Lock()
+        self._gen_lock = _lockgraph.register_lock(
+            "ann.generation", threading.RLock(), reentrant=True
+        )
+        self._retrain_mutex = _lockgraph.register_lock(
+            "ann.retrain", threading.Lock()
+        )
 
     # ----------------------------------------------------------- mutation
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import threading
 from typing import Any
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 _interactive_enabled = False
 
@@ -42,7 +43,9 @@ class LiveTable:
 
         self._table = table
         self._columns = table._column_names()
-        self._lock = threading.Lock()
+        self._lock = _lockgraph.register_lock(
+            "interactive.session", threading.Lock()
+        )
         self._rows: dict[Any, tuple] = {}
         self._pending: dict[Any, tuple] = {}
         self._time: int = 0

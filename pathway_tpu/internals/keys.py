@@ -16,7 +16,10 @@ from __future__ import annotations
 import hashlib
 import itertools
 import struct
+import threading
 from typing import Any, Iterable
+
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 _MASK = (1 << 128) - 1
 _SALT_SEQ = 0x9E3779B97F4A7C15F39CC0605CEDC834
@@ -182,7 +185,10 @@ def key_for_value(value: Any) -> Key:
 
 
 _seq_counter = itertools.count()
-_seq_lock = None  # lazy: threading import kept out of the hot import path
+# eager: the old lazy None-check was itself racy (two first callers could
+# each install a different lock and interleave their reservations), and
+# its import-cost rationale died when lockgraph pulled threading in above
+_seq_lock = _lockgraph.register_lock("keys.sequence", threading.Lock())
 
 
 def reserve_sequential(n: int) -> int:
@@ -190,11 +196,6 @@ def reserve_sequential(n: int) -> int:
     native ingest path computes the same blake2b(pack(base, i) + salt)
     keys in C++ from this range, so native and Python rows share one
     non-colliding sequence."""
-    global _seq_lock
-    if _seq_lock is None:
-        import threading
-
-        _seq_lock = threading.Lock()
     with _seq_lock:
         start = next(_seq_counter)
         for _ in range(n - 1):

@@ -143,6 +143,12 @@ class Session:
         self.plan_report = _planner.new_report()
         self.graph.plan_report = self.plan_report
         self._fusing: set[int] = set()
+        # plan-verifier inputs (internals/verifier.py): the roots and
+        # sink metadata are recorded even with the optimizer off, so the
+        # verifier can re-derive invariants over the same reachable DAG
+        self._plan_roots: list = []
+        self._sink_meta: list = []
+        self._persistent = False
 
     def attach_plan_roots(
         self, roots: list, sink_meta: list | None = None,
@@ -151,6 +157,9 @@ class Session:
         """Build the optimizer's DAG-wide context from the tables this
         session will lower (sinks/subscribes/captures). Analysis failure
         downgrades to the unoptimized plans rather than erroring."""
+        self._plan_roots = list(roots)
+        self._sink_meta = list(sink_meta or [])
+        self._persistent = persistent
         if not self.fuse or not roots:
             return
         try:
@@ -605,6 +614,14 @@ class Session:
             # pure-filter object chains were never sharded, so they
             # fuse at any worker count.
             return None
+        if native and builder is not None:
+            # source schema width: the verifier's native-program type
+            # check resolves every stage-boundary column reference
+            # against it (internals/verifier.py)
+            try:
+                builder.src_width = len(src_table._column_names())
+            except Exception:  # noqa: BLE001 — width stays unknown
+                pass
         program = builder.build() if native and builder is not None else None
         node = eng.FusedRowwiseNode(
             self.graph,
@@ -618,6 +635,9 @@ class Session:
         )
         node.label = "fused"
         node.trace = getattr(head_s, "trace", None)
+        # the verifier (internals/verifier.py) re-proves the group's
+        # single-consumer gates over the raw spec DAG from these ids
+        node._fused_spec_ids = [s.id for _t, s, _st in group]
         if native:
             for _t, s, _st in group:
                 self._native_specs.add(s.id)
@@ -628,6 +648,7 @@ class Session:
             "stages": [k for k, _f in stages] + (["reindex"] if rekey else []),
             "native": bool(program),
             "nodes_saved": len(stages) - 1 + (1 if rekey else 0),
+            "spec_ids": list(node._fused_spec_ids),
             "trace": getattr(head_s, "trace", None),
         })
         return node
@@ -1741,16 +1762,25 @@ class Session:
                     break
                 emit_cols = None
                 break
-        jnode = self._sharded(
-            [left_node, right_node],
-            lambda sg, ins: eng.JoinNode(
+        def make_join(sg, ins):
+            node = eng.JoinNode(
                 sg, ins[0], ins[1], left_jk, right_jk,
                 mode=mode, id_mode=id_mode,
                 left_width=left_width, right_width=right_width,
                 asof_now=asof_now,
                 native_plan=native_plan,
                 emit_cols=emit_cols,
-            ),
+            )
+            # the spec whose elision proof covers this node — node_of may
+            # cache it under a DIFFERENT spec (filter-through-join builds
+            # the join under the filter's id); the plan verifier re-checks
+            # cheap ids against the join spec itself
+            node._join_spec_id = spec.id
+            return node
+
+        jnode = self._sharded(
+            [left_node, right_node],
+            make_join,
             # exchange both sides on the join key (reference: Shard impls on
             # join arrangements, src/engine/dataflow/shard.rs)
             [
@@ -1896,6 +1926,22 @@ class Session:
         if self.plan_ctx is not None:
             rep["elision"]["sources"] = len(self.plan_ctx.cheap_key_sources)
             rep["elision"]["joins"] = len(self.plan_ctx.cheap_id_joins)
+        # plan verifier (internals/verifier.py): re-derive every
+        # optimizer-assumed invariant over the built plan BEFORE the
+        # runtime exists — a violated plan raises here instead of
+        # corrupting data mid-run. PATHWAY_VERIFY=0 skips, =strict
+        # escalates warnings; the verdict rides the published report.
+        from pathway_tpu.internals import verifier as _verifier
+
+        if _verifier.refresh_enabled():
+            try:
+                rep["verify"] = _verifier.verify_session(self)
+            except _verifier.PlanVerificationError as e:
+                rep["verify"] = e.verdict
+                _planner.publish_report(rep)
+                raise
+        else:
+            rep["verify"] = {"mode": "off"}
         _planner.publish_report(rep)
         runtime = Runtime(self.graph, autocommit_ms=self.autocommit_ms)
         runtime.monitors = list(self.monitors)

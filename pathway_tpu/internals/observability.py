@@ -54,6 +54,7 @@ import time
 import weakref
 from collections import deque
 from typing import Any, Callable, Iterable
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 __all__ = [
     "PLANE",
@@ -81,7 +82,7 @@ __all__ = [
 # on the hot path.
 
 PLANE: "ObservabilityPlane | None" = None
-_LOCK = threading.Lock()
+_LOCK = _lockgraph.register_lock("obs.plane", threading.Lock())
 
 # Pre-run stage time (static-ingest parse in io/fs.py happens at graph
 # BUILD time, before pw.run creates the plane) accumulates here always:
@@ -90,7 +91,9 @@ _LOCK = threading.Lock()
 # lets the profile's ingest share reconcile with the bench's
 # `join_ingest_share` (clock-started-after-ingest methodology).
 _PRETIMES: dict[str, float] = {}
-_PRETIMES_LOCK = threading.Lock()
+_PRETIMES_LOCK = _lockgraph.register_lock(
+    "obs.pretimes", threading.Lock()
+)
 
 # RetryPolicy instances announce themselves here (always on — one WeakSet
 # add per policy construction) so /metrics can export breaker states
@@ -174,7 +177,9 @@ class MetricsRegistry:
     lock is fine."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = _lockgraph.register_lock(
+            "obs.metrics", threading.Lock()
+        )
         self._counters: dict[tuple, float] = {}
         self._gauges: dict[tuple, float] = {}
         self._histograms: dict[tuple, _Histogram] = {}
@@ -358,7 +363,9 @@ class Profiler:
     the instrument is honest about what it could not see."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = _lockgraph.register_lock(
+            "obs.profiler", threading.Lock()
+        )
         self.t0_wall = time.time()
         self.t0 = time.perf_counter()
         # node_id -> [exec_ns, queue_ns, stash_ns, waves]
@@ -481,7 +488,9 @@ class FlightRecorder:
 
     def __init__(self, size: int = 4096):
         self.ring: deque = deque(maxlen=size)
-        self._dump_lock = threading.Lock()
+        self._dump_lock = _lockgraph.register_lock(
+            "obs.flight_dump", threading.Lock()
+        )
         self.dumped: list[str] = []  # paths written so far (tests)
 
     def append(self, event: dict) -> None:
@@ -549,7 +558,9 @@ class ObservabilityPlane:
         self.profiler: Profiler | None = Profiler() if profile else None
         self._exporters: list[Callable[[dict], None]] = []
         self._seq = 0
-        self._seq_lock = threading.Lock()
+        self._seq_lock = _lockgraph.register_lock(
+            "obs.seq", threading.Lock()
+        )
         self.flight_dir = flight_dir or os.environ.get(
             "PATHWAY_FLIGHT_DIR"
         ) or os.path.join(tempfile.gettempdir(), "pathway_flight")

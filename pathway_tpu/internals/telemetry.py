@@ -18,8 +18,9 @@ import threading
 import time
 import uuid
 from typing import Any
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
-_LOCK = threading.Lock()
+_LOCK = _lockgraph.register_lock("telemetry.registry", threading.Lock())
 
 
 class _LocalExporter:

@@ -38,8 +38,27 @@ from typing import Any, Callable, Iterator
 
 from pathway_tpu.engine import faults
 from pathway_tpu.internals import observability as _obs
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
-__all__ = ["RetryPolicy", "CircuitOpen"]
+__all__ = ["RetryPolicy", "CircuitOpen", "log_degradation"]
+
+
+def log_degradation(
+    logger: logging.Logger, point: str, exc: BaseException,
+    level: int = logging.WARNING,
+) -> None:
+    """A survivable I/O failure the caller chooses to absorb: logged and
+    counted, never silent. The repo lint (analysis/lint.py
+    ``swallowed-io-error``) bans bare ``except: pass`` on I/O paths —
+    degradations that don't warrant a full :class:`RetryPolicy` route
+    through here so operators can see them
+    (``pathway_io_degradations_total{point=...}`` in /metrics)."""
+    logger.log(level, "%s: degraded: %s: %s", point, type(exc).__name__, exc)
+    if _obs.PLANE is not None:
+        _obs.PLANE.metrics.counter(
+            "pathway_io_degradations_total", {"point": point},
+            help="survivable I/O failures absorbed as degradations",
+        )
 
 _LOG = logging.getLogger("pathway_tpu.io.retry")
 
@@ -86,7 +105,9 @@ class RetryPolicy:
         self.on_breaker_open = on_breaker_open
         self._sleep = sleep
         self._rng = random.Random(name)  # jitter only; never affects results
-        self._lock = threading.Lock()
+        self._lock = _lockgraph.register_lock(
+            "io.retry_breaker", threading.Lock()
+        )
         # breaker state: "closed" | "open" | "half_open"
         self.state = "closed"
         self._consecutive_failures = 0

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import csv as _csv
 import glob as _glob
+import logging
 import json as _json
 import os
 import threading
@@ -32,6 +33,9 @@ from pathway_tpu.internals.keys import (
 )
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import OpSpec, Table
+from pathway_tpu.io._retry import log_degradation as _log_degradation
+
+logger = logging.getLogger("pathway_tpu.io.fs")
 
 
 # -------------------------------------------------- native (token) ingest
@@ -69,6 +73,11 @@ def _native_info(format: str, schema, csv_settings, with_metadata: bool):  # noq
         # below the graph into the parse (advisory row reduction: rows a
         # plan can't judge stay in, the real FilterNode above decides)
         "tuning": {"key_mode": 0, "filters": []},
+        # chunk-size override read HERE, at connector construction —
+        # never per parse call (tests force multi-chunk files to
+        # exercise mid-file frontier positions; the env-read-per-chunk
+        # was the PR 9(h) hot-path bug class)
+        "chunk": int(os.environ.get("PATHWAY_FS_CHUNK", 4 << 20)),
     }
     if format in ("json", "jsonlines"):
         info["kind"] = "json"
@@ -161,9 +170,9 @@ def _chunk_bodies(path: str, info: dict, start_pos: int = 0):
     fills info['field_idx'] as a side effect. `start_pos` (a previously
     reported record-aligned frontier position) seeks past consumed data."""
     is_csv = info["kind"] == "csv"
-    # PATHWAY_FS_CHUNK: chunk-size override (tests force multi-chunk
-    # files to exercise mid-file frontier positions)
-    CHUNK = int(os.environ.get("PATHWAY_FS_CHUNK", 4 << 20))
+    # chunk size decided at connector construction (see the info dict
+    # builder) — this per-file loop must not read the environment
+    CHUNK = info.get("chunk") or 4 << 20
     with open(path, "rb") as f:
         abs_pos = 0
         if is_csv:
@@ -300,8 +309,10 @@ def _file_metadata(path: str, st) -> dict:
         import pwd
 
         owner = pwd.getpwuid(st.st_uid).pw_name
-    except (ImportError, KeyError, OSError):
-        pass
+    except (ImportError, KeyError):
+        pass  # no pwd module / unmapped uid: owner stays None by design
+    except OSError as e:
+        _log_degradation(logger, "fs.metadata.owner", e, logging.DEBUG)
     return {
         "path": path,
         "size": st.st_size,
@@ -987,8 +998,10 @@ class _FileWriter:
         for seg in self._segment_paths():
             try:
                 os.unlink(seg)
-            except OSError:
-                pass
+            except OSError as e:
+                # a surviving orphan would consolidate its STALE rows
+                # into this run's file at close() — loud, counted
+                _log_degradation(logger, "fs.outbox.orphan_segment", e)
 
     def _segment_paths(self) -> list[str]:
         pre = os.path.basename(self.filename) + ".pw-"
@@ -1080,8 +1093,12 @@ class _FileWriter:
         for seg in segs:
             try:
                 os.unlink(seg)
-            except OSError:
-                pass
+            except OSError as e:
+                # already consolidated; next run's reset drops the
+                # leftover — still worth an operator's eyes
+                _log_degradation(
+                    logger, "fs.outbox.segment_cleanup", e, logging.DEBUG
+                )
 
 
 def write(table: Table, filename: str | os.PathLike, *, format: str = "csv", **kwargs: Any) -> None:  # noqa: A002

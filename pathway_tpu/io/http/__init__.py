@@ -31,6 +31,7 @@ from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.keys import Key, sequential_key
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import OpSpec, Table
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 _LOG = logging.getLogger("pathway_tpu.io.http")
 
@@ -40,7 +41,9 @@ _LOG = logging.getLogger("pathway_tpu.io.http")
 # control run, and the metrics registry mirrors the live depth as
 # pathway_serving_pending_futures{route}.
 _ROUTE_STATS: dict[str, dict] = {}
-_ROUTE_STATS_LOCK = threading.Lock()
+_ROUTE_STATS_LOCK = _lockgraph.register_lock(
+    "io.http_route_stats", threading.Lock()
+)
 
 
 def route_stats() -> dict[str, dict]:
@@ -207,7 +210,9 @@ def rest_connector(
     defaults = schema.default_values()
 
     pending: dict[int, asyncio.Future] = {}
-    pending_lock = threading.Lock()
+    pending_lock = _lockgraph.register_lock(
+        "io.http_pending", threading.Lock()
+    )
     session_holder: dict[str, InputSession] = {}
     stats = {
         "pending": 0, "max_pending": 0, "requests": 0, "responses": 0,

@@ -18,6 +18,7 @@ resume does not depend on broker-side consumer-group state.
 from __future__ import annotations
 
 import json as _json
+import logging
 import time as _time
 from typing import Any, Iterable
 
@@ -25,6 +26,9 @@ from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.io._external import require_module
+from pathway_tpu.io._retry import log_degradation
+
+logger = logging.getLogger("pathway_tpu.io.kafka")
 
 
 def read(
@@ -143,8 +147,13 @@ def read(
                 # consumers / lag monitoring)
                 try:
                     consumer.commit(msg, asynchronous=True)
-                except Exception:  # noqa: BLE001 — commit is best-effort
-                    pass
+                except Exception as e:  # noqa: BLE001 — commit is
+                    # best-effort (resume rides the CLIENT-side offset
+                    # frontier above), but lag monitors read the broker
+                    # side: log + count the degradation
+                    log_degradation(
+                        logger, "kafka.broker_commit", e, logging.DEBUG
+                    )
 
         def _deliver(self, msg: Any) -> None:
             payload = msg.value() or b""

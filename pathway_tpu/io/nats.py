@@ -24,6 +24,7 @@ Semantics:
 from __future__ import annotations
 
 import json as _json
+import logging
 import socket
 import threading
 import time as _time
@@ -33,7 +34,10 @@ from pathway_tpu.engine import faults
 from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.parse_graph import G
-from pathway_tpu.io._retry import RetryPolicy
+from pathway_tpu.analysis import lockgraph as _lockgraph
+from pathway_tpu.io._retry import RetryPolicy, log_degradation
+
+logger = logging.getLogger("pathway_tpu.io.nats")
 
 
 class NatsError(RuntimeError):
@@ -61,7 +65,9 @@ class NatsConnection:
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.settimeout(timeout)
         self._buf = bytearray()
-        self._lock = threading.Lock()
+        self._lock = _lockgraph.register_lock(
+            "io.nats_writer", threading.Lock()
+        )
         self.server_info: dict = {}
         self._handshake(name)
 
@@ -181,8 +187,8 @@ class NatsConnection:
     def close(self) -> None:
         try:
             self.sock.close()
-        except OSError:
-            pass
+        except OSError as e:
+            log_degradation(logger, "nats.socket_close", e, logging.DEBUG)
 
 
 # -------------------------------------------------------------------- read

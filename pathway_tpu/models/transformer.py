@@ -178,12 +178,21 @@ def _rmsnorm(x: Array, scale: Array) -> Array:
     return (x32 * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
 
 
-def _use_fused_attention() -> bool:
-    import os
+_FUSED_ATTN_ENV: bool | None = None
 
-    if os.environ.get("PATHWAY_TPU_FUSED_ATTN", "1") == "0":
-        return False
-    return jax.default_backend() == "tpu"
+
+def _use_fused_attention() -> bool:
+    # the kill switch is read ONCE per process: _attention runs inside
+    # jit traces, and an env read per trace is the hot-path bug class
+    # the repo lint bans (PR 9(h))
+    global _FUSED_ATTN_ENV
+    if _FUSED_ATTN_ENV is None:
+        import os
+
+        _FUSED_ATTN_ENV = (
+            os.environ.get("PATHWAY_TPU_FUSED_ATTN", "1") != "0"
+        )
+    return _FUSED_ATTN_ENV and jax.default_backend() == "tpu"
 
 
 def _attention(

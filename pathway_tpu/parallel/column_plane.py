@@ -48,6 +48,7 @@ from pathway_tpu.engine import faults
 from pathway_tpu.parallel import device_exchange as _dx
 from pathway_tpu.parallel.exchange import exchange_columns_with_respill
 from pathway_tpu.parallel.mesh import default_mesh
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 __all__ = [
     "ColumnExchanger",
@@ -66,7 +67,9 @@ def auto_min_rows() -> int:
     return max(_dx.auto_min_elems() // _AUTO_LANES, 1)
 
 
-_STATS_LOCK = threading.Lock()
+_STATS_LOCK = _lockgraph.register_lock(
+    "column_plane.stats", threading.Lock()
+)
 _STATS = {
     "invocations": 0,  # column-plane collectives dispatched
     "rows": 0,  # rows shuffled over the device wire
@@ -142,8 +145,17 @@ class ColumnExchanger:
                     _STATS["wire_faults"] += 1
                 if attempt == 0:
                     continue
-            except Exception:  # noqa: BLE001 — no usable devices mid-run
-                pass
+            except Exception as e:  # noqa: BLE001 — no usable devices
+                # mid-run degrades to the host wire; the plan verifier's
+                # donation guard is NOT a degradation — swallowing it
+                # here would turn an invariant violation into a silent
+                # host fallback (the vector plane propagates it loudly)
+                from pathway_tpu.internals.verifier import (
+                    PlanVerificationError,
+                )
+
+                if isinstance(e, PlanVerificationError):
+                    raise
             with _STATS_LOCK:
                 _STATS["host_degrades"] += 1
             return None

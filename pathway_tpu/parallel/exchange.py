@@ -224,6 +224,46 @@ def route128(key_lo: np.ndarray, key_hi: np.ndarray, n_shards: int) -> np.ndarra
     )
 
 
+def _verifier_on() -> bool:
+    """Plan-verifier gate for the per-wave donation guard: the cached
+    mirror (refreshed at every session's execute seam) — an env read
+    per wave is the PR 9(h) bug class."""
+    from pathway_tpu.internals import verifier
+
+    return verifier.enabled_cached()
+
+
+def plan_respill_layout(
+    capacity: int | None, max_bucket: int, per: int, n_shards: int
+) -> tuple[bool, int, int, int]:
+    """The respill layout decision as a pure function of the wave shape:
+    returns (donate, cap, rounds, rows_local).
+
+    Steady-state donation sizes a SINGLE-round layout from the measured
+    max bucket — each shard sends n_shards*(max_bucket+1) slots, which
+    byte-matches the receive buffers, so the donated program aliases
+    them and steady-state waves reuse staging memory. Taken only while
+    the staging overhead stays bounded (~25% over the real rows; the
+    n_shards^2 floor keeps small waves eligible). Skewed waves keep the
+    multi-round respill UNDONATED: the device arrays are reused across
+    rounds there, so aliasing would corrupt round 2+ — the invariant
+    internals/verifier.py re-probes over a shape grid."""
+    donate = (
+        capacity is None
+        and max_bucket >= 1
+        and n_shards * (max_bucket + 1)
+        <= per + max(per // 4, n_shards * n_shards)
+    )
+    if donate:
+        cap, rounds = max_bucket, 1
+        rows_local = n_shards * (cap + 1)
+    else:
+        cap = capacity or max(min(max_bucket, max(per // 2, 1)), 1)
+        rounds = max(1, -(-max_bucket // cap))
+        rows_local = max(per, 1)
+    return donate, cap, rounds, rows_local
+
+
 def exchange_with_respill(
     key_ids: np.ndarray,
     payloads: np.ndarray,
@@ -268,29 +308,17 @@ def exchange_with_respill(
     within = np.empty(n, np.int64)
     within[order] = within_sorted
     max_bucket = int(group_len.max()) if n else 0
-    # steady-state donation: size the single-round layout from the
-    # measured max bucket — each shard sends n_shards*(max_bucket+1)
-    # slots, which byte-matches the receive buffers, so the donated
-    # program aliases them and steady-state waves reuse staging memory
-    # instead of holding send + receive copies live at once. Taken only
-    # while the staging overhead stays bounded (~25% over the real rows;
-    # the n_shards^2 floor keeps small waves eligible) — the shape
-    # hash-routed waves settle into. Skewed waves fall back to the
-    # multi-round respill below (no donation: the device arrays are
-    # reused across rounds there, so aliasing would corrupt round 2+).
-    donate = (
-        capacity is None
-        and max_bucket >= 1
-        and n_shards * (max_bucket + 1)
-        <= per + max(per // 4, n_shards * n_shards)
+    # steady-state donation vs multi-round respill: the layout decision
+    # and its aliasing rule live in plan_respill_layout
+    donate, cap, rounds, rows_local = plan_respill_layout(
+        capacity, max_bucket, per, n_shards
     )
-    if donate:
-        cap, rounds = max_bucket, 1
-        rows_local = n_shards * (cap + 1)
-    else:
-        cap = capacity or max(min(max_bucket, max(per // 2, 1)), 1)
-        rounds = max(1, -(-max_bucket // cap))
-        rows_local = max(per, 1)
+    if _verifier_on():
+        # the donation aliasing rule, re-checked at the live decision
+        # (internals/verifier.py also re-probes the planner statically)
+        from pathway_tpu.internals.verifier import check_donation
+
+        check_donation(donate, rounds, rows_local, n_shards, cap)
     # per-shard padded layout: shard s holds its run of `per` real rows
     # followed by invalid pad slots up to rows_local
     total = rows_local * n_shards

@@ -38,6 +38,7 @@ from typing import Any
 
 from pathway_tpu.engine import faults
 from pathway_tpu.internals import observability as _obs
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 _LEN = struct.Struct("<Q")
 
@@ -132,7 +133,9 @@ class ProcessMesh:
                     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                     s.sendall(_LEN.pack(8) + self.process_id.to_bytes(8, "little"))
                     self._send_socks[p] = s
-                    self._send_locks[p] = threading.Lock()
+                    self._send_locks[p] = _lockgraph.register_lock(
+                        "mesh.send", threading.Lock()
+                    )
                     break
                 except OSError:
                     if time.monotonic() > deadline:
@@ -454,7 +457,7 @@ class ProcessMesh:
 
 
 _MESH: ProcessMesh | None = None
-_MESH_LOCK = threading.Lock()
+_MESH_LOCK = _lockgraph.register_lock("mesh.registry", threading.Lock())
 
 
 def get_mesh() -> ProcessMesh | None:

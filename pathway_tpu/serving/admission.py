@@ -28,6 +28,7 @@ import threading
 import time as _time
 
 from pathway_tpu.internals import observability as _obs
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 __all__ = ["TokenBucket", "AdmissionController", "AdmissionDecision"]
 
@@ -42,7 +43,9 @@ class TokenBucket:
         self.burst = float(burst)
         self._tokens = float(burst)
         self._last = _time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = _lockgraph.register_lock(
+            "serving.token_bucket", threading.Lock()
+        )
 
     def try_take(self, n: float = 1.0) -> float:
         """Take `n` tokens if available; returns 0.0 on success, else the
@@ -100,7 +103,9 @@ class AdmissionController:
         self._tenant_burst = tenant_burst
         self._max_tenants = max_tenants
         self._tenants: dict[str, TokenBucket] = {}
-        self._lock = threading.Lock()
+        self._lock = _lockgraph.register_lock(
+            "serving.admission", threading.Lock()
+        )
         self._in_flight = 0
         self.stats = {"admitted": 0, "shed": 0, "max_in_flight": 0}
 

@@ -29,6 +29,7 @@ import threading
 import time as _time
 
 from pathway_tpu.internals import observability as _obs
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 __all__ = ["WatermarkBackpressure"]
 
@@ -55,7 +56,9 @@ class WatermarkBackpressure:
         self.max_delay_s = max_delay_s
         self.poll_interval_s = poll_interval_s
         self.sources = sources  # None = every source the plane reports
-        self._lock = threading.Lock()
+        self._lock = _lockgraph.register_lock(
+            "serving.backpressure", threading.Lock()
+        )
         self._cached_lag = 0.0
         self._cached_at = 0.0
         self.stats = {"delayed": 0, "shed": 0, "max_lag_s": 0.0}

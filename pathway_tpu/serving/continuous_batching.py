@@ -62,6 +62,7 @@ from concurrent.futures import Future
 from typing import Any
 
 from pathway_tpu.internals import observability as _obs
+from pathway_tpu.analysis import lockgraph as _lockgraph
 
 __all__ = ["ContinuousBatcher", "continuous_batching_on", "mesh_slots_on"]
 
@@ -159,7 +160,9 @@ class ContinuousBatcher:
             donate_argnums=(1,),
         )
         self._cache_key = ("cb_kv_cache", self.name, n_slots)
-        self._lock = threading.Lock()
+        self._lock = _lockgraph.register_lock(
+            "serving.slot_scheduler", threading.Lock()
+        )
         self._queue: deque[_Request] = deque()
         self._active: dict[int, _Request] = {}  # slot -> request
         self._running = False

@@ -14,6 +14,7 @@ async work never backpressures the upstream dataflow, with:
 from __future__ import annotations
 
 import asyncio
+import logging
 import queue
 import threading
 from typing import Any
@@ -21,7 +22,11 @@ from typing import Any
 from pathway_tpu.engine.runtime import Connector, InputSession, _get_async_loop
 from pathway_tpu.internals import universe as univ
 from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.analysis import lockgraph as _lockgraph
 from pathway_tpu.internals.table import OpSpec, Table
+from pathway_tpu.io._retry import log_degradation
+
+logger = logging.getLogger("pathway_tpu.stdlib.async_transformer")
 
 
 class AsyncTransformer:
@@ -80,7 +85,9 @@ class AsyncTransformer:
                 # insert invalidates it — otherwise a slow invoke would
                 # resurrect a retracted row)
                 gens: dict[Any, int] = {}
-                publish_lock = threading.Lock()
+                publish_lock = _lockgraph.register_lock(
+                    "stdlib.async_transformer", threading.Lock()
+                )
                 while True:
                     item = transformer._queue.get()
                     if item is None:
@@ -132,8 +139,13 @@ class AsyncTransformer:
                 for f in pending:
                     try:
                         f.result(timeout=60)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as e:  # noqa: BLE001 — per-row
+                        # errors were already routed to the failure
+                        # table inside invoke_one; this drain only
+                        # absorbs teardown races, visibly
+                        log_degradation(
+                            logger, "async_transformer.drain", e
+                        )
                 transformer.close()
 
             t = threading.Thread(target=run, daemon=True, name="pw-async-xform")

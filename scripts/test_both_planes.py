@@ -55,6 +55,20 @@ column-plane, exchange and worker-invariance suites rides the compiled
 all_to_all collective on a CPU-only host, and results must stay
 byte-identical to the host wire (docs/parallelism.md §3).
 
+Leg 13 (lint): ``python -m pathway_tpu.analysis.lint`` — the AST rule
+suite encoding paid-for bug classes (hot-path env reads, swallowed I/O
+errors, jit-under-lock, outbox bypass; docs/static-analysis.md) must be
+green over the package; any violation exits nonzero so regressions
+can't land silently.
+Leg 14 (lock-order): the tier-1 suite under PATHWAY_LOCK_CHECK=1 — every
+registered engine lock records its acquisition-order edges, and a cycle
+in the merged graph (the PR 7/PR 8 ABBA deadlock precondition) fails
+the process at exit via the lockgraph atexit gate (rc 86).
+Leg 15 (chaos-quick-lockcheck): the quick chaos drill with the
+lock-order recorder on — crash/recovery generations and fault paths
+must stay cycle-free too (each workload subprocess carries its own
+exit gate).
+
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
 a real, runnable thing, not a docstring claim).
@@ -149,6 +163,31 @@ def run_chaos_leg(name: str = "chaos-quick", env_extra: dict | None = None) -> d
         "summary": tail,
     }
     print(f"[{name}] {tail}")
+    return leg
+
+
+def run_lint_leg() -> dict:
+    """The repo lint as its own leg: nonzero on ANY violation."""
+    t0 = time.time()
+    r = subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.analysis.lint"],
+        cwd=REPO, env=dict(os.environ), capture_output=True, text=True,
+        timeout=600,
+    )
+    tail = (r.stdout.strip().splitlines() or [""])[-1]
+    m = re.search(r"(\d+) violation", tail)
+    violations = int(m.group(1)) if m else -1
+    leg = {
+        "leg": "lint",
+        "rc": r.returncode,
+        # "passed" carries the green-file signal for the all-legs gate
+        "passed": 1 if r.returncode == 0 else 0,
+        "skipped": 0,
+        "failed": violations if violations > 0 else (0 if r.returncode == 0 else 1),
+        "seconds": round(time.time() - t0, 1),
+        "summary": tail,
+    }
+    print(f"[lint] {tail}")
     return leg
 
 
@@ -255,6 +294,19 @@ def main() -> int:
                 "tests/test_parallel.py",
                 "tests/test_workers.py",
             ],
+        ),
+        # static soundness plane (docs/static-analysis.md): the repo
+        # lint must be green, and the tier-1 suite + quick chaos drill
+        # must run CYCLE-FREE with every registered engine lock
+        # recording acquisition order (the lockgraph atexit gate turns
+        # any ABBA cycle into rc 86)
+        run_lint_leg(),
+        run_leg(
+            "lock-order", {"PATHWAY_LOCK_CHECK": "1"},
+            ["-m", "not slow", *extra],
+        ),
+        run_chaos_leg(
+            "chaos-quick-lockcheck", {"PATHWAY_LOCK_CHECK": "1"}
         ),
     ]
     ok = all(l["rc"] == 0 and l["failed"] == 0 and l["passed"] > 0 for l in legs)

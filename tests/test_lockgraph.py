@@ -1,0 +1,208 @@
+"""Lock-order analyzer tests (analysis/lockgraph.py,
+docs/static-analysis.md).
+
+Pins the recorder's semantics: order edges per thread, ABBA cycles in
+the MERGED graph detected even when no interleaving deadlocked, trylock
+acquisitions constraint-free (the ANN inline-retrain pattern), reentrant
+re-acquisition edge-free, zero instrumentation with the flag off, and
+the engine's known lock roles actually registered."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from pathway_tpu.analysis import lockgraph
+
+
+@pytest.fixture(autouse=True)
+def _clean_edges(monkeypatch):
+    monkeypatch.setenv("PATHWAY_LOCK_CHECK", "1")
+    # the atexit hook would os._exit the TEST RUN on the cycles these
+    # tests create on purpose — record edges but never arm the hook
+    monkeypatch.setattr(lockgraph, "_ATEXIT_ARMED", True)
+    # SNAPSHOT the process-wide graph, don't discard it: under the
+    # lock-order CI leg every earlier suite's real engine edges must
+    # survive this file for the exit gate to check the WHOLE run
+    saved = lockgraph.edges()
+    lockgraph.reset()
+    yield
+    lockgraph.reset()
+    with lockgraph._EDGES_LOCK:
+        lockgraph._EDGES.update(saved)
+
+
+def test_disabled_returns_raw_lock(monkeypatch):
+    monkeypatch.setenv("PATHWAY_LOCK_CHECK", "0")
+    lock = threading.Lock()
+    out = lockgraph.register_lock("t.raw", lock)
+    assert out is lock  # zero overhead off-path
+
+
+def test_nested_acquisition_records_edge():
+    a = lockgraph.register_lock("t.a")
+    b = lockgraph.register_lock("t.b")
+    with a:
+        with b:
+            pass
+    assert ("t.a", "t.b") in lockgraph.edges()
+    assert ("t.b", "t.a") not in lockgraph.edges()
+    lockgraph.assert_acyclic()
+
+
+def test_abba_cycle_detected_across_threads():
+    a = lockgraph.register_lock("t.a")
+    b = lockgraph.register_lock("t.b")
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=order_ab)
+    t2 = threading.Thread(target=order_ba)
+    # SEQUENTIAL runs: no interleaving could deadlock here, yet the
+    # merged graph still proves the ABBA precondition
+    t1.start(); t1.join()
+    t2.start(); t2.join()
+    with pytest.raises(lockgraph.LockOrderError) as ei:
+        lockgraph.assert_acyclic()
+    msg = str(ei.value)
+    assert "t.a -> t.b" in msg and "t.b -> t.a" in msg
+    assert "first seen at" in msg
+    cycle = lockgraph.find_cycle()
+    assert cycle is not None and cycle[0] == cycle[-1]
+
+
+def test_trylock_imposes_no_order_constraint():
+    """The ANN pattern: gen -> trylock(retrain) vs retrain -> gen is
+    deadlock-free by construction (the trylock fails instead of
+    waiting) and must not read as a cycle."""
+    gen = lockgraph.register_lock("t.gen")
+    retrain = lockgraph.register_lock("t.retrain")
+    with gen:
+        assert retrain.acquire(blocking=False)
+        retrain.release()
+    with retrain:
+        with gen:
+            pass
+    assert ("t.gen", "t.retrain") not in lockgraph.edges()
+    assert ("t.retrain", "t.gen") in lockgraph.edges()
+    lockgraph.assert_acyclic()
+
+
+def test_held_trylock_still_constrains_later_blocking_acquires():
+    a = lockgraph.register_lock("t.ta")
+    b = lockgraph.register_lock("t.tb")
+    assert a.acquire(blocking=False)
+    with b:  # blocking acquire WHILE holding the trylocked a
+        pass
+    a.release()
+    assert ("t.ta", "t.tb") in lockgraph.edges()
+
+
+def test_reentrant_reacquisition_is_edge_free():
+    r = lockgraph.register_lock("t.r", reentrant=True)
+    other = lockgraph.register_lock("t.o")
+    with r:
+        with r:  # reentrant: no self-edge
+            with other:
+                pass
+    assert ("t.r", "t.r") not in lockgraph.edges()
+    assert ("t.r", "t.o") in lockgraph.edges()
+    # the release of the INNER hold must not pop the outer one early
+    with r:
+        r.acquire()
+        r.release()
+        with other:
+            pass
+    lockgraph.assert_acyclic()
+
+
+def test_sibling_instance_of_held_role_keeps_cross_role_edges():
+    """Two INSTANCES of one role: re-holding the role must not
+    suppress the cross-role edges of the second (blocking!) acquire —
+    only the role-to-itself edge stays out."""
+    pool_a = lockgraph.register_lock("t.pool")
+    pool_b = lockgraph.register_lock("t.pool")
+    other = lockgraph.register_lock("t.other")
+    with pool_a:
+        with other:
+            with pool_b:  # blocks against siblings: a real constraint
+                pass
+    assert ("t.other", "t.pool") in lockgraph.edges()
+    assert ("t.pool", "t.pool") not in lockgraph.edges()
+
+
+def test_three_party_cycle():
+    a = lockgraph.register_lock("t.c1")
+    b = lockgraph.register_lock("t.c2")
+    c = lockgraph.register_lock("t.c3")
+    for first, second in ((a, b), (b, c), (c, a)):
+        with first:
+            with second:
+                pass
+    with pytest.raises(lockgraph.LockOrderError):
+        lockgraph.assert_acyclic()
+
+
+def test_wrapper_api_compat():
+    lock = lockgraph.register_lock("t.api")
+    assert lock.acquire(True, 0.5)
+    assert lock.locked()
+    lock.release()
+    assert not lock.locked()
+
+
+def test_engine_lock_roles_registered():
+    """The instrumentation coverage floor: importing the engine stack
+    registers the known lock roles (a deleted registration would
+    silently shrink what the lock-order leg can see)."""
+    import pathway_tpu  # noqa: F401
+    import pathway_tpu.engine.device_plane  # noqa: F401
+    import pathway_tpu.engine.runtime  # noqa: F401
+    import pathway_tpu.indexing.ann  # noqa: F401
+    import pathway_tpu.internals.observability  # noqa: F401
+    import pathway_tpu.internals.telemetry  # noqa: F401
+    import pathway_tpu.io._retry  # noqa: F401
+    import pathway_tpu.io.http  # noqa: F401
+    import pathway_tpu.parallel.column_plane  # noqa: F401
+    import pathway_tpu.parallel.process_mesh  # noqa: F401
+    import pathway_tpu.serving.admission  # noqa: F401
+    import pathway_tpu.serving.backpressure  # noqa: F401
+    import pathway_tpu.serving.continuous_batching  # noqa: F401
+
+    # instance-scoped roles register at construction; module-scoped ones
+    # at import — the floor here covers the import-time set plus any
+    # instances the suite has already built
+    roles = set(lockgraph.registry())
+    expected_import_time = {
+        "device_plane.registry", "faults.install", "runtime.async_loop",
+        "workers.pool", "obs.plane", "obs.pretimes",
+        "io.http_route_stats", "mesh.registry", "column_plane.stats",
+        "telemetry.registry",
+    }
+    missing = expected_import_time - roles
+    assert not missing, f"lock roles lost their registration: {missing}"
+
+    # constructing the instances registers their roles too
+    from pathway_tpu.engine.device_plane import SlotPool
+    from pathway_tpu.io._retry import RetryPolicy
+    from pathway_tpu.serving.admission import TokenBucket
+
+    TokenBucket(1.0, 1.0)
+    RetryPolicy("lockgraph-test")
+    SlotPool("lockgraph-test", 1)
+    roles = set(lockgraph.registry())
+    for role in (
+        "serving.token_bucket", "io.retry_breaker",
+        "device_plane.slot_pool",
+    ):
+        assert role in roles, role
+    assert len(roles) >= 15, sorted(roles)

@@ -1,0 +1,401 @@
+"""Plan-verifier adversarial matrix (internals/verifier.py,
+docs/static-analysis.md).
+
+Each test hand-builds (or tampers a lowered session into) a plan that
+violates one optimizer-assumed invariant and pins that
+``verify_session`` raises a ``PlanVerificationError`` NAMING the
+offending plan node — the build-time failure that replaces silent
+runtime corruption. The passing side is pinned too: the verdict rides
+``planner.last_report()["verify"]``, ``PATHWAY_VERIFY=0`` skips,
+``strict`` escalates warnings, and a verify-on run is byte-identical to
+a verify-off run on a passing plan (the A/B leg).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import planner, verifier
+from pathway_tpu.internals.lowering import Session
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _native_available() -> bool:
+    try:
+        from pathway_tpu.engine.native import dataplane as dp
+
+        return dp.available()
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _md(txt: str) -> pw.Table:
+    return pw.debug.table_from_markdown(txt)
+
+
+def _fused_session():
+    """select -> filter chain lowered with fusion; returns
+    (session, fused_node, intermediate_table)."""
+    t = _md(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        5 | 6
+        """
+    )
+    mid = t.select(c=pw.this.a + pw.this.b)
+    out = mid.filter(pw.this.c > 3)
+    s = Session()
+    s.attach_plan_roots([out], sink_meta=[(out, True)])
+    s.capture(out)
+    from pathway_tpu.engine.core import FusedRowwiseNode
+
+    fused = [
+        n for n in s.graph.nodes
+        if isinstance(n, FusedRowwiseNode)
+        and getattr(n, "_fused_spec_ids", None)
+    ]
+    if not fused:
+        pytest.skip("chain did not fuse (optimizer off in this leg)")
+    return s, fused[0], mid
+
+
+# ------------------------------------------------------------- passing
+
+
+def test_passing_plan_verdict_lands_in_report():
+    t = _md("a\n1\n2")
+    pw.debug.compute_and_print(t.select(b=pw.this.a * 2), include_id=False)
+    rep = planner.last_report()
+    verdict = rep["verify"]
+    assert verdict["mode"] == "on"
+    assert not verdict["violations"]
+    for name, entry in verdict["checks"].items():
+        assert entry["status"] in ("ok", "skipped", "warning"), (name, entry)
+    # the invariant catalog is actually checked, not vacuously absent
+    assert "fusion-single-consumer" in verdict["checks"]
+    assert "exchange-donation" in verdict["checks"]
+
+
+def test_verify_off_skips(monkeypatch):
+    monkeypatch.setenv("PATHWAY_VERIFY", "0")
+    t = _md("a\n1")
+    pw.debug.compute_and_print(t, include_id=False)
+    assert planner.last_report()["verify"] == {"mode": "off"}
+
+
+# ------------------------------------- violation: fusion consumers
+
+
+def test_fused_interior_with_second_consumer_fails():
+    """A sink attached to a fused-away intermediate: the interior spec
+    gains a second consumer the fusion proof never saw."""
+    s, fused, mid = _fused_session()
+    s._plan_roots.append(mid)  # the tamper: mid is ALSO a sink root now
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    msg = str(ei.value)
+    assert "FusedRowwiseNode" in msg
+    assert "consumers" in msg or "sink root" in msg
+    # the verdict names the same findings
+    assert ei.value.findings
+
+
+def test_fused_interior_unreachable_spec_fails():
+    s, fused, _mid = _fused_session()
+    fused._fused_spec_ids = [999_999] + list(fused._fused_spec_ids)
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    assert "not reachable" in str(ei.value)
+    assert "FusedRowwiseNode" in str(ei.value)
+
+
+# ------------------------------------------ violation: id elision
+
+
+def test_cheap_join_ids_with_observing_sink_fails():
+    l = _md("k | x\n1 | 10\n2 | 20")
+    r = _md("k | y\n1 | 5\n2 | 7")
+    j = l.join(r, l.k == r.k).select(x=l.x, y=r.y)
+    s = Session()
+    # the writer declares it never exposes row keys -> elision fires
+    s.attach_plan_roots([j], sink_meta=[(j, False)])
+    node = s.node_of(j)
+    from pathway_tpu.engine.core import JoinNode
+
+    jn = node if isinstance(node, JoinNode) else next(
+        (n for n in s.graph.nodes if isinstance(n, JoinNode)), None
+    )
+    if jn is None or jn.id_mode != "cheap":
+        pytest.skip("join id elision preconditions not met in this leg")
+    verifier.verify_session(s)  # honest sink: passes
+    # the tamper: the sink now observes keys, the cheap pair-mix ids leak
+    s._sink_meta = [(j, True)]
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    assert "JoinNode" in str(ei.value)
+    assert "OBSERVABLE" in str(ei.value)
+
+
+def test_cheap_scan_keys_with_observing_sink_fails(tmp_path):
+    if not _native_available():
+        pytest.skip("scan key elision needs the native dataplane")
+    inp = tmp_path / "in.jsonl"
+    with open(inp, "w") as f:
+        for i in range(50):
+            f.write('{"v": %d}\n' % i)
+
+    class S(pw.Schema):
+        v: int
+
+    t = pw.io.fs.read(os.fspath(inp), format="json", schema=S, mode="static")
+    out = t.select(w=pw.this.v + 1).filter(pw.this.w % 2 == 0)
+    s = Session()
+    s.attach_plan_roots([out], sink_meta=[(out, False)])
+    s.capture(out)
+    rep = s.plan_report
+    if not any(p["kind"] == "scan-key-elision" for p in rep["pushdowns"]):
+        pytest.skip("scan key elision did not fire in this leg")
+    verifier.verify_session(s)
+    s._sink_meta = [(out, True)]
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    assert "cheap sequential" in str(ei.value)
+    assert "OBSERVABLE" in str(ei.value)
+
+
+def test_cheap_ids_under_multi_worker_session_fails():
+    l = _md("k | x\n1 | 10\n2 | 20")
+    r = _md("k | y\n1 | 5")
+    j = l.join(r, l.k == r.k).select(x=l.x, y=r.y)
+    s = Session()
+    s.attach_plan_roots([j], sink_meta=[(j, False)])
+    node = s.node_of(j)
+    from pathway_tpu.engine.core import JoinNode
+
+    jn = node if isinstance(node, JoinNode) else None
+    if jn is None or jn.id_mode != "cheap":
+        pytest.skip("join id elision preconditions not met in this leg")
+    s.n_workers = 4  # the tamper: cheap keys reshard under exchanges
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    assert "multi-worker" in str(ei.value)
+
+
+# -------------------------------------- violation: iterate scopes
+
+
+def _iterate_session():
+    def step(t):
+        return {"t": t.select(a=pw.if_else(t.a >= 100, t.a, t.a * 10))}
+
+    t = _md("a\n2\n3").with_id_from(pw.this.a)
+    res = pw.iterate(step, t=t)
+    s = Session()
+    s.attach_plan_roots([res], sink_meta=[(res, True)])
+    s.capture(res)
+    from pathway_tpu.engine.runtime import IterateNode
+
+    it = next(n for n in s.graph.nodes if isinstance(n, IterateNode))
+    return s, it
+
+
+def test_iterate_capture_without_demotion_ladder_fails():
+    s, it = _iterate_session()
+    if not it._tok:
+        pytest.skip("token-resident iterate is off in this leg")
+    verifier.verify_session(s)
+    next(iter(it.captures.values())).on_demote = None  # the tamper
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    assert "demotion ladder" in str(ei.value)
+    assert "IterateNode" in str(ei.value)
+
+
+def test_iterate_body_with_sink_fails():
+    s, it = _iterate_session()
+    from pathway_tpu.engine.runtime import OutputNode
+
+    # the tamper: a sink planted inside the fixpoint body
+    OutputNode(it.sub_graph, it.sub_graph.nodes[0], lambda t, e: None)
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    assert "OutputNode" in str(ei.value)
+    assert "per round" in str(ei.value)
+
+
+# --------------------------------- violation: exactly-once outbox
+
+
+def test_persistent_sink_without_outbox_fails(monkeypatch):
+    monkeypatch.delenv("PATHWAY_EXACTLY_ONCE", raising=False)
+    t = _md("a\n1\n2")
+    s = Session()
+    s.attach_plan_roots([t], sink_meta=[(t, False)])
+    s.output(t, lambda time, entries: None)
+    verifier.verify_session(s)  # no persistence: direct writes are fine
+    # the tamper: persistence + streaming connectors, outbox never armed
+    s.checkpointer = object()
+    s.connectors = [object()]
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    assert "OutputNode" in str(ei.value)
+    assert "DIRECTLY" in str(ei.value)
+
+
+def test_outbox_armed_without_contract_fails(monkeypatch):
+    monkeypatch.delenv("PATHWAY_EXACTLY_ONCE", raising=False)
+    t = _md("a\n1")
+    s = Session()
+    s.attach_plan_roots([t], sink_meta=[(t, False)])
+    s.output(t, lambda time, entries: None)
+    from pathway_tpu.engine.runtime import OutputNode
+
+    node = next(n for n in s.graph.nodes if isinstance(n, OutputNode))
+    node._outbox = object()  # the tamper: no persistence to seal it
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    assert "outbox armed without" in str(ei.value)
+
+
+# ------------------------------- violation: native program schema
+
+
+def test_tampered_native_program_schema_fails(tmp_path):
+    if not _native_available():
+        pytest.skip("fused native programs need the native dataplane")
+    inp = tmp_path / "prog.jsonl"
+    with open(inp, "w") as f:
+        for i in range(20):
+            f.write('{"v": %d}\n' % i)
+
+    class S(pw.Schema):
+        v: int
+
+    t = pw.io.fs.read(os.fspath(inp), format="json", schema=S, mode="static")
+    out = t.select(w=pw.this.v * 2).filter(pw.this.w > 4)
+    s = Session()
+    s.attach_plan_roots([out], sink_meta=[(out, True)])
+    s.capture(out)
+    from pathway_tpu.engine.core import FusedRowwiseNode
+
+    fused = next(
+        (
+            n for n in s.graph.nodes
+            if isinstance(n, FusedRowwiseNode) and n._program is not None
+        ),
+        None,
+    )
+    if fused is None:
+        pytest.skip("no fused native program in this leg")
+    verifier.verify_session(s)
+    fused._program["needed_src"] = [99]  # the tamper: phantom column
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    assert "needed_src" in str(ei.value)
+    assert "FusedRowwiseNode" in str(ei.value)
+
+
+# ---------------------------------- violation: exchange donation
+
+
+def test_donating_layout_planner_on_multi_round_wave_fails(monkeypatch):
+    from pathway_tpu.parallel import exchange
+
+    monkeypatch.setattr(
+        exchange, "plan_respill_layout",
+        lambda capacity, max_bucket, per, n_shards: (True, 4, 2, 20),
+    )
+    t = _md("a\n1")
+    s = Session()
+    s.attach_plan_roots([t], sink_meta=[(t, True)])
+    s.capture(t)
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    assert "donated" in str(ei.value)
+    assert "round" in str(ei.value)
+
+
+def test_check_donation_guard_rules():
+    verifier.check_donation(False, 7)  # undonated multi-round: fine
+    verifier.check_donation(True, 1, 10, 2, 4)  # 2*(4+1)=10: fine
+    with pytest.raises(verifier.PlanVerificationError):
+        verifier.check_donation(True, 2)
+    with pytest.raises(verifier.PlanVerificationError):
+        verifier.check_donation(True, 1, 11, 2, 4)  # layout mismatch
+
+
+# ------------------------------------------------- strict / escalation
+
+
+def test_strict_escalates_warnings(monkeypatch):
+    s, fused, _mid = _fused_session()
+    s._plan_roots = []  # fused nodes without recorded roots -> warning
+    verdict = verifier.verify_session(s)
+    assert verdict["warnings"], "expected a warning verdict"
+    monkeypatch.setenv("PATHWAY_VERIFY", "strict")
+    with pytest.raises(verifier.PlanVerificationError):
+        verifier.verify_session(s)
+
+
+def test_execute_raises_and_publishes_verdict():
+    """The seam itself: a violating plan fails at Session.execute, and
+    the failing verdict still lands in planner.last_report()."""
+    s, fused, mid = _fused_session()
+    s._plan_roots.append(mid)
+    with pytest.raises(verifier.PlanVerificationError):
+        s.execute()
+    rep = planner.last_report()
+    assert rep["verify"]["violations"]
+
+
+# ------------------------------------------------------- A/B identity
+
+
+def test_verify_on_is_byte_identical_to_off(tmp_path):
+    script = tmp_path / "ab.py"
+    script.write_text(
+        """
+import os, sys
+import pathway_tpu as pw
+
+class S(pw.Schema):
+    k: str
+    v: int
+
+t = pw.io.fs.read(sys.argv[1], format="json", schema=S, mode="static")
+t2 = t.select(k=pw.this.k, w=pw.this.v * 3)
+t3 = t2.filter(pw.this.w % 2 == 0)
+agg = t3.groupby(t3.k).reduce(t3.k, s=pw.reducers.sum(t3.w))
+pw.io.csv.write(agg, sys.argv[2])
+pw.run()
+"""
+    )
+    inp = tmp_path / "ab.jsonl"
+    with open(inp, "w") as f:
+        for i in range(500):
+            f.write('{"k": "g%d", "v": %d}\n' % (i % 7, i))
+    outs = {}
+    for flag in ("1", "0"):
+        out = tmp_path / f"ab_{flag}.csv"
+        env = {
+            **os.environ, "JAX_PLATFORMS": "cpu", "PATHWAY_VERIFY": flag,
+            "PYTHONPATH": REPO,
+        }
+        r = subprocess.run(
+            [sys.executable, os.fspath(script), os.fspath(inp),
+             os.fspath(out)],
+            env=env, capture_output=True, text=True, timeout=240,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        outs[flag] = out.read_bytes()
+    assert outs["1"] == outs["0"], (
+        "PATHWAY_VERIFY=1 must be byte-identical to =0 on a passing plan"
+    )
