@@ -1931,15 +1931,26 @@ class Session:
         # runtime exists — a violated plan raises here instead of
         # corrupting data mid-run. PATHWAY_VERIFY=0 skips, =strict
         # escalates warnings; the verdict rides the published report.
+        from pathway_tpu.internals import observability as _obs
         from pathway_tpu.internals import verifier as _verifier
 
         if _verifier.refresh_enabled():
+            import time as _time_mod
+
+            _v_t0 = _time_mod.perf_counter()
             try:
                 rep["verify"] = _verifier.verify_session(self)
             except _verifier.PlanVerificationError as e:
                 rep["verify"] = e.verdict
                 _planner.publish_report(rep)
                 raise
+            finally:
+                # the verifier is part of the build: attribute its wall
+                # to its own profiler stage instead of "unattributed"
+                if _obs.PLANE is not None:
+                    _obs.PLANE.stage_seconds(
+                        "verify", _time_mod.perf_counter() - _v_t0
+                    )
         else:
             rep["verify"] = {"mode": "off"}
         _planner.publish_report(rep)
