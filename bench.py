@@ -376,6 +376,37 @@ pw.run()
 print("ROWS_PER_SEC", {n} / (time.time() - t0))
 """
 
+# Megakernel accounting rung: same wordcount, but reports host dispatches
+# per wave from the graph counters (docs/megakernel.md). The subscribe
+# hook is how the script reaches the session after pw.run returns; it
+# flips id observability, which changes key derivation but not the
+# dispatch accounting being measured.
+_WORDCOUNT_CONE_SCRIPT = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import pathway_tpu as pw
+from pathway_tpu.internals import planner
+from pathway_tpu.internals import run as run_mod
+
+class S(pw.Schema):
+    word: str
+
+t = pw.io.fs.read({inp!r}, format="json", schema=S, mode="static")
+res = t.groupby(t.word).reduce(t.word, count=pw.reducers.count())
+pw.io.csv.write(res, {out!r})
+holder = {{}}
+pw.io.subscribe(res, on_end=lambda: holder.update(s=run_mod.current_session()))
+pw.run()
+g = holder["s"].graph
+cones = planner.last_report()["megakernel"]["cones"]
+print(
+    "CONE_DISPATCHES",
+    g.dispatch_count / max(g.wave_count, 1),
+    sum(c["cone_fires"] for c in cones),
+    sum(c["fallback_fires"] for c in cones),
+)
+"""
+
 _JOIN_SCRIPT = r"""
 import sys, time
 sys.path.insert(0, {repo!r})
@@ -961,6 +992,35 @@ def bench_dataflow(repo: str) -> dict:
             ),
             1,
         )
+        # megakernel accounting: dispatches per steady-state wave must be
+        # O(1) in the cone's member count — the acceptance counter for
+        # the single-dispatch wave cone (docs/megakernel.md)
+        cone_script = _WORDCOUNT_CONE_SCRIPT.format(
+            repo=repo, inp=winp, out=os.path.join(tmp, "wc_cone_out.csv"),
+        )
+        try:
+            env = dict(os.environ)
+            env.update({"PATHWAY_THREADS": "1", "JAX_PLATFORMS": "cpu"})
+            env.setdefault("JAX_COMPILATION_CACHE_DIR", _XLA_CACHE)
+            r = subprocess.run(
+                [sys.executable, "-c", cone_script],
+                capture_output=True, text=True, env=env, timeout=1800,
+            )
+            line = next(
+                l for l in r.stdout.splitlines()
+                if l.startswith("CONE_DISPATCHES")
+            )
+            _tag, per_wave, fires, fallbacks = line.split()
+            out["wordcount_cone_dispatches_per_wave"] = round(
+                float(per_wave), 3
+            )
+            out["wordcount_cone_fires"] = int(fires)
+            out["wordcount_cone_fallback_fires"] = int(fallbacks)
+        except (StopIteration, RuntimeError, ValueError, OSError) as e:
+            out["wordcount_cone_dispatches_per_wave"] = None
+            out["wordcount_cone_fires"] = None
+            out["wordcount_cone_fallback_fires"] = None
+            out["wordcount_cone_skip_reason"] = f"failed: {e}"
         # observability overhead rung: the same wordcount with the full
         # instrumentation plane on (wave tracing + metrics + flight
         # ring). Acceptance: <10% enabled; the disabled cost IS the

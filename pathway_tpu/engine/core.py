@@ -335,6 +335,14 @@ class Node:
         # two GroupByNodes distinguishable in the TUI, logs and metrics
         self.label: str | None = None
 
+    # Wave-cone membership (engine/cone.py): a cone HEAD keeps `_cone`
+    # set and fires the whole cone at its topo slot; absorbed interior
+    # members are skipped by Graph.step but stay live — fallback waves,
+    # persistence and Graph.end still drive them directly. Class-level
+    # defaults keep the common case attribute-read-only.
+    _cone = None
+    _cone_absorbed = False
+
     def describe(self) -> str:
         """Human identity for monitors/metrics: type, plan label, call
         site when known, and the node id."""
@@ -478,6 +486,11 @@ class Node:
         return outs
 
 
+# dispatch-count buckets: wave dispatches are small integers (operator
+# counts), not latencies — the default latency buckets would flatten them
+_WAVE_DISPATCH_BOUNDS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+
+
 class Graph:
     """Owns nodes in topological (creation) order."""
 
@@ -489,6 +502,12 @@ class Graph:
         # (engine/frontier.py); operators may consult it for their input
         # frontier (e.g. the iterate scope). None under the static pump.
         self.scheduler = None
+        # installed wave cones (engine/cone.py) + the host-dispatch
+        # meter behind the O(1)-dispatches-per-wave claim: a cone fire
+        # is ONE dispatch where the per-node plan pays one per member
+        self._cones: list = []
+        self.wave_count = 0
+        self.dispatch_count = 0
 
     def register(self, node: Node) -> int:
         self.nodes.append(node)
@@ -503,13 +522,30 @@ class Graph:
         from time import perf_counter_ns
 
         plane = _obs.PLANE
+        dispatches = 0
         for node in self.nodes:
+            if node._cone_absorbed:
+                continue  # the head's cone fire covers this member
+            cone = node._cone
             t0 = perf_counter_ns()
-            node.finish_time(time)
+            if cone is not None:
+                dispatches += cone.fire(time)
+            else:
+                node.finish_time(time)
+                dispatches += 1
             elapsed = perf_counter_ns() - t0
             node.time_ns += elapsed
             if plane is not None:
                 plane.wave(node, time, elapsed)
+        self.wave_count += 1
+        self.dispatch_count += dispatches
+        if plane is not None:
+            plane.metrics.observe(
+                "pathway_wave_dispatches",
+                float(dispatches),
+                bounds=_WAVE_DISPATCH_BOUNDS,
+                help="host dispatches per wave (cone fire = 1)",
+            )
 
     def end(self, time: int) -> None:
         # per node: drain buffered input FIRST, then end-of-stream hooks —
@@ -517,9 +553,14 @@ class Graph:
         # flush, delivered via topo order) before its on_end closes the
         # file. Upstream on_end emissions still precede every downstream
         # node's finish_time because nodes run in topological order.
+        # Cone heads drain through their cone first so late segments keep
+        # cone semantics; the members' own finish_time/on_end still run
+        # (no-ops once drained) — absorbed nodes are NOT skipped here.
         plane = _obs.PLANE
         if plane is None:
             for node in self.nodes:
+                if node._cone is not None:
+                    node._cone.fire(time)
                 node.finish_time(time)
                 node.on_end(time)
             return
@@ -527,6 +568,8 @@ class Graph:
 
         for node in self.nodes:
             t0 = perf_counter_ns()
+            if node._cone is not None:
+                node._cone.fire(time)
             node.finish_time(time)
             node.on_end(time)
             # record the end-flush span for the profiler/histograms but
@@ -3449,16 +3492,20 @@ class GroupByNode(Node):
             )
         self.emit(time, out)
 
-    def _finish_native_batch(self, time: int, batch) -> bool:
-        """Token-resident wave: group projection, arg decode and the
-        semigroup aggregation all run in C/numpy; Python appears only for
-        the affected groups' output rows. Returns False when the batch
-        can't be handled (caller materializes)."""
+    def _prepare_native_batch(self, batch, gtok=None):
+        """Pure half of the token-resident wave: group projection + arg
+        decode, no state touched. Returns (gtok, vals_i, vals_f, tags)
+        or None when the plan can't judge the batch (caller falls back
+        with nothing applied). `gtok` may be supplied by a caller that
+        already projected the group columns — the wave cone's sharded
+        path shares ONE projection between exchange routing and the
+        groupby update (engine/cone.py)."""
         plan = self._plan
-        res = self._dp.project_group(self._tab, batch.token, plan["gb_cols"])
-        if res is None:
-            return False
-        gtok = res[0]
+        if gtok is None:
+            res = self._dp.project_group(self._tab, batch.token, plan["gb_cols"])
+            if res is None:
+                return None
+            gtok = res[0]
         n = len(batch)
         n_red = len(self.reducers)
         # decode every distinct arg column once
@@ -3469,7 +3516,7 @@ class GroupByNode(Node):
         )
         decoded = decode_cols_dict(self._dp, self._tab, batch.token, need_cols)
         if decoded is None:
-            return False
+            return None
         vals_i = np.zeros((n_red, n), np.int64)
         vals_f = np.zeros((n_red, n), np.float64)
         tags = np.zeros((n_red, n), np.uint8)
@@ -3485,6 +3532,17 @@ class GroupByNode(Node):
             vals_i[ri] = vi
             vals_f[ri] = vf
             tags[ri] = tg
+        return gtok, vals_i, vals_f, tags
+
+    def _finish_native_batch(self, time: int, batch) -> bool:
+        """Token-resident wave: group projection, arg decode and the
+        semigroup aggregation all run in C/numpy; Python appears only for
+        the affected groups' output rows. Returns False when the batch
+        can't be handled (caller materializes)."""
+        prep = self._prepare_native_batch(batch)
+        if prep is None:
+            return False
+        gtok, vals_i, vals_f, tags = prep
         g_ids, totals, isum, fsum, cnts, flags = self._native.update(
             gtok, vals_i, vals_f, tags, np.ascontiguousarray(batch.diff)
         )

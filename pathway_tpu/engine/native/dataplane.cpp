@@ -764,6 +764,25 @@ int64_t dp_ingest_jsonl(void* h, const char* data, int64_t len, int64_t n_cols,
     std::vector<std::string> pieces(static_cast<size_t>(n_cols));
     std::vector<uint8_t> have(static_cast<size_t>(n_cols));
     std::string row_bytes, name;
+    // Line-level dictionary: identical raw lines parse to identical row
+    // bytes (and, for pk sources, identical content keys), so repeats
+    // skip the whole JSON walk. Low-cardinality ingest — a grouped value
+    // column, enum-ish event streams — collapses to one parse per
+    // distinct line. Keys into the map are views of the input buffer
+    // (stable for this call). High-cardinality data pays one hash probe
+    // per line until the hit-rate check at MEMO_PROBE lines turns the
+    // memo off; inserts stop at MEMO_CAP so adversarial input can't
+    // balloon the map.
+    struct LineMemo {
+        std::string row;
+        uint8_t status;
+        uint64_t klo, khi;
+    };
+    constexpr int64_t MEMO_PROBE = 8192;
+    constexpr size_t MEMO_CAP = 1 << 16;
+    std::unordered_map<std::string_view, LineMemo> memo;
+    bool memo_on = true;
+    int64_t memo_seen = 0, memo_hits = 0;
     int64_t n_lines = 0;
     const char* p = data;
     const char* end = data + len;
@@ -783,6 +802,32 @@ int64_t dp_ingest_jsonl(void* h, const char* data, int64_t len, int64_t n_cols,
         if (q == le) {
             out_status[i] = 2;
             continue;
+        }
+        if (memo_on) {
+            ++memo_seen;
+            auto mit = memo.find(
+                std::string_view(ls, static_cast<size_t>(le - ls)));
+            if (mit != memo.end()) {
+                ++memo_hits;
+                const LineMemo& m = mit->second;
+                out_status[i] = m.status;
+                if (m.status == 0) {
+                    pend.add(m.row, i);
+                    if (n_pk > 0) {
+                        out_lo[i] = m.klo;  // content key: line-determined
+                        out_hi[i] = m.khi;
+                    } else {
+                        row_key(nullptr, nullptr, 0, seq_base,
+                                seq_start + static_cast<uint64_t>(i),
+                                key_mode, &out_lo[i], &out_hi[i]);
+                    }
+                }
+                continue;
+            }
+            if (memo_seen == MEMO_PROBE && memo_hits * 8 < memo_seen) {
+                memo_on = false;
+                memo.clear();
+            }
         }
         JsonCursor c{ls, le};
         std::fill(have.begin(), have.end(), 0);
@@ -839,6 +884,10 @@ int64_t dp_ingest_jsonl(void* h, const char* data, int64_t len, int64_t n_cols,
         }
         if (!ok) {
             out_status[i] = 1;
+            if (memo_on && memo.size() < MEMO_CAP)
+                memo.emplace(
+                    std::string_view(ls, static_cast<size_t>(le - ls)),
+                    LineMemo{std::string(), 1, 0, 0});
             continue;
         }
         row_bytes.clear();
@@ -851,6 +900,9 @@ int64_t dp_ingest_jsonl(void* h, const char* data, int64_t len, int64_t n_cols,
                 seq_start + static_cast<uint64_t>(i), key_mode, &out_lo[i],
                 &out_hi[i]);
         out_status[i] = 0;
+        if (memo_on && memo.size() < MEMO_CAP)
+            memo.emplace(std::string_view(ls, static_cast<size_t>(le - ls)),
+                         LineMemo{row_bytes, 0, out_lo[i], out_hi[i]});
     }
     pend.intern_all(tab, out_token);
     return n_lines;
@@ -1142,12 +1194,21 @@ int64_t dp_project_group(void* h, int64_t n, const uint64_t* tokens,
     // under one short lock at the end.
     std::string blob, gbytes, canon;
     std::unordered_map<std::string_view, int64_t> local;  // gbytes -> gid
+    // token -> gid short-circuit: a token names one immutable row, so its
+    // projection is fixed; low-cardinality batches (e.g. a single grouped
+    // value column) skip the decode+hash for every repeat.
+    std::unordered_map<uint64_t, int64_t> tok2gid;
     std::vector<std::pair<int64_t, int64_t>> spans;       // gid -> span
     std::vector<int64_t> shard_of_gid;
     std::vector<int64_t> gid_of_row(static_cast<size_t>(n));
     blob.reserve(1024);
     std::shared_lock<std::shared_mutex> rg(tab->mu);
     for (int64_t i = 0; i < n; ++i) {
+        auto memo = tok2gid.find(tokens[i]);
+        if (memo != tok2gid.end()) {
+            gid_of_row[static_cast<size_t>(i)] = memo->second;
+            continue;
+        }
         const char* row;
         int64_t rlen;
         if (!tab->get(tokens[i], &row, &rlen) ||
@@ -1163,6 +1224,7 @@ int64_t dp_project_group(void* h, int64_t n, const uint64_t* tokens,
         }
         if (forbidden) {
             gid_of_row[static_cast<size_t>(i)] = -1;
+            tok2gid.emplace(tokens[i], -1);
             continue;
         }
         auto it = local.find(std::string_view(gbytes));
@@ -1204,6 +1266,7 @@ int64_t dp_project_group(void* h, int64_t n, const uint64_t* tokens,
             }
         }
         gid_of_row[static_cast<size_t>(i)] = gid;
+        tok2gid.emplace(tokens[i], gid);
     }
     rg.unlock();
     std::vector<uint64_t> gtok(spans.size());

@@ -221,6 +221,15 @@ class Runtime:
     def _make_scheduler(self):
         from pathway_tpu.engine.frontier import FrontierScheduler
 
+        if getattr(self.graph, "_cones", None):
+            # the frontier scheduler fires finish_time per node and
+            # stashes emissions per slot — an installed cone would never
+            # fire there. Dissolve loudly (plan report + flight event)
+            # so the fallback to per-node dispatch is visible, never
+            # silent (engine/cone.py).
+            from pathway_tpu.engine.cone import dissolve_cones
+
+            dissolve_cones(self.graph, "frontier-scheduler")
         sched = FrontierScheduler(self.graph, monitors=self.monitors)
         self.scheduler = sched
         self.graph.scheduler = sched

@@ -1926,6 +1926,19 @@ class Session:
         if self.plan_ctx is not None:
             rep["elision"]["sources"] = len(self.plan_ctx.cheap_key_sources)
             rep["elision"]["joins"] = len(self.plan_ctx.cheap_id_joins)
+        # wave cones (engine/cone.py): installed BEFORE the verifier so
+        # check_cone_contract re-proves every cone ahead of any compile.
+        # PATHWAY_MEGAKERNEL=0 skips installation — the per-node fused
+        # plan runs byte-identically. Mesh sessions never install: the
+        # mesh pump owns cross-process wave pacing.
+        if _planner.megakernel_enabled() and self.mesh is None:
+            from pathway_tpu.engine.cone import install_cones
+
+            install_cones(self)
+        else:
+            rep["megakernel"] = {
+                "enabled": False, "cones": [], "dissolved": None,
+            }
         # plan verifier (internals/verifier.py): re-derive every
         # optimizer-assumed invariant over the built plan BEFORE the
         # runtime exists — a violated plan raises here instead of

@@ -466,6 +466,22 @@ class Profiler:
             "ingest_share": round(ingest_total / total, 4) if total > 0 else 0.0,
             "stages": stages,
             "operators": operators,
+            # the O(1)-dispatch claim, measured: host dispatches per
+            # lockstep wave (a cone fire counts 1, a fallback wave its
+            # member count — docs/megakernel.md)
+            **(
+                {
+                    "wave_dispatches": {
+                        "waves": graph.wave_count,
+                        "dispatches": graph.dispatch_count,
+                        "per_wave_mean": round(
+                            graph.dispatch_count / graph.wave_count, 3
+                        ),
+                    }
+                }
+                if graph is not None and getattr(graph, "wave_count", 0)
+                else {}
+            ),
             # plan visibility: the optimizer's decisions for this run
             # (fusion groups, pushdowns, join-order advice, replans) —
             # see docs/planner.md

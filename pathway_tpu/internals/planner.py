@@ -74,6 +74,14 @@ def adaptive_enabled() -> bool:
     return os.environ.get("PATHWAY_ADAPTIVE", "1") != "0"
 
 
+def megakernel_enabled() -> bool:
+    """Wave-cone gate: PATHWAY_MEGAKERNEL=0 skips cone installation so
+    the graph executes the per-node fused plan byte-identically
+    (A/B-pinned by the megakernel-off leg). Read once at the lowering
+    seam (Session.execute), never per wave."""
+    return os.environ.get("PATHWAY_MEGAKERNEL", "1") != "0"
+
+
 # ------------------------------------------------------------ last report
 
 _LAST_REPORT: dict | None = None
@@ -410,6 +418,93 @@ def _swap_join_spec(spec) -> None:
     spec.params["mode"] = {"left": "right", "right": "left"}.get(mode, mode)
 
 
+# ------------------------------------------------------------- wave cones
+
+
+def find_cone_chains(graph) -> list[tuple]:
+    """Identify wave cones on a lowered graph: scan source → optional
+    fused rowwise run → bucketized groupby update (bare or sharded over
+    the column-plane exchange). Returns (head, fused_or_None, target)
+    triples; engine/cone.py installs them and the verifier's
+    cone-contract check re-proves each one before any compile.
+
+    Eligibility is deliberately strict — everything here is a condition
+    the cone's byte-identity proof needs (docs/megakernel.md):
+
+    * single-consumer interior: each member feeds ONLY the next member
+      (one downstream edge, next member's sole input) — a second
+      consumer would observe the head's merged emission the cone never
+      builds;
+    * the fused run must be a pure native program (no stateful
+      suppression, no rekey, object stages only as the per-row BAD
+      fallback) — stateful emission depends on cross-wave state the
+      per-segment replay would order differently;
+    * the target must hold a native groupby plan (plan-mode
+      `GroupByNode`); a sharded target additionally needs the
+      group-column native route so the exchange pack and the update can
+      share one projection.
+    """
+    from pathway_tpu.engine.core import (
+        FusedRowwiseNode,
+        GroupByNode,
+        InputNode,
+    )
+    from pathway_tpu.engine.workers import ShardedNode
+
+    def _live_single_consumer(node):
+        if len(node.downstream) != 1:
+            return None
+        nxt = node.downstream[0][0]
+        if getattr(nxt, "_replaced", False) or nxt._cone_absorbed:
+            return None
+        if len(nxt.inputs) != 1 or nxt.inputs[0] is not node:
+            return None
+        return nxt
+
+    def _plan_mode_groupby(node) -> bool:
+        return (
+            isinstance(node, GroupByNode)
+            and node._native is not None
+            and node._plan is not None
+        )
+
+    chains: list[tuple] = []
+    for head in graph.nodes:
+        if type(head) is not InputNode:
+            continue
+        if head._cone is not None or head._cone_absorbed:
+            continue
+        cur = _live_single_consumer(head)
+        if cur is None:
+            continue
+        fused = None
+        if isinstance(cur, FusedRowwiseNode):
+            if (
+                cur._program is None
+                or cur._stateful
+                or cur.rekey is not None
+                or getattr(cur, "_replaced", False)
+            ):
+                continue
+            nxt = _live_single_consumer(cur)
+            if nxt is None:
+                continue
+            fused, cur = cur, nxt
+        target = cur
+        if isinstance(target, ShardedNode):
+            if len(target.inputs) != 1:
+                continue
+            route = target.native_routes[0]
+            if route is None or route[0] != "group":
+                continue
+            if not all(_plan_mode_groupby(r) for r in target.replicas):
+                continue
+        elif not _plan_mode_groupby(target):
+            continue
+        chains.append((head, fused, target))
+    return chains
+
+
 # ---------------------------------------------------------------- report
 
 
@@ -503,6 +598,15 @@ class AdaptivePolicy:
         changes += self._retune_exchange(plane)
         if changes and scheduler is not None:
             scheduler.replan_refresh()
+        if changes:
+            # adaptive re-fusion changes the live plan after the static
+            # report was published — refresh the node count so
+            # /statistics and last_report() describe what is running,
+            # not the plan as lowered
+            self.report["nodes_after"] = sum(
+                1 for n in self.graph.nodes
+                if not getattr(n, "_replaced", False)
+            )
         return changes
 
     # ------------------------------------------------------- re-fusion
@@ -535,6 +639,8 @@ class AdaptivePolicy:
         for node in list(self.graph.nodes):
             if not isinstance(node, fusible) or getattr(node, "_replaced", False):
                 continue
+            if node._cone_absorbed or node._cone is not None:
+                continue  # cone members fire through the cone, not alone
             # start of a linear stateless run: single live downstream
             # that is also fusible, whose only input is this node
             chain = [node]
@@ -548,6 +654,8 @@ class AdaptivePolicy:
                     break
                 nxt = downs[0]
                 if len(nxt.inputs) != 1 or any(b for b in nxt.buffers):
+                    break
+                if nxt._cone_absorbed:
                     break
                 chain.append(nxt)
                 cur = nxt

@@ -46,6 +46,13 @@ Checks (each independent of the code it audits; see the matching
   byte-matching ``n_shards * (cap + 1)`` layout (aliasing on a
   multi-round wave would corrupt round 2+). The same rule guards the
   live decision via :func:`check_donation`.
+* ``cone-contract`` — every installed wave cone (engine/cone.py) is
+  re-proved before any compile: single-consumer interior (each member
+  feeds ONLY the next member — a second consumer would observe the
+  merged emission the cone elides), donation only on single-round
+  layouts, byte-matching staging-buffer schema (4 u64 lanes per row;
+  the interior program re-passes the native-program schema check), and
+  absorbed-flag consistency with ``Graph.step``'s skip rule.
 """
 
 from __future__ import annotations
@@ -790,6 +797,75 @@ def check_exchange_donation(session, v: _Verdict, shared: dict) -> None:
     _DONATION_PROBED_FN = plan
 
 
+# ----------------------------------------------- check: cone contract
+
+
+def check_cone_contract(session, v: _Verdict, shared: dict) -> None:
+    """Re-prove every installed wave cone's contract (engine/cone.py)
+    BEFORE any compile: a cone that fires one merged program instead of
+    per-node waves is only sound when no third party can observe the
+    emissions it elides and its donated buffers can actually alias."""
+    check = "cone-contract"
+    v.start(check)
+    cones = getattr(session.graph, "_cones", None) or []
+    v.report["checks"][check]["cones"] = len(cones)
+    if not cones:
+        return
+    for cone in cones:
+        name = cone.head.describe()
+        # single-consumer interior: each member feeds ONLY the next one
+        for m, nxt in zip(cone.members[:-1], cone.members[1:]):
+            downs = [d for d, _i in m.downstream]
+            if len(downs) != 1 or downs[0] is not nxt:
+                v.violation(
+                    check,
+                    f"{name}: multi-consumer interior — {m.describe()} "
+                    f"feeds {len(downs)} consumer(s); a cone member may "
+                    "feed only the next member (any other consumer "
+                    "would observe the per-node emission the cone "
+                    "elides)",
+                )
+        prog = cone.program
+        rounds = prog.get("rounds", 1)
+        if prog.get("donation", "none") != "none" and rounds != 1:
+            v.violation(
+                check,
+                f"{name}: donation on a multi-round layout "
+                f"({rounds} rounds) — the donated staging buffers alias "
+                "the receive buffers and would corrupt every round "
+                "after the first (same rule as check_donation)",
+            )
+        if prog.get("lanes") != 4:
+            v.violation(
+                check,
+                f"{name}: schema-mismatched staging buffer — "
+                f"{prog.get('lanes')} lanes declared, the exchange pack "
+                "ships exactly 4 u64 lanes per row (key_lo, key_hi, "
+                "token, diff); send/receive byte sizes must match for "
+                "XLA to alias them",
+            )
+        interior = prog.get("interior")
+        if interior is not None:
+            for problem in _validate_program(interior):
+                v.violation(
+                    check, f"{name}: interior program schema: {problem}"
+                )
+        for m in cone.members[1:]:
+            if not m._cone_absorbed:
+                v.violation(
+                    check,
+                    f"{name}: {m.describe()} is a cone member but not "
+                    "absorbed — Graph.step would fire it a second time "
+                    "on top of the cone's fire",
+                )
+        if cone.head._cone is not cone:
+            v.violation(
+                check,
+                f"{name}: head does not point back at its cone — the "
+                "cone would never fire while its members stay absorbed",
+            )
+
+
 # ---------------------------------------------------------------- driver
 
 _CHECKS = (
@@ -799,6 +875,7 @@ _CHECKS = (
     check_exactly_once_outbox,
     check_native_programs,
     check_exchange_donation,
+    check_cone_contract,
 )
 
 

@@ -6,33 +6,43 @@ external/timely-dataflow/communication/, SURVEY.md §2.2). The TPU-native
 equivalent keeps a host control plane but moves the numeric data plane onto
 the chip interconnect: records are bucketized by key hash in XLA and shuffled
 with `all_to_all` over the mesh (ICI intra-pod, DCN across pods).
+
+The package namespace is lazy (PEP 562): importing `pathway_tpu.parallel`
+must NOT pull in jax, because every Session imports `process_mesh` (a
+pure-socket module) and mesh-less pipelines would otherwise pay the whole
+jax-ecosystem import on their first wave. The jax version shim
+(`jax_compat.install()`, required before any submodule builds a sharded
+program) runs inside exchange.py itself — the one submodule that calls
+`shard_map` — and again at first attribute access here.
 """
 
-# jax version shims (jax.shard_map on old releases) before any
-# submodule builds a sharded program
-from pathway_tpu.internals import jax_compat as _jax_compat
+_EXPORTS = {
+    "default_mesh": "mesh",
+    "make_mesh": "mesh",
+    "replicate": "mesh",
+    "shard_rows": "mesh",
+    "ExchangeResult": "exchange",
+    "exchange_by_key": "exchange",
+    "partition_counts": "exchange",
+}
 
-_jax_compat.install()
+__all__ = sorted(_EXPORTS)
 
 
-from pathway_tpu.parallel.mesh import (
-    default_mesh,
-    make_mesh,
-    replicate,
-    shard_rows,
-)
-from pathway_tpu.parallel.exchange import (
-    ExchangeResult,
-    exchange_by_key,
-    partition_counts,
-)
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    from pathway_tpu.internals import jax_compat as _jax_compat
 
-__all__ = [
-    "default_mesh",
-    "make_mesh",
-    "replicate",
-    "shard_rows",
-    "ExchangeResult",
-    "exchange_by_key",
-    "partition_counts",
-]
+    _jax_compat.install()
+    import importlib
+
+    mod = importlib.import_module(f"pathway_tpu.parallel.{target}")
+    val = getattr(mod, name)
+    globals()[name] = val  # cache: subsequent access skips __getattr__
+    return val
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -37,6 +37,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pathway_tpu.internals import jax_compat as _jax_compat
+
+# jax.shard_map must resolve on old releases before any program below is
+# built; the package __init__ is lazy and no longer guarantees this ran.
+_jax_compat.install()
+
 Array = jax.Array
 
 
@@ -82,6 +88,20 @@ def _bucketize(keys, payloads, dests, valid_in, n_shards: int, cap: int,
     return bucket_keys, bucket_pay, bucket_valid, overflow
 
 
+# mesh -> small stable token for program names: two distinct meshes with
+# the same axis/shape must NOT share one registered program (the
+# shard_map closes over the mesh). The lru_cache below already keeps a
+# strong ref to every cached mesh, so tokens never alias live meshes.
+_MESH_TOKENS: dict = {}
+
+
+def _mesh_token(mesh: Mesh) -> int:
+    tok = _MESH_TOKENS.get(mesh)
+    if tok is None:
+        tok = _MESH_TOKENS[mesh] = len(_MESH_TOKENS)
+    return tok
+
+
 @functools.lru_cache(maxsize=64)
 def _exchange_program(mesh: Mesh, axis: str, n_shards: int, cap: int,
                       donate: bool = False):
@@ -94,7 +114,15 @@ def _exchange_program(mesh: Mesh, axis: str, n_shards: int, cap: int,
     ``n_shards * (cap + 1)`` per shard — the steady-state single-round
     layout `exchange_with_respill` produces for near-uniform waves. The
     staging memory of wave N is then reused as the receive buffers of the
-    same dispatch instead of accumulating a second copy per wave."""
+    same dispatch instead of accumulating a second copy per wave.
+
+    The jit is owned by the device plane's per-bucket compile ledger
+    (engine/device_plane.py): every dispatch charges bucket ``cap``, so
+    adversarial capacity churn shows up as new (program, bucket) rows
+    while steady-state ragged waves — whose padded shapes are fully
+    determined by (cap, n_shards, lanes) — keep each row pinned at one
+    compilation. A failing XLA dispatch degrades to the eager shard_map
+    host path via the plane's quarantine instead of killing the wave."""
 
     def local(k, p, d, v):
         bk, bp, bv, overflow = _bucketize(k, p, d, v, n_shards, cap, axis)
@@ -119,11 +147,22 @@ def _exchange_program(mesh: Mesh, axis: str, n_shards: int, cap: int,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=(P(axis), P(axis), P(axis), P(axis)),
     )
-    if donate:
-        # dests (arg 2) has no same-dtype output to alias; donating it
-        # would only draw the "unusable donation" warning
-        return jax.jit(mapped, donate_argnums=(0, 1, 3))
-    return jax.jit(mapped)
+    from pathway_tpu.engine.device_plane import get_device_plane
+
+    name = (
+        f"exchange.a2a[{axis}]:s{n_shards}:c{cap}:m{_mesh_token(mesh)}"
+        + (":donated" if donate else "")
+    )
+    # dests (arg 2) has no same-dtype output to alias; donating it
+    # would only draw the "unusable donation" warning
+    prog = get_device_plane().program(
+        name, mapped, donate_argnums=(0, 1, 3) if donate else ()
+    )
+
+    def dispatch(*args):
+        return prog(*args, bucket=cap)
+
+    return dispatch
 
 
 def exchange_by_key(

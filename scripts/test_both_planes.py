@@ -68,6 +68,11 @@ Leg 15 (chaos-quick-lockcheck): the quick chaos drill with the
 lock-order recorder on — crash/recovery generations and fault paths
 must stay cycle-free too (each workload subprocess carries its own
 exit gate).
+Leg 16 (megakernel-off): the engine + plan suites with the wave cone
+killed (PATHWAY_MEGAKERNEL=0) — every wave fires per-node, the
+byte-identity baseline the single-dispatch cone is pinned against
+(docs/megakernel.md); the cone-on side runs inside legs 1-2 and the
+per-pipeline A/B comparisons live in tests/test_megakernel.py.
 
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
@@ -293,6 +298,21 @@ def main() -> int:
                 "tests/test_column_plane.py",
                 "tests/test_parallel.py",
                 "tests/test_workers.py",
+            ],
+        ),
+        # megakernel killed: every wave fires per-node, which is the
+        # byte-identity baseline the cone is pinned against; the
+        # per-pipeline A/B comparisons live in tests/test_megakernel.py
+        # (docs/megakernel.md)
+        run_leg(
+            "megakernel-off", {"PATHWAY_MEGAKERNEL": "0"}, extra,
+            [
+                "tests/test_megakernel.py",
+                "tests/test_native_engine.py",
+                "tests/test_plan_optimizer.py",
+                "tests/test_column_plane.py",
+                "tests/test_io_formats.py",
+                "tests/test_persistence.py",
             ],
         ),
         # static soundness plane (docs/static-analysis.md): the repo

@@ -7,7 +7,6 @@ is what a fresh interpreter would produce, independent of other examples
 (auto-keys hash a process-wide sequence number)."""
 
 import doctest
-import itertools
 
 import pathway_tpu as pw
 from pathway_tpu.internals import keys
@@ -20,7 +19,7 @@ def _run_module_doctests(module) -> None:
     assert tests, f"no doctest examples found in {module.__name__}"
     failures = []
     for test in tests:
-        keys._seq_counter = itertools.count()  # fresh-interpreter key order
+        keys._seq_next = 0  # fresh-interpreter key order
         result = runner.run(test)
         if result.failed:
             failures.append(test.name)

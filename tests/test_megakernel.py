@@ -1,0 +1,365 @@
+"""Megakernel wave-cone tests (tier-1).
+
+The planner identifies a wave cone — scan decode → fused rowwise run →
+groupby update — and fires it as ONE host dispatch per wave
+(engine/cone.py). These tests pin the contract:
+
+- O(1) dispatches per steady-state wave, proven by the
+  ``pathway_wave_dispatches`` counter plumbing (``graph.dispatch_count`` /
+  ``graph.wave_count``), invariant in the fused-chain length;
+- ``PATHWAY_MEGAKERNEL=0`` reproduces outputs byte-identically on the
+  native plane and content-identically on the object plane (including
+  retraction streams and a persistence roundtrip);
+- the per-bucket compile ledger stays bounded under bucket churn;
+- the verifier rejects cone-contract violations BY NAME before compile;
+- ineligible waves degrade to the per-node path and are counted, never
+  silently dropped.
+"""
+
+import json
+import os
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import planner
+from pathway_tpu.internals import run as run_mod
+
+
+class WordSchema(pw.Schema):
+    word: str
+
+
+def _write_jsonl(path, words):
+    with open(path, "w") as f:
+        for w in words:
+            f.write(json.dumps({"word": w}) + "\n")
+
+
+def _wordcount_pipeline(inp, out, n_selects=0):
+    t = pw.io.fs.read(str(inp), format="json", schema=WordSchema, mode="static")
+    for _ in range(n_selects):
+        t = t.select(word=pw.this.word)
+    res = t.groupby(t.word).reduce(t.word, count=pw.reducers.count())
+    pw.io.csv.write(res, str(out))
+    return res
+
+
+def _run_and_grab_graph(res):
+    """Run the registered pipeline; capture the session's graph via a
+    subscribe hook (the run module drops its handle after pw.run)."""
+    holder = {}
+    pw.io.subscribe(
+        res, on_end=lambda: holder.update(s=run_mod.current_session())
+    )
+    pw.run()
+    return holder["s"].graph
+
+
+# ------------------------------------------------- O(1) dispatch
+
+
+def test_cone_fire_is_one_dispatch_per_wave(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_MEGAKERNEL", "1")
+    inp = tmp_path / "wc.jsonl"
+    _write_jsonl(inp, [f"w{i % 50}" for i in range(5000)])
+    res = _wordcount_pipeline(inp, tmp_path / "out.csv")
+    graph = _run_and_grab_graph(res)
+
+    rep = planner.last_report()["megakernel"]
+    assert rep["enabled"] is True and rep["dissolved"] is None
+    [cone] = rep["cones"]
+    assert cone["cone_fires"] >= 1
+    assert cone["fallback_fires"] == 0
+    members = len(cone["members"])
+    assert members >= 2
+    # the cone's members cost ONE dispatch per wave: total dispatches are
+    # wave_count * (live nodes - members + 1), i.e. strictly fewer than a
+    # per-node firing would charge
+    live = sum(
+        1
+        for n in graph.nodes
+        if not getattr(n, "_replaced", False)
+    )
+    assert graph.wave_count >= 1
+    assert graph.dispatch_count == graph.wave_count * (live - members + 1)
+
+
+def test_dispatches_per_wave_invariant_in_chain_length(tmp_path, monkeypatch):
+    """Growing the fused interior must NOT grow host dispatches per wave:
+    the extra stages are absorbed into the same single cone fire."""
+    monkeypatch.setenv("PATHWAY_MEGAKERNEL", "1")
+    per_wave = {}
+    for n_selects in (0, 3):
+        from pathway_tpu.internals.parse_graph import G
+
+        G.clear()
+        inp = tmp_path / f"wc{n_selects}.jsonl"
+        _write_jsonl(inp, [f"w{i % 20}" for i in range(2000)])
+        res = _wordcount_pipeline(
+            inp, tmp_path / f"out{n_selects}.csv", n_selects=n_selects
+        )
+        graph = _run_and_grab_graph(res)
+        per_wave[n_selects] = graph.dispatch_count / graph.wave_count
+        [cone] = planner.last_report()["megakernel"]["cones"]
+        assert cone["cone_fires"] >= 1, n_selects
+    assert per_wave[3] <= per_wave[0]
+
+
+def test_megakernel_off_bypasses_and_counts_every_node(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_MEGAKERNEL", "0")
+    inp = tmp_path / "wc.jsonl"
+    _write_jsonl(inp, [f"w{i % 10}" for i in range(500)])
+    res = _wordcount_pipeline(inp, tmp_path / "out.csv")
+    graph = _run_and_grab_graph(res)
+    rep = planner.last_report()["megakernel"]
+    assert rep == {"enabled": False, "cones": [], "dissolved": None}
+    assert not getattr(graph, "_cones", [])
+    live = sum(1 for n in graph.nodes if not getattr(n, "_replaced", False))
+    assert graph.dispatch_count == graph.wave_count * live
+
+
+# ------------------------------------------------- A/B byte-identity
+
+
+def _run_wordcount_subprocess_free(tmp_path, monkeypatch, mk, tag):
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    monkeypatch.setenv("PATHWAY_MEGAKERNEL", mk)
+    inp = tmp_path / "in.jsonl"
+    if not inp.exists():
+        _write_jsonl(inp, [f"w{(i * 7) % 97}" for i in range(20_000)])
+    out = tmp_path / f"out_{tag}.csv"
+    _wordcount_pipeline(inp, out)
+    pw.run()
+    return out.read_bytes()
+
+def test_native_plane_ab_byte_identity(tmp_path, monkeypatch):
+    on = _run_wordcount_subprocess_free(tmp_path, monkeypatch, "1", "on")
+    off = _run_wordcount_subprocess_free(tmp_path, monkeypatch, "0", "off")
+    assert on == off
+
+
+def _object_plane_counts(monkeypatch, mk):
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    monkeypatch.setenv("PATHWAY_MEGAKERNEL", mk)
+    rows = [
+        # (word, time, diff): w1 is inserted then retracted at t=2 —
+        # the groupby must emit the same retract/insert stream both ways
+        ("w0", 0, 1),
+        ("w1", 0, 1),
+        ("w0", 2, 1),
+        ("w1", 2, -1),
+        ("w2", 4, 1),
+    ]
+    t = pw.debug.table_from_rows(WordSchema, rows, is_stream=True)
+    res = t.groupby(t.word).reduce(t.word, count=pw.reducers.count())
+    _keys, cols = pw.debug.table_to_dicts(res)
+    return {
+        cols["word"][k]: cols["count"][k] for k in cols["word"]
+    }
+
+
+def test_object_plane_retractions_ab_identity(monkeypatch):
+    on = _object_plane_counts(monkeypatch, "1")
+    off = _object_plane_counts(monkeypatch, "0")
+    assert on == off == {"w0": 2, "w2": 1}
+
+
+def test_persistence_roundtrip_ab_identity(tmp_path, monkeypatch):
+    """Checkpoint under one mode, resume under the other: the resumed
+    output must match a cold run — cone state lives in the members, so
+    persistence sees the same node snapshots either way."""
+    outputs = {}
+    for first, second, tag in (("1", "0", "on_off"), ("0", "1", "off_on")):
+        pdir = tmp_path / f"p_{tag}"
+        inp = tmp_path / f"in_{tag}.jsonl"
+        _write_jsonl(inp, [f"w{i % 13}" for i in range(3000)])
+        for leg, mk in (("a", first), ("b", second)):
+            from pathway_tpu.internals.parse_graph import G
+
+            G.clear()
+            monkeypatch.setenv("PATHWAY_MEGAKERNEL", mk)
+            out = tmp_path / f"out_{tag}_{leg}.csv"
+            _wordcount_pipeline(inp, out)
+            pw.run(
+                persistence_config=pw.persistence.Config(
+                    pw.persistence.Backend.filesystem(str(pdir))
+                )
+            )
+            outputs[(tag, leg)] = out.read_bytes()
+    # leg a (cold) wrote the counts; leg b (resume, flipped mode) must not
+    # re-emit differently — both resumes agree with each other
+    assert outputs[("on_off", "a")] == outputs[("off_on", "a")]
+    assert outputs[("on_off", "b")] == outputs[("off_on", "b")]
+
+
+# ------------------------------------------------- compile ledger
+
+
+def test_exchange_compile_ledger_bounded_under_bucket_churn():
+    """Churning wave shapes through the donated exchange must charge the
+    per-bucket ledger once per (program, bucket), not once per wave."""
+    import numpy as np
+
+    from pathway_tpu.engine.device_plane import get_device_plane
+    from pathway_tpu.parallel import make_mesh
+    from pathway_tpu.parallel.exchange import exchange_with_respill
+
+    mesh = make_mesh((2,), ("data",))
+
+    def wave(n):
+        # deterministic per shape: the same wave shape must hit the same
+        # ledger bucket every time it recurs
+        rng = np.random.default_rng(n)
+        ids = (np.arange(n) & 0xFFFFFFFF).astype(np.uint32)
+        pay = rng.standard_normal((n, 4)).astype(np.float32)
+        dests = rng.integers(0, 2, n)
+        exchange_with_respill(ids, pay, dests, mesh, "data")
+
+    def ledger():
+        return {
+            k: v
+            for k, v in get_device_plane().compile_counts().items()
+            if k[0].startswith("exchange.a2a")
+        }
+
+    # the ledger is shared plane-wide state: other suites may already
+    # have charged exchange.a2a entries, so assert on the delta only
+    baseline = ledger()
+    shapes = [64, 128, 64, 256, 128, 64, 256, 64, 128, 256]
+    for n in shapes:
+        wave(n)
+    after_first = ledger()
+    charged = {
+        k: v for k, v in after_first.items() if v != baseline.get(k)
+    }
+    # bounded: at most one new ledger entry per distinct wave shape, not
+    # one per wave (zero is fine only if an earlier suite already
+    # compiled these exact buckets — then replay below still pins it)
+    assert len(charged) <= len(set(shapes))
+    assert after_first != baseline or len(baseline) > 0
+    # steady state: replaying the same shape churn charges nothing new
+    for n in shapes:
+        wave(n)
+    assert ledger() == after_first
+
+
+# ------------------------------------------------- verifier contract
+
+
+def _session_with_cone(tmp_path):
+    from pathway_tpu.engine.cone import install_cones
+    from pathway_tpu.internals.lowering import Session
+
+    inp = tmp_path / "v.jsonl"
+    _write_jsonl(inp, [f"w{i % 5}" for i in range(50)])
+    t = pw.io.fs.read(str(inp), format="json", schema=WordSchema, mode="static")
+    res = t.groupby(t.word).reduce(t.word, count=pw.reducers.count())
+    s = Session()
+    s.attach_plan_roots([res], sink_meta=[(res, False)])
+    s.capture(res)
+    install_cones(s)
+    if not getattr(s.graph, "_cones", None):
+        pytest.skip("no cone installed (native plane unavailable)")
+    return s
+
+
+def test_verifier_rejects_multi_consumer_interior(tmp_path):
+    from pathway_tpu.internals import verifier
+
+    s = _session_with_cone(tmp_path)
+    cone = s.graph._cones[0]
+    # tamper: give an interior member a second consumer behind the
+    # planner's back
+    intruder = cone.members[-1]
+    cone.members[0].downstream.append((99, intruder))
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    assert "multi-consumer interior" in str(ei.value)
+
+
+def test_verifier_rejects_donation_on_multi_round_layout(tmp_path):
+    from pathway_tpu.internals import verifier
+
+    s = _session_with_cone(tmp_path)
+    cone = s.graph._cones[0]
+    cone.program["rounds"] = 3  # skewed wave would need respill rounds
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    assert "donation on a multi-round layout" in str(ei.value)
+
+
+def test_verifier_rejects_schema_mismatched_buffer(tmp_path):
+    from pathway_tpu.internals import verifier
+
+    s = _session_with_cone(tmp_path)
+    cone = s.graph._cones[0]
+    cone.program["lanes"] = 3  # staging rows are 4 u64 lanes, not 3
+    with pytest.raises(verifier.PlanVerificationError) as ei:
+        verifier.verify_session(s)
+    assert "schema-mismatched staging buffer" in str(ei.value)
+
+
+def test_verifier_passes_untampered_cone(tmp_path):
+    from pathway_tpu.internals import verifier
+
+    s = _session_with_cone(tmp_path)
+    verdict = verifier.verify_session(s)
+    assert verdict["checks"]["cone-contract"]["status"] == "ok"
+    assert verdict["checks"]["cone-contract"]["cones"] == 1
+
+
+# ------------------------------------------------- fallback honesty
+
+
+def test_object_wave_falls_back_and_is_counted(monkeypatch):
+    """Object-plane rows can't feed the fused program: the whole wave must
+    take the per-node path, once, and say so in the plan report."""
+    monkeypatch.setenv("PATHWAY_MEGAKERNEL", "1")
+    t = pw.debug.table_from_rows(
+        WordSchema, [("a",), ("b",), ("a",)]
+    )
+    res = t.groupby(t.word).reduce(t.word, count=pw.reducers.count())
+    _keys, cols = pw.debug.table_to_dicts(res)
+    assert {cols["word"][k]: cols["count"][k] for k in cols["word"]} == {
+        "a": 2,
+        "b": 1,
+    }
+    rep = planner.last_report()["megakernel"]
+    if not rep["cones"]:
+        pytest.skip("no cone installed on this plane")
+    [cone] = rep["cones"]
+    assert cone["fallback_fires"] >= 1
+
+
+def test_frontier_scheduler_dissolves_cones_loudly(tmp_path, monkeypatch):
+    """Cones cannot fire under the frontier scheduler's per-slot protocol:
+    streaming runs must dissolve them with a named reason, not wedge."""
+    monkeypatch.setenv("PATHWAY_MEGAKERNEL", "1")
+    from pathway_tpu.io.python import ConnectorSubject
+
+    class Words(ConnectorSubject):
+        def run(self):
+            for w in ("x", "y", "x"):
+                self.next(word=w)
+
+    t = pw.io.python.read(
+        Words(), schema=pw.schema_from_types(word=str), name="mk-words"
+    )
+    res = t.groupby(t.word).reduce(t.word, count=pw.reducers.count())
+    got = {}
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, is_addition: got.__setitem__(
+            row["word"], row["count"]
+        ),
+    )
+    pw.run()
+    assert got == {"x": 2, "y": 1}
+    rep = planner.last_report()["megakernel"]
+    if rep["cones"]:
+        assert rep["dissolved"] == "frontier-scheduler"
