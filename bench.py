@@ -837,7 +837,19 @@ _XLA_CACHE = os.path.join(tempfile.gettempdir(), "pathway_tpu_xla_cache")
 _ENGINE_TRIALS = 3
 
 
-def _run_engine_script_once(script: str, env_extra: dict) -> float:
+# every engine rung also reports its subprocess's peak RSS: ru_maxrss is
+# KiB on Linux; the print rides after the workload so it captures the
+# run's true high-water mark
+_RSS_EPILOGUE = (
+    "\nimport resource as _res\n"
+    "print('PEAK_RSS', _res.getrusage(_res.RUSAGE_SELF).ru_maxrss * 1024)\n"
+)
+
+
+def _run_engine_script_once(
+    script: str, env_extra: dict
+) -> tuple[float, float]:
+    """Returns (rows_per_sec, peak_rss_mb) of one subprocess run."""
     env = dict(os.environ)
     env.update(env_extra)
     env.setdefault("JAX_PLATFORMS", "cpu")  # engine configs never touch the chip
@@ -845,13 +857,20 @@ def _run_engine_script_once(script: str, env_extra: dict) -> float:
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
     r = subprocess.run(
-        [sys.executable, "-c", script],
+        [sys.executable, "-c", script + _RSS_EPILOGUE],
         capture_output=True, text=True, env=env, timeout=1800,
     )
+    rate = rss_mb = None
     for line in r.stdout.splitlines():
         if line.startswith("ROWS_PER_SEC"):
-            return float(line.split()[1])
-    raise RuntimeError(f"engine bench failed: {r.stdout[-500:]} {r.stderr[-2000:]}")
+            rate = float(line.split()[1])
+        elif line.startswith("PEAK_RSS"):
+            rss_mb = float(line.split()[1]) / (1024 * 1024)
+    if rate is None:
+        raise RuntimeError(
+            f"engine bench failed: {r.stdout[-500:]} {r.stderr[-2000:]}"
+        )
+    return rate, rss_mb if rss_mb is not None else 0.0
 
 
 def _run_engine_script(
@@ -860,14 +879,22 @@ def _run_engine_script(
 ) -> float:
     """Median of `trials` runs (first run doubles as the compile-cache
     warmer; with 3 trials the median lands on a warm sample). Records
-    {median, best, trials} under stats[rung] when given."""
-    rates = [_run_engine_script_once(script, env_extra) for _ in range(trials)]
+    {median, best, trials} plus the peak-RSS companion under
+    stats[rung] when given."""
+    runs = [_run_engine_script_once(script, env_extra) for _ in range(trials)]
+    rates = [r[0] for r in runs]
+    rsss = [r[1] for r in runs]
     med = float(np.median(rates))
     if stats is not None and rung is not None:
         stats[rung] = {
             "median": round(med, 1),
             "best": round(max(rates), 1),
             "trials": [round(x, 1) for x in rates],
+        }
+        stats[rung + "_rss_peak_mb"] = {
+            "median": round(float(np.median(rsss)), 1),
+            "best": round(min(rsss), 1),
+            "trials": [round(x, 1) for x in rsss],
         }
     return med
 
@@ -1553,6 +1580,143 @@ def bench_serving(repo: str) -> dict:
     return out
 
 
+_SPILL_GROUPBY_SCRIPT = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import pathway_tpu as pw
+
+class W(pw.Schema):
+    word: str
+
+t0 = time.time()
+t = pw.io.fs.read({inp!r}, format="json", schema=W, mode="static")
+res = t.groupby(t.word).reduce(t.word, count=pw.reducers.count())
+pw.io.csv.write(res, {out!r})
+pw.run()
+print("ROWS_PER_SEC", {n} / (time.time() - t0))
+"""
+
+
+def bench_spill(repo: str, stats: dict) -> dict:
+    """Out-of-core operator state rungs (engine/spill.py).
+
+    * probe-ladder microbench — per-probe latency of the three ladder
+      outcomes over a sealed store: tail hit (resident dict), bloom-
+      pruned miss (no disk read), run hit (one windowed disk read +
+      promotion);
+    * spilled groupby rung — object-plane groupby whose distinct-key
+      state is 10x the resident budget, spill-on vs the PATHWAY_SPILL=0
+      control of the same workload. Both publish peak RSS per rung; the
+      acceptance claim is that the spilled run's RSS stays bounded by
+      the budget, not the key space.
+    """
+    out: dict = {}
+    try:
+        from pathway_tpu.engine import spill as _spill
+        from pathway_tpu.engine.core import MultisetState
+
+        n = 20_000
+        st = MultisetState()
+        for i in range(n):
+            st.update_one(f"k{i:08d}", (i,), 1)
+        store = _spill.store_for("bench-ladder", budget=max(n // 10, 1))
+
+        def resolve(dkey):
+            raw = store.take(dkey.encode())
+            if raw is not None:
+                st.groups[dkey] = {0: ((0,), 1)}
+
+        st.spill_attach(store, resolve)
+        store.tail_keys = lambda: (k.encode() for k in st.groups)
+        from pathway_tpu.engine.core import _spill_evict_multiset
+
+        _spill_evict_multiset(
+            st, store, lambda dkey, group: b"p" * 64
+        )
+        resident = list(st.groups)[:2000]
+        t0 = time.perf_counter()
+        for k in resident:
+            st.get(k)
+        out["spill_probe_tail_us"] = round(
+            (time.perf_counter() - t0) / len(resident) * 1e6, 2
+        )
+        t0 = time.perf_counter()
+        for i in range(2000):
+            store.take(f"absent{i:08d}".encode())
+        out["spill_probe_bloom_miss_us"] = round(
+            (time.perf_counter() - t0) / 2000 * 1e6, 2
+        )
+        spilled = [f"k{i:08d}" for i in range(2000)]
+        t0 = time.perf_counter()
+        for k in spilled:
+            store.take(k.encode())
+        out["spill_probe_run_hit_us"] = round(
+            (time.perf_counter() - t0) / len(spilled) * 1e6, 2
+        )
+        store.close()
+        out["spill_probe_skip_reason"] = None
+    except Exception as e:  # noqa: BLE001 — rung failure, never fatal
+        for k in (
+            "spill_probe_tail_us", "spill_probe_bloom_miss_us",
+            "spill_probe_run_hit_us",
+        ):
+            out.setdefault(k, None)
+        out["spill_probe_skip_reason"] = f"failed: {type(e).__name__}: {e}"
+    # spilled groupby: 100k distinct keys, resident budget 10k (state
+    # 10x the budget) — object plane (the MultisetState tier is what
+    # spills; native groupby keeps fixed-width accumulators)
+    try:
+        n = 200_000
+        n_keys = 100_000
+        with tempfile.TemporaryDirectory() as tmp:
+            inp = os.path.join(tmp, "spill_in.jsonl")
+            rng = np.random.default_rng(3)
+            idx = rng.integers(0, n_keys, n)
+            with open(inp, "w") as f:
+                chunk = 200_000
+                for s in range(0, n, chunk):
+                    f.write(
+                        "\n".join(
+                            '{"word": "w%07d"}' % i for i in idx[s:s + chunk]
+                        )
+                        + "\n"
+                    )
+            script = _SPILL_GROUPBY_SCRIPT.format(
+                repo=repo, inp=inp, out=os.path.join(tmp, "spill_out.csv"),
+                n=n,
+            )
+            base_env = {"PATHWAY_TPU_NATIVE": "0", "PATHWAY_THREADS": "1"}
+            on = _run_engine_script(
+                script,
+                {**base_env, "PATHWAY_SPILL": "1",
+                 "PATHWAY_SPILL_BUDGET": str(n_keys // 10)},
+                stats=stats, rung="spill_groupby_rows_per_sec",
+            )
+            off = _run_engine_script(
+                script, {**base_env, "PATHWAY_SPILL": "0"},
+                stats=stats, rung="spill_off_groupby_rows_per_sec",
+            )
+        out["spill_groupby_rows_per_sec"] = round(on, 1)
+        out["spill_off_groupby_rows_per_sec"] = round(off, 1)
+        on_rss = stats["spill_groupby_rows_per_sec_rss_peak_mb"]["median"]
+        off_rss = stats["spill_off_groupby_rows_per_sec_rss_peak_mb"]["median"]
+        out["spill_groupby_rss_peak_mb"] = on_rss
+        out["spill_off_groupby_rss_peak_mb"] = off_rss
+        out["spill_rss_ratio"] = (
+            round(on_rss / off_rss, 3) if off_rss else None
+        )
+        out["spill_groupby_skip_reason"] = None
+    except Exception as e:  # noqa: BLE001
+        for k in (
+            "spill_groupby_rows_per_sec", "spill_off_groupby_rows_per_sec",
+            "spill_groupby_rss_peak_mb", "spill_off_groupby_rss_peak_mb",
+            "spill_rss_ratio",
+        ):
+            out.setdefault(k, None)
+        out["spill_groupby_skip_reason"] = f"failed: {type(e).__name__}: {e}"
+    return out
+
+
 def _detect_backend() -> str:
     """Probe the jax backend WITHOUT initializing this process's client
     (the RAG-on-chip subprocess must grab the device first)."""
@@ -1607,6 +1771,7 @@ def main() -> None:
     # ANN rungs LAST: the 10M corpus leans on host RAM / HBM that the
     # device rungs above want clean
     ann_rungs = bench_ann(dataflow.setdefault("stats", {}))
+    spill_rungs = bench_spill(repo, dataflow.setdefault("stats", {}))
     result = {
         "metric": "embed_throughput_per_chip",
         "value": round(embed_rate, 1) if embed_rate is not None else None,
@@ -1654,6 +1819,7 @@ def main() -> None:
         **rag_tpu,
         **serving,
         **ann_rungs,
+        **spill_rungs,
         # config 5 stretch: Gemma-2B-shaped on-chip decode
         "lm_decode_tokens_per_sec": (
             round(decode_rate, 1) if decode_rate else None

@@ -196,17 +196,36 @@ class KeyedState:
 
 
 class MultisetState:
-    """Arrangement by a derived key: dkey -> {token: (payload, count)}."""
+    """Arrangement by a derived key: dkey -> {token: (payload, count)}.
 
-    __slots__ = ("groups",)
+    Out-of-core tier (engine/spill.py): a node that spills attaches a
+    miss hook (`_resolve`) that promotes an absent group back from the
+    LSM run tier before any read or write touches it — residency is
+    exclusive, so a group lives either in `groups` (the tail) or in one
+    run's live set, never both. `_rec` tracks touch recency for the
+    owner's coldest-first eviction; both stay None (zero overhead, and
+    byte-identical codec snapshots) until a store attaches."""
+
+    __slots__ = ("groups", "_resolve", "_rec", "_seq", "_spill_store")
 
     def __init__(self) -> None:
         self.groups: dict[Any, dict[Any, tuple[Any, int]]] = {}
+        self._resolve: Callable[[Any], None] | None = None
+        self._rec: dict[Any, int] | None = None
+        self._seq = 0
+        self._spill_store: Any = None
 
     def update_one(self, dkey: Any, payload: Any, diff: int) -> None:
         group = self.groups.get(dkey)
         if group is None:
-            group = self.groups[dkey] = {}
+            if self._resolve is not None:
+                self._resolve(dkey)
+                group = self.groups.get(dkey)
+            if group is None:
+                group = self.groups[dkey] = {}
+        if self._rec is not None:
+            self._seq += 1
+            self._rec[dkey] = self._seq
         token = freeze_value(payload)
         cur = group.get(token)
         new_count = (cur[1] if cur else 0) + diff
@@ -214,11 +233,19 @@ class MultisetState:
             group.pop(token, None)
             if not group:
                 del self.groups[dkey]
+                if self._rec is not None:
+                    self._rec.pop(dkey, None)
         else:
             group[token] = (payload, new_count)
 
     def get(self, dkey: Any) -> list[tuple[Any, int]]:
         group = self.groups.get(dkey)
+        if group is None and self._resolve is not None:
+            self._resolve(dkey)
+            group = self.groups.get(dkey)
+        if self._rec is not None and group is not None:
+            self._seq += 1
+            self._rec[dkey] = self._seq
         if not group:
             return []
         return list(group.values())
@@ -227,7 +254,21 @@ class MultisetState:
         return self.groups.keys()
 
     def __contains__(self, dkey: Any) -> bool:
-        return dkey in self.groups
+        if dkey in self.groups:
+            return True
+        if self._resolve is not None:
+            self._resolve(dkey)
+            return dkey in self.groups
+        return False
+
+    def spill_attach(self, store: Any, resolve: Callable[[Any], None]) -> None:
+        self._spill_store = store
+        self._resolve = resolve
+        if self._rec is None:
+            # backfill recency from insertion order: oldest-inserted
+            # groups are the first eviction candidates
+            self._rec = {k: i for i, k in enumerate(self.groups)}
+            self._seq = len(self._rec)
 
 
 # ------------------------------------------------- shard-rescale protocol
@@ -254,6 +295,14 @@ class RescaleUnsupported(RuntimeError):
     worker count; resume falls back to full journal replay."""
 
 
+def _spill_blocks_rescale(state: Any) -> bool:
+    """A spilled arrangement's authoritative state spans tail + on-disk
+    runs; merging/splitting only the tail would silently lose the run
+    tier, so rescale refuses (journal-replay fallback) while runs exist."""
+    store = getattr(state, "_spill_store", None)
+    return store is not None and store.has_runs
+
+
 def _merge_pair(a: Any, b: Any) -> Any:
     """Union two per-shard state containers (disjoint by construction:
     every shard key lives on exactly one shard)."""
@@ -261,6 +310,11 @@ def _merge_pair(a: Any, b: Any) -> Any:
         a.rows.update(b.rows)
         return a
     if isinstance(a, MultisetState):
+        if _spill_blocks_rescale(a) or _spill_blocks_rescale(b):
+            raise RescaleUnsupported(
+                "spilled arrangement (on-disk runs) cannot merge across "
+                "worker shards; resume falls back to journal replay"
+            )
         a.groups.update(b.groups)
         return a
     if isinstance(a, dict):
@@ -282,6 +336,11 @@ def _split_container(value: Any, rule: str, n: int, shard_of) -> list[Any]:
             outs[shard_of(key.value)].rows[key] = row
         return outs
     if isinstance(value, MultisetState):
+        if _spill_blocks_rescale(value):
+            raise RescaleUnsupported(
+                "spilled arrangement (on-disk runs) cannot re-partition "
+                "across worker shards; resume falls back to journal replay"
+            )
         outs = [MultisetState() for _ in range(n)]
         for dkey, group in value.groups.items():
             outs[shard_of(dkey)].groups[dkey] = group
@@ -303,6 +362,48 @@ def _split_container(value: Any, rule: str, n: int, shard_of) -> list[Any]:
             outs[shard_of(tok)][k] = v
         return outs
     raise RescaleUnsupported(f"cannot split state of type {type(value).__name__}")
+
+
+def _spill_evict_multiset(state: MultisetState, store: Any, pack) -> int:
+    """Seal the coldest groups of a MultisetState into one spill run,
+    down to the store's low-water mark. `pack(dkey, group)` returns the
+    group's self-contained payload bytes (the owner adds its per-group
+    side state — emitted rows, group keys — so promotion restores the
+    node exactly)."""
+    from pathway_tpu.persistence import codec as _codec
+
+    if len(state.groups) <= store.budget:
+        return 0
+    target = int(store.budget * 0.75)
+    n_evict = len(state.groups) - target
+    rec = state._rec if state._rec is not None else {}
+    victims = sorted(state.groups, key=lambda k: rec.get(k, 0))[:n_evict]
+    items = []
+    for dkey in victims:
+        group = state.groups.pop(dkey)
+        try:
+            # pack() must defer owner-side mutation until its encode
+            # succeeded: a group whose payload the codec cannot express
+            # (exotic reducer values) simply stays resident
+            items.append((_codec.encode_value(dkey), pack(dkey, group)))
+        except Exception:  # noqa: BLE001
+            state.groups[dkey] = group
+            continue
+        rec.pop(dkey, None)
+    if not items:
+        return 0
+    return store.seal(items)
+
+
+def _spill_check_strict(store: Any, owner: str) -> None:
+    """Deep exclusive-residency proof at restore (reads every run), so
+    it only runs under PATHWAY_VERIFY=strict; the cheap manifest checks
+    always run inside spill.attach_store."""
+    from pathway_tpu.engine import spill as _spill
+    from pathway_tpu.internals import verifier as _verifier
+
+    if _verifier.mode() == "strict":
+        _spill.check_two_tier(store, owner)
 
 
 # ------------------------------------------------------------------- nodes
@@ -2517,6 +2618,14 @@ class JoinNode(Node):
         )
 
     def merge_shard_states(self, states: list[dict]) -> dict:
+        if any(
+            k in st for st in states
+            for k in ("spill", "spill_left", "spill_right")
+        ):
+            raise RescaleUnsupported(
+                "spilled join arrangement (on-disk runs) cannot merge "
+                "across worker shards; resume falls back to journal replay"
+            )
         if not states or "njoin" not in states[0]:
             return super().merge_shard_states(states)
         # native arrangements: concat the flat arrays; intern ids are
@@ -2542,6 +2651,11 @@ class JoinNode(Node):
         return {"njoin": merged}
 
     def split_shard_state(self, merged: dict, n: int, shard_of) -> list[dict]:
+        if any(k in merged for k in ("spill", "spill_left", "spill_right")):
+            raise RescaleUnsupported(
+                "spilled join arrangement (on-disk runs) cannot "
+                "re-partition across worker shards"
+            )
         if "njoin" not in merged:
             return super().split_shard_state(merged, n, shard_of)
         # shard of a jk = shard of its VALUE tuple: decode the canonical
@@ -2592,20 +2706,48 @@ class JoinNode(Node):
 
     def persist_state(self) -> dict:
         if self._plan is None:
-            return super().persist_state()
-        return {"njoin": [self._export_arr(a) for a in self._arrs]}
+            st = super().persist_state()
+            for side, key in ((0, "spill_left"), (1, "spill_right")):
+                store = self._spill_js[side]
+                if store is not None and store.has_runs:
+                    st[key] = store.manifest()
+            return st
+        st = {"njoin": [self._export_arr(a) for a in self._arrs]}
+        spills = [
+            (s.manifest() if s is not None and s.has_runs else None)
+            for s in self._spill_n
+        ]
+        if any(m is not None for m in spills):
+            st["spill"] = spills
+        return st
 
     def restore_state(self, st: dict) -> None:
+        from pathway_tpu.engine import spill as _spill
+
         if ("njoin" in st) != (self._plan is not None):
             raise RuntimeError(
                 "join snapshot was taken with a different native-kernel "
                 "setting; cannot restore operator state"
             )
         if self._plan is None:
+            st = dict(st)
+            manifests = (st.pop("spill_left", None), st.pop("spill_right", None))
             super().restore_state(st)
+            for side, man in enumerate(manifests):
+                if man is not None:
+                    self._spill_attach_py(side, _spill.attach_store(man))
+                    _spill_check_strict(
+                        self._spill_js[side], f"join n{self.node_id}"
+                    )
             return
         for arr, dump in zip(self._arrs, st["njoin"]):
             self._import_arr(arr, dump)
+        for side, man in enumerate(st.get("spill") or []):
+            if man is not None:
+                self._spill_adopt_native(side, _spill.attach_store(man))
+                _spill_check_strict(
+                    self._spill_n[side], f"join n{self.node_id}"
+                )
 
     def _export_arr(self, arr) -> dict:
         """Intern ids are run-local: snapshot canonical BYTES per unique
@@ -2628,6 +2770,146 @@ class JoinNode(Node):
         jk = np.array([jk_map[int(t)] for t in dump["jk"]], np.uint64)
         tok = np.array([tok_map[int(t)] for t in dump["tok"]], np.uint64)
         arr.update(jk, dump["klo"], dump["khi"], tok, dump["cnt"])
+
+    # ---- out-of-core spill tier (engine/spill.py) --------------------
+    # Exclusive residency: a join key's rows live EITHER in the resident
+    # arrangement (tail) or in exactly one sealed run on disk. Any touch
+    # promotes the group back into the tail before the wave reads it, so
+    # the dataflow is byte-identical to the all-resident run.
+
+    def spill_stores(self) -> list:
+        """Active spill stores (verifier contract surface)."""
+        return [s for s in (*self._spill_js, *self._spill_n) if s is not None]
+
+    def _spill_attach_py(self, side: int, store) -> None:
+        from pathway_tpu.persistence import codec as _codec
+
+        st = self.left_state if side == 0 else self.right_state
+        self._spill_js[side] = store
+        st.spill_attach(store, lambda dkey, _s=side: self._spill_resolve_py(_s, dkey))
+        store.tail_keys = lambda _st=st: (
+            _codec.encode_value(k) for k in _st.groups
+        )
+
+    def _spill_resolve_py(self, side: int, dkey) -> None:
+        """Promote one spilled group into the resident tail (miss hook)."""
+        from pathway_tpu.persistence import codec as _codec
+
+        store = self._spill_js[side]
+        if store is None:
+            return
+        raw = store.take(_codec.encode_value(dkey))
+        if raw is None:
+            return
+        st = self.left_state if side == 0 else self.right_state
+        entries = _codec.decode_value(raw)
+        st.groups[dkey] = {
+            freeze_value(p): (p, c) for p, c in entries
+        }
+
+    def _maybe_spill_py(self) -> None:
+        from pathway_tpu.engine import spill as _spill
+        from pathway_tpu.persistence import codec as _codec
+
+        if not _spill.enabled():
+            return
+        budget = _spill.default_budget()
+        pack = lambda dkey, group: _codec.encode_value(tuple(group.values()))  # noqa: E731
+        for side, st in ((0, self.left_state), (1, self.right_state)):
+            if self._spill_js[side] is None:
+                if len(st.groups) <= budget:
+                    continue
+                label = f"n{self.node_id}-{'left' if side == 0 else 'right'}"
+                self._spill_attach_py(side, _spill.store_for(label))
+            _spill_evict_multiset(st, self._spill_js[side], pack)
+
+    # Native plane: the C arrangement has no miss hook, so promotion is
+    # eager — before a wave probes/updates, every spilled group whose jk
+    # appears in the wave is re-inserted (dj_update) in original
+    # insertion order. jk/row tokens are run-local intern ids; payloads
+    # therefore carry canonical BYTES, re-interned on promote.
+
+    def _spill_adopt_native(self, side: int, store) -> None:
+        self._spill_n[side] = store
+        arr = self._arrs[side]
+        store.tail_keys = lambda _a=arr: (
+            self._tab.get_bytes(int(jk)) for jk in _a.group_sizes()[0]
+        )
+
+    def _spill_store_native(self, side: int):
+        from pathway_tpu.engine import spill as _spill
+
+        if self._spill_n[side] is None:
+            label = f"n{self.node_id}-{'jl' if side == 0 else 'jr'}"
+            self._spill_adopt_native(side, _spill.store_for(label))
+        return self._spill_n[side]
+
+    def _spill_promote_native(self, lw, rw) -> None:
+        from pathway_tpu.persistence import codec as _codec
+
+        jks: set[int] = set()
+        if lw is not None:
+            jks.update(int(t) for t in set(lw[4].tolist()))
+        if rw is not None:
+            jks.update(int(t) for t in set(rw[4].tolist()))
+        for side in range(2):
+            store = self._spill_n[side]
+            rec = self._spill_rec[side]
+            arr = self._arrs[side]
+            for jk_t in jks:
+                self._spill_seq += 1
+                rec[jk_t] = self._spill_seq
+                if store is None or not store.has_runs:
+                    continue
+                raw = store.take(self._tab.get_bytes(jk_t))
+                if raw is None:
+                    continue
+                klo_b, khi_b, cnt_b, row_bytes = _codec.decode_value(raw)
+                klo = np.frombuffer(klo_b, np.uint64)
+                khi = np.frombuffer(khi_b, np.uint64)
+                cnt = np.frombuffer(cnt_b, np.int64)
+                tok = np.array(
+                    [self._tab.intern(b) for b in row_bytes], np.uint64
+                )
+                arr.update(
+                    np.full(len(cnt), jk_t, np.uint64), klo, khi, tok, cnt
+                )
+
+    def _spill_native_evict(self) -> None:
+        from pathway_tpu.engine import spill as _spill
+        from pathway_tpu.persistence import codec as _codec
+
+        if not _spill.enabled():
+            return
+        budget = _spill.default_budget()
+        for side in range(2):
+            arr = self._arrs[side]
+            jk_live, nrows = arr.group_sizes()
+            if len(jk_live) <= budget and self._spill_n[side] is None:
+                continue
+            store = self._spill_store_native(side)
+            if len(jk_live) <= store.budget:
+                continue
+            target = int(store.budget * 0.75)
+            rec = self._spill_rec[side]
+            order = sorted(
+                jk_live.tolist(), key=lambda t: rec.get(int(t), 0)
+            )
+            items = []
+            for jk_t in order[: len(jk_live) - target]:
+                jk_t = int(jk_t)
+                res = arr.evict_group(jk_t)
+                if res is None:
+                    continue
+                klo, khi, tok, cnt = res
+                rec.pop(jk_t, None)
+                payload = _codec.encode_value((
+                    klo.tobytes(), khi.tobytes(), cnt.tobytes(),
+                    [self._tab.get_bytes(int(t)) for t in tok],
+                ))
+                items.append((self._tab.get_bytes(jk_t), payload))
+            if items:
+                store.seal(items)
 
     _ID_MODES = {"hash": 0, "left": 1, "right": 2, "cheap": 3}
 
@@ -2660,6 +2942,12 @@ class JoinNode(Node):
         self.emit_cols = emit_cols
         self.left_state = MultisetState()
         self.right_state = MultisetState()
+        # out-of-core tier (engine/spill.py): per-side stores, created
+        # lazily when an arrangement first exceeds the resident budget
+        self._spill_js: list = [None, None]   # python-plane MultisetStates
+        self._spill_n: list = [None, None]    # native NativeJoinArrs
+        self._spill_rec: tuple = ({}, {})     # native jk-token recency
+        self._spill_seq = 0
         # asof_now: left deltas join the right side's state as of their
         # arrival; right-side changes never retro-update results
         # (reference: asof_now joins / use_external_index_as_of_now)
@@ -2836,6 +3124,14 @@ class JoinNode(Node):
         lw = self._wave_arrays(0)
         rw = self._wave_arrays(1)
         l_arr, r_arr = self._arrs
+        if lw is not None or rw is not None:
+            from pathway_tpu.engine import spill as _spill
+
+            if _spill.enabled():
+                # promote every spilled group this wave touches BEFORE
+                # any probe/update: the probe ladder must see the full
+                # arrangement or match counts would silently drop
+                self._spill_promote_native(lw, rw)
         if lw is not None:
             lo, hi, tok, diff, jk = lw
             idx, klo, khi, ktok, cnt = r_arr.probe(jk)  # dL ⋈ R_old
@@ -2857,6 +3153,10 @@ class JoinNode(Node):
             )
             r_arr.update(jk, lo, hi, tok, diff)
         if lw is not None or rw is not None:
+            from pathway_tpu.engine import spill as _spill
+
+            if _spill.enabled():
+                self._spill_native_evict()
             self._refresh_sketch()
 
     def finish_time(self, time: int) -> None:
@@ -2906,6 +3206,7 @@ class JoinNode(Node):
                     if not rmatches and self.mode in ("left", "outer", "full"):
                         out.append(self._out_entry(lkey, lrow, None, None, dc))
             self.emit(time, consolidate(out))
+            self._maybe_spill_py()
             self._refresh_sketch()
             return
         # dL ⋈ R_old
@@ -2958,6 +3259,7 @@ class JoinNode(Node):
                         for (rkey, rrow), c in rrows_now:
                             out.append(self._out_entry(None, None, rkey, rrow, c))
         self.emit(time, consolidate(out))
+        self._maybe_spill_py()
         self._refresh_sketch()
 
 
@@ -3050,6 +3352,67 @@ class GroupByNode(Node):
             self.state = MultisetState()  # gkey -> {token: ((gvals,args),cnt)}
             self.gkeys: dict[Any, tuple[Key, tuple]] = {}  # fzn gval->(Key,gvals)
             self.stateful_state: dict[Any, list[Any]] = {}
+            # out-of-core tier: lazily created once the resident group
+            # count first exceeds the spill budget (native accumulator
+            # modes never spill — their state is fixed-width per group)
+            self._spill = None
+
+    # ---- out-of-core spill tier (engine/spill.py) --------------------
+    # A spilled group carries its multiset AND its per-group side state
+    # (gkeys entry, last emitted row) so promotion restores the node
+    # exactly: delta_emit keeps retracting against the right prior row.
+
+    def spill_stores(self) -> list:
+        s = getattr(self, "_spill", None)
+        return [s] if s is not None else []
+
+    def _spill_attach(self, store) -> None:
+        from pathway_tpu.persistence import codec as _codec
+
+        self._spill = store
+        self.state.spill_attach(store, self._spill_resolve)
+        store.tail_keys = lambda _st=self.state: (
+            _codec.encode_value(k) for k in _st.groups
+        )
+
+    def _spill_resolve(self, token_g) -> None:
+        from pathway_tpu.persistence import codec as _codec
+
+        store = self._spill
+        if store is None:
+            return
+        raw = store.take(_codec.encode_value(token_g))
+        if raw is None:
+            return
+        entries, ginfo, em = _codec.decode_value(raw)
+        self.state.groups[token_g] = {
+            freeze_value(p): (p, c) for p, c in entries
+        }
+        self.gkeys.setdefault(token_g, ginfo)
+        if em is not None:
+            self.emitted.setdefault(ginfo[0], em)
+
+    def _maybe_spill(self) -> None:
+        from pathway_tpu.engine import spill as _spill
+        from pathway_tpu.persistence import codec as _codec
+
+        if not _spill.enabled():
+            return
+        if self._spill is None:
+            if len(self.state.groups) <= _spill.default_budget():
+                return
+            self._spill_attach(_spill.store_for(f"n{self.node_id}-reduce"))
+
+        def pack(token_g, group):
+            ginfo = self.gkeys[token_g]
+            em = self.emitted.get(ginfo[0])
+            raw = _codec.encode_value((tuple(group.values()), ginfo, em))
+            self.gkeys.pop(token_g, None)
+            if em is not None:
+                self.emitted.pop(ginfo[0], None)
+            return raw
+
+        _spill_evict_multiset(self.state, self._spill, pack)
 
     def persist_signature(self) -> str:
         reds = ",".join(
@@ -3062,6 +3425,11 @@ class GroupByNode(Node):
     def merge_shard_states(self, states: list[dict]) -> dict:
         if not states:
             return {}
+        if any("spill" in st for st in states):
+            raise RescaleUnsupported(
+                "spilled groupby arrangement (on-disk runs) cannot merge "
+                "across worker shards; resume falls back to journal replay"
+            )
         if "native_plan" in states[0]:
             # group-aligned arrays concatenate; slots align positionally
             aggs = [st["native_plan"] for st in states]
@@ -3121,6 +3489,11 @@ class GroupByNode(Node):
         return super().merge_shard_states(states)
 
     def split_shard_state(self, merged: dict, n: int, shard_of) -> list[dict]:
+        if "spill" in merged:
+            raise RescaleUnsupported(
+                "spilled groupby arrangement (on-disk runs) cannot "
+                "re-partition across worker shards"
+            )
         if "native" in merged:
             # decompose the canonical merged export, routed by group token
             exp, g2t, info = (
@@ -3281,12 +3654,15 @@ class GroupByNode(Node):
                 "ginfo": self._ginfo,
                 "emitted": self.emitted,
             }
-        return {
+        st = {
             "state": self.state,
             "gkeys": self.gkeys,
             "stateful_state": self.stateful_state,
             "emitted": self.emitted,
         }
+        if self._spill is not None and self._spill.has_runs:
+            st["spill"] = self._spill.manifest()
+        return st
 
     def restore_state(self, st: dict) -> None:
         mode = (
@@ -3336,6 +3712,12 @@ class GroupByNode(Node):
             self.gkeys = st["gkeys"]
             self.stateful_state = st["stateful_state"]
             self.emitted = st["emitted"]
+            man = st.get("spill")
+            if man is not None:
+                from pathway_tpu.engine import spill as _spill
+
+                self._spill_attach(_spill.attach_store(man))
+                _spill_check_strict(self._spill, f"reduce n{self.node_id}")
 
     def _group_token(self, gvals: tuple) -> int:
         """Plan mode: the group's intern id (canonical bytes) or a
@@ -3617,6 +3999,7 @@ class GroupByNode(Node):
                     new = None
             delta_emit(self.emitted, out, gkey, new)
         self.emit(time, out)
+        self._maybe_spill()
 
 
 def _canon_scalar(v: Any) -> Any:

@@ -1885,6 +1885,88 @@ int64_t dj_export(void* h, uint64_t* jk, uint64_t* klo, uint64_t* khi,
     return m;
 }
 
+// Per-group live-row census for the spill tier (engine/spill.py): writes
+// up to cap (jk, live_rows) pairs in arrangement iteration order.
+// Returns group count, or negated required capacity when cap is small.
+int64_t dj_groups(void* h, int64_t cap, uint64_t* jk, int64_t* nrows) {
+    auto* arr = static_cast<JoinArr*>(h);
+    int64_t m = 0;
+    for (const auto& g : arr->groups) {
+        if (m < cap) {
+            jk[m] = g.first;
+            nrows[m] = g.second.live;
+        }
+        ++m;
+    }
+    return m <= cap ? m : -m;
+}
+
+// Evict one group into the spill tier: export its live rows in INSERTION
+// order — exactly the order dj_probe/dj_export would emit them, so a
+// later promote via dj_update round-trips byte-identically — then erase
+// the group. Returns live-row count; negated required capacity when cap
+// is too small (group untouched); 0 when the group is absent.
+int64_t dj_evict(void* h, uint64_t jkey, int64_t cap, uint64_t* klo,
+                 uint64_t* khi, uint64_t* tok, int64_t* cnt) {
+    auto* arr = static_cast<JoinArr*>(h);
+    auto it = arr->groups.find(jkey);
+    if (it == arr->groups.end()) return 0;
+    const JGroup& g = it->second;
+    if (g.live > cap) return -g.live;
+    int64_t m = 0;
+    for (size_t k = 0; k < g.rows.size(); ++k) {
+        if (g.cnt[k] == 0) continue;  // tombstone
+        klo[m] = g.rows[k].lo;
+        khi[m] = g.rows[k].hi;
+        tok[m] = g.rows[k].tok;
+        cnt[m] = g.cnt[k];
+        ++m;
+    }
+    arr->groups.erase(it);
+    return m;
+}
+
+// ------------------------------------------------------------ spill bloom
+//
+// Split bloom filter over pre-hashed u64 keys for the LSM run probe
+// ladder (engine/spill.py): k probes derived from one 64-bit hash via
+// Kirsch-Mitzenmacher double hashing. m_bits must be a power of two.
+
+static inline uint64_t dp_bloom_mix(uint64_t x) {
+    x ^= x >> 33;
+    x *= 0xFF51AFD7ED558CCDull;
+    x ^= x >> 33;
+    x *= 0xC4CEB9FE1A85EC53ull;
+    x ^= x >> 33;
+    return x;
+}
+
+void dp_bloom_build(int64_t n, const uint64_t* hashes, int64_t m_bits,
+                    int64_t k, uint8_t* bits) {
+    std::memset(bits, 0, static_cast<size_t>(m_bits / 8));
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t h1 = dp_bloom_mix(hashes[i]);
+        uint64_t h2 = dp_bloom_mix(h1 ^ 0x9E3779B97F4A7C15ull) | 1;
+        for (int64_t j = 0; j < k; ++j) {
+            uint64_t b = (h1 + static_cast<uint64_t>(j) * h2) &
+                         static_cast<uint64_t>(m_bits - 1);
+            bits[b >> 3] |= static_cast<uint8_t>(1u << (b & 7));
+        }
+    }
+}
+
+int64_t dp_bloom_check(const uint8_t* bits, int64_t m_bits, int64_t k,
+                       uint64_t hash) {
+    uint64_t h1 = dp_bloom_mix(hash);
+    uint64_t h2 = dp_bloom_mix(h1 ^ 0x9E3779B97F4A7C15ull) | 1;
+    for (int64_t j = 0; j < k; ++j) {
+        uint64_t b = (h1 + static_cast<uint64_t>(j) * h2) &
+                     static_cast<uint64_t>(m_bits - 1);
+        if (!(bits[b >> 3] & (1u << (b & 7)))) return 0;
+    }
+    return 1;
+}
+
 // Assemble joined output rows: for pair p, row bytes =
 // piece_key(lkey) + piece_key(rkey) + lrow_bytes + rrow_bytes, interned;
 // out key: id_mode 0 = blake2b(piece_key(l)+piece_key(r)) (hash),

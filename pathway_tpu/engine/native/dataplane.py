@@ -166,6 +166,15 @@ def _load() -> ctypes.CDLL | None:
         lib.dj_len.argtypes = [c.c_void_p]
         lib.dj_export.restype = c.c_int64
         lib.dj_export.argtypes = [c.c_void_p, u64p, u64p, u64p, u64p, i64p]
+        lib.dj_groups.restype = c.c_int64
+        lib.dj_groups.argtypes = [c.c_void_p, c.c_int64, u64p, i64p]
+        lib.dj_evict.restype = c.c_int64
+        lib.dj_evict.argtypes = [
+            c.c_void_p, c.c_uint64, c.c_int64, u64p, u64p, u64p, i64p,
+        ]
+        lib.dp_bloom_build.argtypes = [c.c_int64, u64p, c.c_int64, c.c_int64, u8p]
+        lib.dp_bloom_check.restype = c.c_int64
+        lib.dp_bloom_check.argtypes = [u8p, c.c_int64, c.c_int64, c.c_uint64]
         lib.dp_join_rows.restype = c.c_int64
         lib.dp_join_rows.argtypes = [
             c.c_void_p, c.c_int64, u64p, u64p, u64p, u64p, u64p, u64p,
@@ -577,6 +586,77 @@ class NativeJoinArr:
         m = self._lib.dj_export(self._h, jk, klo, khi, tok, cnt)
         assert m == n
         return jk, klo, khi, tok, cnt
+
+    def group_sizes(self):
+        """(jk, live_row_count) per resident group, iteration order."""
+        cap = 256
+        while True:
+            jk = np.empty(cap, np.uint64)
+            nrows = np.empty(cap, np.int64)
+            m = self._lib.dj_groups(self._h, cap, jk, nrows)
+            if m >= 0:
+                return jk[:m], nrows[:m]
+            cap = -m
+
+    def evict_group(self, jk: int):
+        """Export one group's live rows in insertion order and erase it:
+        (key_lo, key_hi, token, count) arrays, empty when absent. The
+        insertion order is the order dj_probe would have emitted, so a
+        later re-insert via update() round-trips byte-identically."""
+        cap = 64
+        while True:
+            klo = np.empty(cap, np.uint64)
+            khi = np.empty(cap, np.uint64)
+            tok = np.empty(cap, np.uint64)
+            cnt = np.empty(cap, np.int64)
+            m = self._lib.dj_evict(self._h, jk, cap, klo, khi, tok, cnt)
+            if m >= 0:
+                return klo[:m], khi[:m], tok[:m], cnt[:m]
+            cap = -m
+
+
+def bloom_build(hashes: np.ndarray, m_bits: int, k: int) -> np.ndarray:
+    """Bloom bitset (uint8 array of m_bits/8 bytes) over pre-hashed u64
+    keys; m_bits must be a power of two. Falls back to a pure-python
+    build when the native library is unavailable."""
+    bits = np.zeros(m_bits // 8, np.uint8)
+    lib = _load()
+    h = np.ascontiguousarray(hashes, np.uint64)
+    if lib is not None:
+        lib.dp_bloom_build(len(h), h, m_bits, k, bits)
+        return bits
+    for hv in h.tolist():
+        h1 = _bloom_mix(hv)
+        h2 = _bloom_mix(h1 ^ 0x9E3779B97F4A7C15) | 1
+        for j in range(k):
+            b = (h1 + j * h2) % m_bits
+            bits[b >> 3] |= 1 << (b & 7)
+    return bits
+
+
+def bloom_check(bits: np.ndarray, m_bits: int, k: int, hash_: int) -> bool:
+    lib = _load()
+    if lib is not None:
+        return bool(lib.dp_bloom_check(bits, m_bits, k, hash_))
+    h1 = _bloom_mix(hash_)
+    h2 = _bloom_mix(h1 ^ 0x9E3779B97F4A7C15) | 1
+    for j in range(k):
+        b = (h1 + j * h2) % m_bits
+        if not (bits[b >> 3] & (1 << (b & 7))):
+            return False
+    return True
+
+
+def _bloom_mix(x: int) -> int:
+    # mirror of dp_bloom_mix in dataplane.cpp — the two builds must agree
+    # bit-for-bit so a bitset built on one plane checks on the other
+    M = (1 << 64) - 1
+    x ^= x >> 33
+    x = (x * 0xFF51AFD7ED558CCD) & M
+    x ^= x >> 33
+    x = (x * 0xC4CEB9FE1A85EC53) & M
+    x ^= x >> 33
+    return x
 
 
 def join_rows(

@@ -372,6 +372,12 @@ class Runtime:
                 and sched.fully_drained()
                 and not sched.has_async()
             ):
+                # refresh pathway_spill_{runs,bytes} gauges at the fence
+                # (seal/compact publish too, but an idle store's gauges
+                # would otherwise go stale after restore)
+                from pathway_tpu.engine import spill as _spill
+
+                _spill.publish_metrics()
                 policy.maybe_replan(sched)
             if len(closed) == len(self.connectors):
                 # final drain: anything staged between the last poll and

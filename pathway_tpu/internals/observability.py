@@ -17,10 +17,13 @@ the port's equivalent spine; four concerns share it:
 * **metrics registry** — per-source watermark lag and frontier age,
   per-operator latency *histograms* (not just the cumulative
   ``time_ns``), mesh wire counters, device-plane compile/quarantine
-  counts and RetryPolicy/breaker + fault-plane events, all exported
-  through the Prometheus endpoint (internals/metrics.py), the JSONL/OTLP
-  telemetry exporter (internals/telemetry.py) and the ``/statistics``
-  JSON route;
+  counts and RetryPolicy/breaker + fault-plane events, plus the
+  out-of-core state plane (``pathway_spill_runs`` / ``_bytes`` gauges
+  per store, the ``pathway_spill_probe_tier`` ladder counter,
+  ``pathway_spill_compactions`` and the ``pathway_spill_merge_seconds``
+  histogram — engine/spill.py), all exported through the Prometheus
+  endpoint (internals/metrics.py), the JSONL/OTLP telemetry exporter
+  (internals/telemetry.py) and the ``/statistics`` JSON route;
 
 * **pipeline profiler** — ``pw.run(profile=...)`` (or
   ``PATHWAY_PROFILE=1``/``=path``) writes a per-run profile attributing

@@ -566,6 +566,8 @@ class AdaptivePolicy:
         self.interval_s = interval_s
         self._last = 0.0
         self._exchange_tuned = 0
+        self._spill_tuned = 0
+        self._spill_probe_seen = 0.0
         # fresh tuning per run: the exchanger is a process-wide
         # singleton, and a previous run's doublings must not ratchet
         # into this one (same discipline as the scan-tuning claim)
@@ -596,6 +598,7 @@ class AdaptivePolicy:
             return 0
         changes = self._refuse_hot_chains(plane)
         changes += self._retune_exchange(plane)
+        changes += self._retune_spill(plane)
         if changes and scheduler is not None:
             scheduler.replan_refresh()
         if changes:
@@ -756,5 +759,52 @@ class AdaptivePolicy:
         plane.record("replan", action=action, auto_min=auto_min)
         self.report["replans"].append({
             "action": action, "auto_min": auto_min,
+        })
+        return 1
+
+    # ---------------------------------------------------- spill retune
+
+    def _retune_spill(self, plane) -> int:
+        """Thrash detection for out-of-core arrangements: when the probe
+        ladder keeps landing on disk (run hits dominate the fence-to-
+        fence probe window, i.e. the working set exceeds the resident
+        budget), double the spilled stores' budgets — bounded at 4x the
+        configured base so a genuinely huge key space cannot re-inflate
+        RSS past what the operator asked for."""
+        from pathway_tpu.engine import spill as _spill
+
+        if self._spill_tuned >= 4:
+            return 0
+        stores = [s for s in _spill.stores() if s.has_runs]
+        if not stores:
+            return 0
+        hits = plane.metrics.counter_value(
+            "pathway_spill_probe_tier", {"tier": "run_hit"}
+        )
+        window = hits - self._spill_probe_seen
+        self._spill_probe_seen = hits
+        # thrash signal: at least one full budget's worth of groups came
+        # back off disk since the last fence — the tail is too small to
+        # hold the live working set
+        min_budget = min(s.budget for s in stores)
+        if window < max(min_budget, 64):
+            return 0
+        tuned = []
+        for s in stores:
+            bound = s.base_budget * 4
+            if s.budget < bound:
+                s.budget = min(s.budget * 2, bound)
+                tuned.append({"store": s.label, "budget": s.budget})
+        if not tuned:
+            return 0
+        self._spill_tuned += 1
+        plane.metrics.counter("pathway_planner_retunes")
+        plane.record(
+            "replan", action="spill_retune",
+            run_hits=int(window), stores=tuned,
+        )
+        self.report["replans"].append({
+            "action": "spill_retune", "run_hits": int(window),
+            "stores": tuned,
         })
         return 1

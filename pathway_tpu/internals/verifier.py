@@ -53,6 +53,13 @@ Checks (each independent of the code it audits; see the matching
   layouts, byte-matching staging-buffer schema (4 u64 lanes per row;
   the interior program re-passes the native-program schema check), and
   absorbed-flag consistency with ``Graph.step``'s skip rule.
+* ``spill-contract`` — every out-of-core arrangement (engine/spill.py):
+  positive resident budget, manifest covers the sealed runs exactly
+  (count + record-total redundancy catches a run dropped from the
+  listing), and the exclusive-residency invariant behind the probe
+  ladder (a key live in two tiers would let tail-first-then-newest-run
+  serve stale state). Restore re-runs the manifest checks on every
+  spill manifest embedded in a checkpoint BEFORE any node mutates.
 """
 
 from __future__ import annotations
@@ -866,6 +873,47 @@ def check_cone_contract(session, v: _Verdict, shared: dict) -> None:
             )
 
 
+# ---------------------------------------------- check: spill contract
+
+
+def check_spill_contract(session, v: _Verdict, shared: dict) -> None:
+    """Prove every out-of-core arrangement's spill contract
+    (engine/spill.py) before data flows: the probe ladder is only sound
+    when a key is live in EXACTLY one tier (resident tail or one run's
+    live set — tail-first-then-newest-run-first stops at the first hit),
+    the run manifest covers the runs exactly, and the resident budget is
+    a positive group count (a zero budget would thrash every wave
+    through disk)."""
+    from pathway_tpu.engine import spill as _spill
+
+    check = "spill-contract"
+    v.start(check)
+    stores = 0
+    for node in session.graph.nodes:
+        getter = getattr(node, "spill_stores", None)
+        if getter is None:
+            continue
+        for store in getter():
+            stores += 1
+            who = f"{node.describe()}:{store.label}"
+            if store.budget <= 0:
+                v.violation(
+                    check,
+                    f"{who}: non-positive resident budget "
+                    f"{store.budget}; every probe would take the disk "
+                    "ladder",
+                )
+            try:
+                _spill.verify_manifest(store.manifest(), who)
+            except PlanVerificationError as e:
+                v.violation(check, str(e.findings[0] if e.findings else e))
+            try:
+                _spill.check_two_tier(store, who)
+            except PlanVerificationError as e:
+                v.violation(check, str(e.findings[0] if e.findings else e))
+    v.report["checks"][check]["stores"] = stores
+
+
 # ---------------------------------------------------------------- driver
 
 _CHECKS = (
@@ -876,6 +924,7 @@ _CHECKS = (
     check_native_programs,
     check_exchange_donation,
     check_cone_contract,
+    check_spill_contract,
 )
 
 

@@ -736,6 +736,26 @@ def _adapt_shard_state(node: Any, st: dict) -> dict:
     return adapt_shard_state(node, st)
 
 
+def _validate_spill_manifests(st: Any, pid: str) -> None:
+    """Phase-1 validation of every spill-run manifest embedded in a
+    decoded snapshot. Semantic tamper (run missing from the listing,
+    bad record totals) raises PlanVerificationError — restore REFUSES;
+    file-level damage (missing/torn run segments) raises RuntimeError —
+    restore falls back one epoch like any other unreadable snapshot."""
+    from pathway_tpu.engine import spill as _spill
+
+    if _spill.is_manifest(st):
+        _spill.verify_manifest(st, pid)
+        _spill.validate_manifest_files(st)
+        return
+    if isinstance(st, dict):
+        for v in st.values():
+            _validate_spill_manifests(v, pid)
+    elif isinstance(st, (list, tuple)):
+        for v in st:
+            _validate_spill_manifests(v, pid)
+
+
 class CheckpointManager:
     """Orchestrates checkpoints: journal fsync → operator snapshots →
     metadata commit → compaction. Restores in the opposite order."""
@@ -748,6 +768,13 @@ class CheckpointManager:
         self.journal = SegmentedJournal(root)
         self.metadata = MetadataStore(root)
         self.ops = OperatorSnapshotStore(root)
+        # spilled arrangements (engine/spill.py) keep their runs under
+        # the same persistence root so checkpoint manifests stay valid
+        # across restarts; without persistence the runs live in a
+        # per-process tempdir instead
+        from pathway_tpu.engine import spill as _spill
+
+        _spill.set_root(root, persistent=True)
         self.signature = _pipeline_signature(session.graph)
         self.epoch = 0
         self._last_checkpoint = _time.monotonic()
@@ -910,6 +937,8 @@ class CheckpointManager:
         (nothing has been mutated). Returns None when the epoch is
         unusable: a snapshot is corrupt, un-adaptable, or listed in the
         epoch's manifest but missing on disk."""
+        from pathway_tpu.internals.verifier import PlanVerificationError
+
         epoch = int(rec["epoch"])
         manifest = rec.get("op_snapshots")
         restored: list[tuple[Any, dict]] = []
@@ -924,9 +953,16 @@ class CheckpointManager:
                             "the epoch manifest but missing on disk"
                         )
                     continue  # stateless node: never snapshotted
+                _validate_spill_manifests(st, pid)
                 # worker-count changes re-partition here, BEFORE any node
                 # mutates — RescaleUnsupported falls back cleanly
                 restored.append((node, _adapt_shard_state(node, st)))
+        except PlanVerificationError:
+            # a TAMPERED spill manifest (keys in two tiers, runs missing
+            # from the listing) is a contract violation, not a degraded
+            # disk: refuse loudly before any data flows rather than
+            # silently serving an older epoch
+            raise
         except Exception as e:  # noqa: BLE001
             self.session.graph.log_error(
                 f"operator snapshot unreadable (epoch {epoch}): {e}"
@@ -1032,6 +1068,12 @@ class CheckpointManager:
         # can roll back one epoch when peers crashed between commits
         if wrote_ops:
             self.ops.compact({epoch - 1, epoch})
+            # spill runs retired by compaction stay on disk until they
+            # have survived enough checkpoints that no restorable epoch's
+            # manifest can still reference them
+            from pathway_tpu.engine import spill as _spill
+
+            _spill.collect_garbage()
             prev_offsets = (
                 prev_record.get("offsets", {}) if prev_record else {}
             )

@@ -253,6 +253,15 @@ KINDS = {
     "device_wire": lambda seed: (
         f"seed={seed};mesh.device_wire@{1 + seed}+"
     ),
+    # crash INSIDE spill compaction: the merged run is written but the
+    # generation swap never happens — recovery must restore from the
+    # pre-merge runs (still on disk, still in the committed manifest)
+    # and replay to output byte-identical with the unspilled baseline.
+    # Runs under a 2-group resident budget so the 7-word state spills
+    # and compacts constantly (KIND_ENV)
+    "compaction_mid_merge": lambda seed: (
+        f"seed={seed};state.compaction.mid_merge@{1 + seed}"
+    ),
 }
 # per-kind workload environment (applied to the FAULTED runs only; the
 # baseline stays the plain single-thread host-wire run, which is exactly
@@ -263,14 +272,23 @@ KIND_ENV = {
         "PATHWAY_DEVICE_EXCHANGE": "1",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
     },
+    # object plane (the native count-mode groupby never builds the
+    # MultisetState tier that spills), tiny budget, eager compaction
+    "compaction_mid_merge": {
+        "PATHWAY_TPU_NATIVE": "0",
+        "PATHWAY_SPILL": "1",
+        "PATHWAY_SPILL_BUDGET": "2",
+        "PATHWAY_SPILL_COMPACT": "2",
+    },
 }
 SINK_KINDS = {"sink_pre_seal", "sink_post_seal", "sink_torn_flush"}
 CRASH_KINDS = {
     "crash_mid_wave", "torn_metadata", "torn_journal", "lost_snapshot",
+    "compaction_mid_merge",
 } | SINK_KINDS
 QUICK_KINDS = [
     "crash_mid_wave", "torn_metadata", "connector_flap", "device_dispatch",
-    "sink_post_seal", "device_wire",
+    "sink_post_seal", "device_wire", "compaction_mid_merge",
 ]
 MAX_GENERATIONS = 4  # a schedule may land a crash in the recovery window
 

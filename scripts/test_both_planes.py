@@ -73,6 +73,12 @@ killed (PATHWAY_MEGAKERNEL=0) — every wave fires per-node, the
 byte-identity baseline the single-dispatch cone is pinned against
 (docs/megakernel.md); the cone-on side runs inside legs 1-2 and the
 per-pipeline A/B comparisons live in tests/test_megakernel.py.
+Leg 17 (spill-off): the stateful-operator suites with the out-of-core
+state tier killed (PATHWAY_SPILL=0) — join/groupby arrangements stay
+fully resident and must be byte-identical to the spill-enabled default
+(docs/persistence.md §out-of-core); the spill-on side (tiny-budget A/B,
+probe ladder, compaction, manifest checkpoints) lives in
+tests/test_spill.py and runs inside legs 1-2.
 
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
@@ -312,6 +318,21 @@ def main() -> int:
                 "tests/test_plan_optimizer.py",
                 "tests/test_column_plane.py",
                 "tests/test_io_formats.py",
+                "tests/test_persistence.py",
+            ],
+        ),
+        # out-of-core state tier killed: arrangements stay fully
+        # resident, the byte-identity baseline the LSM spill path is
+        # pinned against; the spill-on A/B + corruption matrix lives in
+        # tests/test_spill.py + test_persistence_matrix.py (legs 1-2)
+        run_leg(
+            "spill-off", {"PATHWAY_SPILL": "0"}, extra,
+            [
+                "tests/test_spill.py",
+                "tests/test_join_matrix.py",
+                "tests/test_reducers_matrix.py",
+                "tests/test_iterate.py",
+                "tests/test_persistence_matrix.py",
                 "tests/test_persistence.py",
             ],
         ),

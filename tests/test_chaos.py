@@ -306,10 +306,10 @@ def test_chaos_equivalence_matrix(tmp_path):
         sorted(chaos_drill.KINDS), [0, 1, 2], workdir=str(tmp_path)
     )
     assert report["ok"], "\n".join(report.get("failures", []))
-    expected_kinds = 9 if report["exactly_once"] else 6
+    expected_kinds = 10 if report["exactly_once"] else 7
     assert len(report["cases"]) >= expected_kinds * 3
     crashed = [c for c in report["cases"] if c["generations"] > 1]
-    min_crash = (7 if report["exactly_once"] else 4) * 3
+    min_crash = (8 if report["exactly_once"] else 5) * 3
     assert len(crashed) >= min_crash, "crash kinds must actually crash"
     base = report["baseline"]
     if report["exactly_once"]:

@@ -355,3 +355,119 @@ def test_every_epoch_unusable_degrades_to_journal_replay(tmp_path):
             f.write(b"corrupt")
     _cap, m = _restore_fresh(build, root)
     assert not m.restored and m.epoch == 0
+
+
+# ------------------------------------------- spilled-state corruption
+
+
+def _two_epoch_spilled_checkpoint(tmp_path, monkeypatch):
+    """Like _two_epoch_checkpoint, but a spill run is sealed between the
+    two checkpoints: epoch 2's snapshot references an on-disk run via its
+    manifest while epoch 1 is fully resident. Damage to the run file must
+    cost exactly one epoch — never the whole checkpoint history.
+
+    The max reducer forces the python (MultisetState) groupby path —
+    native fixed-width accumulator modes never spill by design, and
+    native availability is cached process-wide so an env toggle here
+    could not switch it off."""
+
+    def build():
+        return (
+            pw.debug.table_from_rows(
+                pw.schema_from_types(g=str, v=int),
+                [("a", 1), ("b", 2), ("a", 3)],
+            )
+            .groupby(pw.this.g)
+            .reduce(
+                g=pw.this.g,
+                s=pw.reducers.sum(pw.this.v),
+                m=pw.reducers.max(pw.this.v),
+            )
+        )
+
+    root = str(tmp_path / "p")
+    s = Session()
+    s.capture(build())
+    s.execute()
+    m = CheckpointManager(s, Config(Backend.filesystem(root)))
+    m.checkpoint(finalized_time=10)
+    node = next(n for n in s.graph.nodes if hasattr(n, "_maybe_spill"))
+    monkeypatch.setenv("PATHWAY_SPILL", "1")  # the helper spills even in the spill-off CI leg
+    monkeypatch.setenv("PATHWAY_SPILL_BUDGET", "1")
+    node._maybe_spill()
+    assert node._spill is not None and node._spill.has_runs
+    run_path = node._spill.runs[0].path
+    m.checkpoint(finalized_time=20)
+    meta = m.metadata.load()
+    assert meta["epoch"] == 2 and meta["history"][0]["epoch"] == 1
+    return build, root, meta, run_path
+
+
+def test_torn_spill_run_tail_falls_back_one_epoch(tmp_path, monkeypatch):
+    """A run segment torn mid-frame (crash between the data write and
+    the fsync of a copy) fails the crc-frame walk during phase-1 manifest
+    validation: epoch 2 is rejected before any node state mutates, and
+    restore lands on the fully-resident epoch 1."""
+    build, root, _meta, run_path = _two_epoch_spilled_checkpoint(
+        tmp_path, monkeypatch
+    )
+    size = os.path.getsize(run_path)
+    with open(run_path, "r+b") as f:
+        f.truncate(size - 3)  # torn mid-record
+    cap, m = _restore_fresh(build, root)
+    assert m.restored and m.epoch == 1
+    assert {tuple(r) for r in cap.state.rows.values()} == {
+        ("a", 4, 3),
+        ("b", 2, 2),
+    }
+
+
+def test_spill_run_missing_from_disk_falls_back_one_epoch(tmp_path, monkeypatch):
+    """Epoch 2's manifest lists a run whose file is gone (the mismatch an
+    interrupted rsync of the persistence root leaves behind): restore
+    must detect the hole loudly during validation and fall back one
+    epoch, not probe into a missing file mid-wave later."""
+    build, root, _meta, run_path = _two_epoch_spilled_checkpoint(
+        tmp_path, monkeypatch
+    )
+    os.unlink(run_path)
+    cap, m = _restore_fresh(build, root)
+    assert m.restored and m.epoch == 1
+    assert {tuple(r) for r in cap.state.rows.values()} == {
+        ("a", 4, 3),
+        ("b", 2, 2),
+    }
+
+
+def test_tampered_spill_manifest_refuses_restore_by_name(tmp_path, monkeypatch):
+    """Semantic manifest damage (run-count disagrees with the run list)
+    is a contract violation, not bit-rot: restore must refuse with a
+    named PlanVerificationError rather than silently serving an older
+    epoch — the older epoch's data is fine, but the tamper means the
+    storage root can no longer be trusted."""
+    from pathway_tpu.internals.verifier import PlanVerificationError
+    from pathway_tpu.persistence import codec
+
+    build, root, meta, _run_path = _two_epoch_spilled_checkpoint(
+        tmp_path, monkeypatch
+    )
+    op_dir = os.path.join(root, "operator")
+    tampered = False
+    for pid in meta["op_snapshots"]:
+        path = os.path.join(op_dir, f"{pid}.2.state")
+        with open(path, "rb") as f:
+            state = next(iter(codec.read_records(f.read(), with_magic=True)))
+        man = state.get("spill")
+        if not isinstance(man, dict) or "n_runs" not in man:
+            continue
+        man["n_runs"] = man["n_runs"] + 1  # claims a run that was never listed
+        with open(path, "wb") as f:
+            f.write(codec.encode_record(state, with_magic=True))
+        tampered = True
+    assert tampered, "one snapshot must carry the spill manifest"
+    G.clear()
+    s = Session()
+    s.capture(build())
+    m = CheckpointManager(s, Config(Backend.filesystem(root)))
+    with pytest.raises(PlanVerificationError, match="missing from the manifest"):
+        m.restore()

@@ -1,0 +1,395 @@
+"""Out-of-core operator state (engine/spill.py): the LSM spill tier for
+join/groupby arrangements. Unit mechanics (seal, the fence/bloom/disk
+probe ladder, promotion tombstones, tiered compaction with mid-merge
+replay, deferred GC), the exclusive-residency invariant, the manifest
+tamper matrix (PlanVerificationError by name) vs file damage
+(RuntimeError / one-epoch fallback, see test_persistence_matrix.py),
+checkpoint+restore of a spilled arrangement, and A/B byte-identity:
+a tiny resident budget must not change a single output byte vs
+PATHWAY_SPILL=0 (docs/persistence.md §out-of-core)."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import spill
+from pathway_tpu.internals.lowering import Session
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.verifier import PlanVerificationError
+from pathway_tpu.persistence import codec
+
+
+@pytest.fixture(autouse=True)
+def _fresh(tmp_path):
+    G.clear()
+    saved = (spill._ROOT, spill._PERSISTENT)
+    spill.set_root(str(tmp_path), persistent=True)
+    yield
+    G.clear()
+    with spill._ROOT_LOCK:
+        spill._ROOT, spill._PERSISTENT = saved
+
+
+# ------------------------------------------------------- store mechanics
+
+
+def test_seal_and_promote_roundtrip():
+    store = spill.store_for("unit-a", budget=4)
+    items = [
+        (f"k{i:04d}".encode(), f"payload-{i}".encode() * 3)
+        for i in range(300)  # > _SPARSE_EVERY: probes cross index windows
+    ]
+    assert store.seal(items) == 300
+    assert store.has_runs and store.run_count == 1
+    for i in range(0, 300, 7):
+        kb, payload = items[i]
+        assert store.take(kb) == payload
+    assert store.promotions == 43
+    # promotion marks the key dead in its run: exclusive residency means
+    # the ladder must MISS it from now on (the tail owns it)
+    assert store.take(b"k0007") is None
+    assert store.take(b"never-sealed") is None
+
+
+def test_compaction_merges_shadows_and_drops_dead():
+    store = spill.store_for("unit-c", budget=4)
+    store.seal([(b"a", b"pa1"), (b"b", b"pb")])
+    assert store.take(b"a") == b"pa1"  # dead in run 1
+    store.seal([(b"a", b"pa2")])  # re-spilled: newer run shadows run 1
+    store.seal([(b"c", b"pc")])
+    assert store.run_count == 3
+    assert store.compact_once()
+    assert store.run_count == 1
+    assert store.take(b"b") == b"pb"
+    assert store.take(b"a") == b"pa2"  # newest-run-first merge order
+    assert store.take(b"c") == b"pc"
+
+
+def test_compaction_all_dead_leaves_no_run():
+    store = spill.store_for("unit-d", budget=4)
+    store.seal([(b"x", b"p")])
+    store.seal([(b"y", b"q")])
+    store.take(b"x")
+    store.take(b"y")
+    assert store.compact_once()  # tombstone GC: nothing survives
+    assert store.run_count == 0
+
+
+def test_mid_merge_promotion_replayed_on_merged_run(monkeypatch):
+    """A key promoted to the tail WHILE the merge is running (after the
+    snapshot was cut) must not resurrect from the merged run: the swap
+    replays the mid-merge dead set onto the new generation."""
+    store = spill.store_for("unit-m", budget=4)
+    store.seal([(b"a", b"pa"), (b"b", b"pb")])
+    store.seal([(b"c", b"pc")])
+    grabbed = {}
+    real_crash = spill._faults.crash
+
+    def crash_hook(kind):
+        # the injection point sits exactly in the window: merged run
+        # durable, generation swap not yet taken
+        if kind == "state.compaction.mid_merge" and not grabbed:
+            grabbed["a"] = store.take(b"a")
+        return real_crash(kind)
+
+    monkeypatch.setattr(spill._faults, "crash", crash_hook)
+    assert store.compact_once()
+    assert grabbed["a"] == b"pa"
+    assert store.take(b"a") is None  # tail owns it; no resurrection
+    assert store.take(b"b") == b"pb"
+    spill.check_two_tier(store)
+
+
+def test_deferred_gc_and_orphan_collection():
+    store = spill.store_for("unit-g", budget=4)
+    store.seal([(b"a", b"p")])
+    store.seal([(b"b", b"q")])
+    old_paths = [r.path for r in store.runs]
+    assert store.compact_once()
+    # persistent root: the last durable checkpoints' manifests may still
+    # name the merged-away files — the unlink is deferred two ticks
+    assert all(os.path.exists(p) for p in old_paths)
+    assert store.collect_garbage() == 0
+    assert store.collect_garbage() == 2
+    assert not any(os.path.exists(p) for p in old_paths)
+    # a stray half-merged run no generation references is an orphan
+    stray = os.path.join(store.dir, "run-99999999.seg")
+    with open(stray, "wb") as f:
+        f.write(b"half-merged junk")
+    assert store.gc_orphans() == 1
+    assert not os.path.exists(stray)
+
+
+def test_manifest_attach_roundtrip_preserves_dead_set():
+    store = spill.store_for("unit-r", budget=4)
+    items = [(f"k{i}".encode(), f"p{i}".encode()) for i in range(100)]
+    store.seal(items[:60])
+    store.seal(items[60:])
+    assert store.take(b"k3") == b"p3"
+    man = store.manifest()
+    assert spill.is_manifest(man)
+    assert man["n_runs"] == 2 and man["total_records"] == 100
+    # the manifest round-trips through the snapshot codec unchanged
+    man = codec.decode_value(codec.encode_value(man))
+    back = spill.attach_store(man)
+    assert back.run_count == 2
+    assert back.take(b"k3") is None  # the tombstone survived restore
+    for i in (10, 45, 75, 99):
+        assert back.take(f"k{i}".encode()) == f"p{i}".encode()
+
+
+# ------------------------------------------------- verification contract
+
+
+def test_verify_manifest_tamper_matrix():
+    store = spill.store_for("unit-v", budget=4)
+    store.seal([(b"a", b"p"), (b"b", b"q")])
+    store.seal([(b"c", b"r")])
+    man = store.manifest()
+    spill.verify_manifest(man)  # the honest manifest is clean
+
+    bad = dict(man, n_runs=man["n_runs"] + 1)
+    with pytest.raises(PlanVerificationError, match="missing from the manifest"):
+        spill.verify_manifest(bad)
+
+    bad = dict(man, total_records=man["total_records"] + 5)
+    with pytest.raises(PlanVerificationError, match="missing from the manifest"):
+        spill.verify_manifest(bad)
+
+    bad = dict(man, runs=list(reversed(man["runs"])))
+    with pytest.raises(PlanVerificationError, match="out of order"):
+        spill.verify_manifest(bad)
+
+    runs = [dict(man["runs"][0]), dict(man["runs"][1])]
+    runs[0]["dead"] = [b"a", b"b", b"forged"]
+    bad = dict(man, runs=runs)
+    with pytest.raises(PlanVerificationError, match="more dead keys"):
+        spill.verify_manifest(bad)
+
+    bad = {k: v for k, v in man.items() if k != spill.MANIFEST_MARK}
+    assert not spill.is_manifest(bad)
+    with pytest.raises(PlanVerificationError, match="missing manifest marker"):
+        spill.verify_manifest(bad)
+
+
+def test_validate_manifest_files_damage_matrix():
+    store = spill.store_for("unit-f", budget=4)
+    store.seal([(f"k{i}".encode(), b"x" * 32) for i in range(20)])
+    man = store.manifest()
+    path = store.runs[0].path
+    spill.validate_manifest_files(man)
+
+    orig = open(path, "rb").read()
+    # torn tail: crash mid-copy lost the last bytes
+    with open(path, "wb") as f:
+        f.write(orig[:-3])
+    with pytest.raises(RuntimeError, match="torn segment"):
+        spill.validate_manifest_files(man)
+    # same length, last frame's crc no longer matches (bit rot)
+    with open(path, "wb") as f:
+        f.write(orig[:-4] + bytes(b ^ 0xFF for b in orig[-4:]))
+    with pytest.raises(RuntimeError, match="torn segment tail"):
+        spill.validate_manifest_files(man)
+    # gone entirely
+    os.unlink(path)
+    with pytest.raises(RuntimeError, match="missing on disk"):
+        spill.validate_manifest_files(man)
+
+
+def test_check_two_tier_names_the_offending_tiers():
+    store = spill.store_for("unit-t", budget=4)
+    store.seal([(b"k", b"p1")])
+    store.seal([(b"k", b"p2")])  # forged: one key live in two runs
+    with pytest.raises(PlanVerificationError, match="live in runs"):
+        spill.check_two_tier(store)
+
+    store2 = spill.store_for("unit-t2", budget=4)
+    store2.seal([(b"q", b"p")])
+    store2.tail_keys = lambda: [b"q"]  # forged: live in tail AND a run
+    with pytest.raises(PlanVerificationError, match="resident in the tail"):
+        spill.check_two_tier(store2)
+
+
+# --------------------------------------------- arrangement-level spill
+
+
+def test_multiset_spill_promote_and_retract():
+    from pathway_tpu.engine.core import (
+        MultisetState,
+        _spill_evict_multiset,
+        freeze_value,
+    )
+
+    st = MultisetState()
+    for i in range(20):
+        st.update_one(f"g{i}", ("row", i), 1)
+    store = spill.store_for("unit-ms", budget=5)
+
+    def resolve(dkey):
+        raw = store.take(codec.encode_value(dkey))
+        if raw is None:
+            return
+        entries = codec.decode_value(raw)
+        st.groups[dkey] = {freeze_value(p): (p, c) for p, c in entries}
+
+    st.spill_attach(store, resolve)
+    store.tail_keys = lambda: (codec.encode_value(k) for k in st.groups)
+
+    def pack(dkey, group):
+        return codec.encode_value(tuple(group.values()))
+
+    n = _spill_evict_multiset(st, store, pack)
+    assert n == 17 and store.has_runs  # coldest-first down to low water
+    assert set(st.groups) == {"g17", "g18", "g19"}
+    spill.check_two_tier(store)
+    # read miss promotes through the resolve hook, payload intact
+    assert st.get("g2") == [(("row", 2), 1)]
+    assert "g2" in st.groups
+    # a retraction against a spilled group promotes then folds to zero
+    st.update_one("g7", ("row", 7), -1)
+    assert "g7" not in st.groups
+    assert store.take(codec.encode_value("g7")) is None
+    spill.check_two_tier(store)
+
+
+# ---------------------------------------------------- pipeline A/B + CI
+
+
+def _capture(build, env: dict):
+    """Run the pipeline with env overlaid; return (rows, sealed runs)."""
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        G.clear()
+        s = Session()
+        cap = s.capture(build())
+        s.execute()
+        runs = sum(
+            st.run_count
+            for n in s.graph.nodes
+            for st in getattr(n, "spill_stores", list)()
+        )
+        return {tuple(r) for r in cap.state.rows.values()}, runs
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _groupby_build():
+    rows = [(f"g{i % 7}", i) for i in range(40)]
+    return (
+        pw.debug.table_from_rows(pw.schema_from_types(g=str, v=int), rows)
+        .groupby(pw.this.g)
+        .reduce(
+            g=pw.this.g,
+            s=pw.reducers.sum(pw.this.v),
+            m=pw.reducers.max(pw.this.v),  # non-native: MultisetState path
+        )
+    )
+
+
+def _join_build():
+    left = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, lv=str),
+        [(i % 11, f"l{i}") for i in range(50)],
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, rv=str),
+        [(i % 7, f"r{i}") for i in range(30)],
+    )
+    return left.join(right, left.k == right.k).select(
+        left.k, left.lv, right.rv
+    )
+
+
+def test_groupby_spill_ab_byte_identical():
+    on, runs_on = _capture(
+        _groupby_build, {"PATHWAY_SPILL": "1", "PATHWAY_SPILL_BUDGET": "2"}
+    )
+    off, runs_off = _capture(_groupby_build, {"PATHWAY_SPILL": "0"})
+    assert runs_on > 0, "a 2-group budget over 7 groups must seal runs"
+    assert runs_off == 0
+    assert on == off
+
+
+def test_join_spill_ab_byte_identical():
+    on, runs_on = _capture(
+        _join_build, {"PATHWAY_SPILL": "1", "PATHWAY_SPILL_BUDGET": "2"}
+    )
+    off, runs_off = _capture(_join_build, {"PATHWAY_SPILL": "0"})
+    assert runs_on > 0, "a 2-group budget over 11 join keys must seal runs"
+    assert runs_off == 0
+    assert on == off
+
+
+def test_default_budget_stays_resident():
+    """PATHWAY_SPILL=1 is the default, but with the default budget an
+    all-resident pipeline must seal ZERO runs — the spill tier is
+    byte-invisible until state actually outgrows RAM."""
+    rows, runs = _capture(_groupby_build, {})
+    assert runs == 0 and rows
+
+
+def test_checkpoint_restore_spilled_arrangement(tmp_path, monkeypatch):
+    """A checkpoint of a spilled arrangement is (manifest + tail); the
+    restored node must serve the same bytes, promoting restored runs
+    through the rebuilt sparse index on first touch."""
+    from pathway_tpu.persistence import Backend, CheckpointManager, Config
+
+    root = str(tmp_path / "ckpt")
+    s = Session()
+    cap1 = s.capture(_groupby_build())
+    s.execute()
+    m = CheckpointManager(s, Config(Backend.filesystem(root)))
+    node = next(n for n in s.graph.nodes if hasattr(n, "_maybe_spill"))
+    monkeypatch.setenv("PATHWAY_SPILL", "1")
+    monkeypatch.setenv("PATHWAY_SPILL_BUDGET", "1")
+    node._maybe_spill()
+    assert node._spill is not None and node._spill.has_runs
+    m.checkpoint(finalized_time=10)
+    want = {tuple(r) for r in cap1.state.rows.values()}
+
+    G.clear()
+    s2 = Session()
+    cap2 = s2.capture(_groupby_build())
+    m2 = CheckpointManager(s2, Config(Backend.filesystem(root)))
+    m2.restore()
+    assert m2.restored
+    assert {tuple(r) for r in cap2.state.rows.values()} == want
+    node2 = next(n for n in s2.graph.nodes if hasattr(n, "_maybe_spill"))
+    store = node2._spill
+    assert store is not None and store.has_runs
+    spill.check_two_tier(store, "restored reduce")
+    # promotion off a restored run: the index rebuilds from one read
+    run = store.runs[0]
+    kb = next(
+        k for (_o, _h, k, _p) in store._read_run(run) if k not in run.dead
+    )
+    assert store.take(kb) is not None
+
+
+def test_verify_session_proves_spill_contract(monkeypatch):
+    from pathway_tpu.internals import verifier
+
+    monkeypatch.setenv("PATHWAY_SPILL", "1")
+    monkeypatch.setenv("PATHWAY_SPILL_BUDGET", "2")
+    G.clear()
+    s = Session()
+    s.capture(_groupby_build())
+    s.execute()
+    rep = verifier.verify_session(s)
+    assert rep["checks"]["spill-contract"]["stores"] >= 1
+
+    node = next(n for n in s.graph.nodes if hasattr(n, "_maybe_spill"))
+    store = node._spill
+    assert store is not None and store.has_runs
+    store.seal([(b"forged", b"p1")])
+    store.seal([(b"forged", b"p2")])  # violates exclusive residency
+    with pytest.raises(PlanVerificationError, match="spill-two-tier"):
+        verifier.verify_session(s)
