@@ -899,6 +899,29 @@ def _run_engine_script(
     return med
 
 
+def _paired_overhead_pct(
+    script: str, base_env: dict, obs_env: dict,
+    trials: int = _ENGINE_TRIALS,
+) -> tuple[float, float, list, list]:
+    """Interleaved A/B overhead measurement: each trial runs the base
+    arm then the instrumented arm back-to-back, so slow drift (page
+    cache warm-up, thermal, background load) lands on both arms equally
+    instead of on whichever arm happened to run last. Comparing medians
+    of two NON-interleaved batches once published a -7.4% observability
+    "overhead" — instrumentation measured faster than its own baseline,
+    which is drift, not physics. Returns (raw_overhead_pct, obs_median,
+    base_rates, obs_rates); the caller clamps the published number."""
+    base_rates: list[float] = []
+    obs_rates: list[float] = []
+    for _ in range(trials):
+        base_rates.append(_run_engine_script_once(script, base_env)[0])
+        obs_rates.append(_run_engine_script_once(script, obs_env)[0])
+    base_med = float(np.median(base_rates))
+    obs_med = float(np.median(obs_rates))
+    raw = (1.0 - obs_med / base_med) * 100.0 if base_med > 0 else 0.0
+    return raw, obs_med, base_rates, obs_rates
+
+
 def _gen_wordcount_input(path: str, n: int) -> None:
     rng = np.random.default_rng(7)
     letters = np.array(list("abcdefghijklmnopqrstuvwxyz"))
@@ -1005,10 +1028,20 @@ def bench_dataflow(repo: str) -> dict:
             repo=repo, inp=winp, out=os.path.join(tmp, "wc_out.csv"),
             n=WORDCOUNT_ROWS,
         )
+        # the historical single-thread baseline stays morsel-free so the
+        # rung remains comparable across runs; the morsel arm is its own
+        # rung below and the A/B leg pins their byte equivalence
         out["wordcount_rows_per_sec"] = round(
             _run_engine_script(
-                wc, {"PATHWAY_THREADS": "1"},
+                wc, {"PATHWAY_THREADS": "1", "PATHWAY_MORSEL": "0"},
                 stats=stats, rung="wordcount_rows_per_sec",
+            ),
+            1,
+        )
+        out["wordcount_morsel_rows_per_sec"] = round(
+            _run_engine_script(
+                wc, {"PATHWAY_THREADS": "1", "PATHWAY_MORSEL": "1"},
+                stats=stats, rung="wordcount_morsel_rows_per_sec",
             ),
             1,
         )
@@ -1051,14 +1084,30 @@ def bench_dataflow(repo: str) -> dict:
         # observability overhead rung: the same wordcount with the full
         # instrumentation plane on (wave tracing + metrics + flight
         # ring). Acceptance: <10% enabled; the disabled cost IS the
-        # baseline above (every probe is one `PLANE is None` test).
-        obs_rate = _run_engine_script(
-            wc, {"PATHWAY_THREADS": "1", "PATHWAY_OBSERVABILITY": "1"},
-            stats=stats, rung="wordcount_obs_rows_per_sec",
+        # baseline (every probe is one `PLANE is None` test). The two
+        # arms run INTERLEAVED with a fresh paired baseline — the
+        # headline wordcount median above is measured minutes apart and
+        # comparing across that gap once published a negative overhead.
+        raw_ovh, obs_rate, ovh_base, ovh_obs = _paired_overhead_pct(
+            wc,
+            {"PATHWAY_THREADS": "1", "PATHWAY_MORSEL": "0"},
+            {"PATHWAY_THREADS": "1", "PATHWAY_MORSEL": "0",
+             "PATHWAY_OBSERVABILITY": "1"},
         )
+        stats["wordcount_obs_rows_per_sec"] = {
+            "median": round(float(np.median(ovh_obs)), 1),
+            "best": round(max(ovh_obs), 1),
+            "trials": [round(x, 1) for x in ovh_obs],
+            "paired_base_trials": [round(x, 1) for x in ovh_base],
+        }
         out["wordcount_obs_rows_per_sec"] = round(obs_rate, 1)
-        out["observability_overhead_pct"] = round(
-            (1.0 - obs_rate / out["wordcount_rows_per_sec"]) * 100, 1
+        # an instrumentation plane cannot make the pipeline faster: a
+        # negative raw delta is measurement noise, so the published
+        # overhead clamps at 0 and the note keeps the raw reading
+        out["observability_overhead_pct"] = round(max(raw_ovh, 0.0), 1)
+        out["observability_overhead_pct_note"] = (
+            f"raw paired delta {round(raw_ovh, 1)}% "
+            "(negative = noise, clamped to 0)"
         )
         # profiler attribution rung: one profiled run must attribute
         # >=95% of pipeline wall to named operators/stages and state the
@@ -1076,6 +1125,28 @@ def bench_dataflow(repo: str) -> dict:
             out["wordcount_profile_attributed_pct"] = None
             out["wordcount_profile_ingest_share"] = None
             out["wordcount_profile_skip_reason"] = f"failed: {e}"
+        # steal visibility rung: one profiled threads-4 morsel run; the
+        # profiler JSON carries the cumulative pathway_steal_ratio gauge
+        # plus the last wave's queue/steal tallies (docs/parallelism.md).
+        # On a host without 4 CPUs the ratio still reports (stealing is
+        # about queue contention, not core count) but no speedup claim
+        # rides on it — the <4-CPU guard below governs that.
+        steal_prof = os.path.join(tmp, "wc_steal_profile.json")
+        try:
+            _run_engine_script_once(
+                wc,
+                {"PATHWAY_THREADS": "4", "PATHWAY_MORSEL": "1",
+                 "PATHWAY_PROFILE": steal_prof},
+            )
+            with open(steal_prof) as f:
+                sp = json.load(f)
+            morsels = sp.get("morsels") or {}
+            out["wordcount_morsel_steal_ratio"] = morsels.get("steal_ratio")
+            out["wordcount_morsel_last_wave"] = morsels.get("last_wave")
+        except (RuntimeError, OSError, ValueError) as e:
+            out["wordcount_morsel_steal_ratio"] = None
+            out["wordcount_morsel_last_wave"] = None
+            out["wordcount_morsel_steal_skip_reason"] = f"failed: {e}"
         # the object plane is ~10x slower; a 1M-row run measures the same
         # per-row rate without an extra minute of bench wall-clock
         n_py = WORDCOUNT_ROWS // 5

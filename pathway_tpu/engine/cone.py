@@ -68,6 +68,7 @@ from pathway_tpu.engine.core import (
     _NativeProgramBuilder,
     _nb_type,
 )
+from pathway_tpu.engine import morsel as _morsel
 from pathway_tpu.engine.workers import ShardedNode, _pool
 
 __all__ = [
@@ -189,6 +190,25 @@ class WaveCone:
             return self._fallback(time, "object-or-unhinted-wave")
         segs, head.pending = head.pending, []
         head.rows_out += sum(len(s) for s in segs)
+        if _morsel.enabled_cached():
+            # cache-sized morsels: oversized scan segments split into
+            # row-contiguous slices so the fused run and the sharded
+            # update below get steal-balanceable units. Concatenating
+            # the slices reproduces the segment row-for-row (bool-mask
+            # select keeps distinct_hint), so the segment-merge proof
+            # above covers morsels unchanged.
+            rows = _morsel.morsel_rows_cached()
+            if any(len(s) > rows for s in segs):
+                split = [m for s in segs for m in _morsel.split_batch(s, rows)]
+                from pathway_tpu.internals import observability as _obs
+
+                if _obs.PLANE is not None:
+                    _obs.PLANE.metrics.counter(
+                        "pathway_morsel_split_total",
+                        inc=len(split) - len(segs),
+                        help="extra segments created by morsel splitting",
+                    )
+                segs = split
         batches: list = segs
         entries: list = []
         fused = self.fused
@@ -317,12 +337,57 @@ class WaveCone:
 
         if len(touched) == 1:
             run_replica(touched[0])
+        elif _morsel.enabled_cached():
+            # per-replica morsel queues: each (replica, segment) update
+            # is one steal-able unit, each queue runs in segment order
+            # on exactly one thread at a time (StealScheduler's busy
+            # latch), parts collect in segment order, and the closing
+            # task merges + emits ONCE per replica — exactly the serial
+            # run_replica, just drained by whichever worker is idle.
+            _morsel.run_stealing(
+                [self._replica_queue(replicas[s], time, prepared, s)
+                 for s in touched]
+            )
         else:
             futures = [_pool().submit(run_replica, s) for s in touched]
             for f in futures:
                 f.result()  # wave barrier; re-raises replica errors
         sh._emit_collected(time, touched)
         return True
+
+    @staticmethod
+    def _replica_queue(gb, time: int, prepared: list, s: int) -> list:
+        """Ordered morsel tasks for one replica: one native update per
+        prepared segment appending into `parts`, then one merge+emit
+        tail. The queue's in-order, single-consumer execution is what
+        makes parts == the serial segment loop."""
+        parts: list = []
+
+        def update_task(subs, prep):
+            gtok, vals_i, vals_f, tags = prep
+
+            def run() -> None:
+                gb.rows_in += len(subs[s])
+                parts.append(
+                    gb._native.update(
+                        gtok, vals_i, vals_f, tags,
+                        np.ascontiguousarray(subs[s].diff),
+                    )
+                )
+
+            return run
+
+        tasks = [
+            update_task(subs, preps[s])
+            for subs, preps in prepared
+            if preps[s] is not None
+        ]
+
+        def emit_tail() -> None:
+            gb._emit_agg(time, *_merge_agg(parts))
+
+        tasks.append(emit_tail)
+        return tasks
 
     # --------------------------------------------------------- fallback
 

@@ -590,6 +590,7 @@ class Node:
 # dispatch-count buckets: wave dispatches are small integers (operator
 # counts), not latencies — the default latency buckets would flatten them
 _WAVE_DISPATCH_BOUNDS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+_MORSEL_SEG_BOUNDS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
 
 
 class Graph:
@@ -701,6 +702,16 @@ class InputNode(Node):
         nb_t = _nb_type()
         batches = [s for s in out if type(s) is nb_t] if nb_t is not None else []
         entries = [s for s in out if type(s) is not nb_t]
+        if batches and _obs.PLANE is not None:
+            # segments per input wave = morsel units the scan handed over;
+            # the histogram is what the planner's morsel retune reads
+            # alongside task latency to judge split granularity
+            _obs.PLANE.metrics.observe(
+                "pathway_morsel_wave_segments",
+                float(len(batches)),
+                bounds=_MORSEL_SEG_BOUNDS,
+                help="native segments entering one input wave",
+            )
         _emit_merged(self, time, batches, entries)
 
 

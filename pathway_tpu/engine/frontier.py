@@ -568,6 +568,21 @@ class FrontierScheduler:
                 fired += slow
             if cheap == 0 and slow == 0:
                 break
+        plane = _obs.PLANE
+        if plane is not None:
+            # depth of the work-stealing morsel queues left behind by the
+            # waves this pass fired (engine/morsel.py). Sampled here — not
+            # inside the steal loop — so the steady-state reading costs one
+            # gauge per pump instead of one per morsel. Nonzero at the
+            # sample point means a wave returned while stolen morsels were
+            # still draining, i.e. stealing actually overlapped the pump.
+            from pathway_tpu.engine import morsel as _morsel
+
+            plane.metrics.gauge(
+                "pathway_morsel_queue_depth",
+                float(_morsel.live_depth()),
+                help="morsels queued across live steal schedulers",
+            )
         return fired
 
     def _fire_pass(self, slow_tier: bool, limit: int | None = None) -> int:

@@ -429,6 +429,21 @@ void dp_hash128(const char* data, int64_t len, uint64_t* lo, uint64_t* hi) {
                 lo, hi);
 }
 
+// Capability bitmask the Python loader consults before enabling
+// concurrency that leans on kernel-side guarantees.
+//
+// Bit 0 — reentrant ingest: dp_ingest_jsonl / dp_ingest_csv keep all
+// per-call state on the stack (PendingRows, piece buffers, line memo)
+// and touch the shared InternTable only through its shared_mutex: each
+// call interns its morsel's rows as ONE batch under a single write-lock
+// acquisition (PendingRows::flush), so concurrent morsel decodes into
+// one table are safe and the "merge" of their intern batches is simply
+// the lock's admission order — token NUMBERING may differ across
+// schedules, token->bytes mappings never do. A library missing this
+// symbol predates the contract; the loader then degrades morsel decode
+// to serial (io/fs.py consults dataplane.ingest_reentrant()).
+int64_t dp_abi_flags() { return 1; }
+
 // ------------------------------------------------------------- json parsing
 
 namespace {

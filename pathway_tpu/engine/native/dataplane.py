@@ -210,6 +210,27 @@ def available() -> bool:
     return _load() is not None
 
 
+def ingest_reentrant() -> bool:
+    """True when the loaded kernel declares its ingest_* entry points
+    reentrant (bit 0 of dp_abi_flags): per-call state is stack-local and
+    the shared InternTable is only touched through its shared_mutex,
+    with each call interning its morsel's rows as one batch under a
+    single write-lock acquisition. Morsel-parallel scan decode
+    (io/fs.py) gates on this so a stale library without the contract
+    degrades to serial decode instead of racing."""
+    lib = _load()
+    if lib is None:
+        return False
+    fn = getattr(lib, "dp_abi_flags", None)
+    if fn is None:  # pre-contract library: assume nothing
+        return False
+    try:
+        fn.restype = ctypes.c_int64
+        return bool(int(fn()) & 1)
+    except (ctypes.ArgumentError, OSError):
+        return False
+
+
 # -------------------------------------------------------- row (de)serialize
 
 _TAG_NONE, _TAG_BOOL, _TAG_INT, _TAG_FLOAT, _TAG_STR, _TAG_BYTES, _TAG_KEY = (

@@ -32,6 +32,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Sequence
 
 from pathway_tpu.engine.core import Entry, Graph, InputNode, Node
+from pathway_tpu.engine import morsel as _morsel
 from pathway_tpu.analysis import lockgraph as _lockgraph
 
 # Route functions map (key, row) -> an int or hashable token; the shard is
@@ -59,6 +60,21 @@ def _pool() -> ThreadPoolExecutor:
                 thread_name_prefix="pw-worker",
             )
     return _POOL
+
+
+class _FinishTask:
+    """One replica-wave morsel: ``replica.finish_time(t)`` as a repeat-
+    free callable (a bound closure per replica would pin `time` fine
+    too; a named task keeps steal traces readable)."""
+
+    __slots__ = ("replica", "time")
+
+    def __init__(self, replica: Node, time: int):
+        self.replica = replica
+        self.time = time
+
+    def __call__(self) -> None:
+        self.replica.finish_time(self.time)
 
 
 class _Collector:
@@ -277,6 +293,15 @@ class ShardedNode(Node):
         ordered = sorted(active)
         if len(ordered) == 1:
             self.replicas[ordered[0]].finish_time(time)
+        elif _morsel.enabled_cached():
+            # per-replica morsel queues drained with work stealing: the
+            # frontier/static pump no longer pins a replica to the pool
+            # thread that happened to receive its future — idle threads
+            # drain a straggler's queue instead of blocking the barrier
+            # (emission stays on this thread, in replica order, below)
+            _morsel.run_stealing(
+                [[_FinishTask(self.replicas[s], time)] for s in ordered]
+            )
         else:
             futures = [
                 _pool().submit(self.replicas[s].finish_time, time)

@@ -1622,9 +1622,13 @@ class Session:
     ) -> eng.Node:
         # ---- plan optimizer (internals/planner.py): sketch-costed
         # orientation + id elision. The orientation swap is multiset-
-        # equivalent but permutes intra-wave emission order, so it only
-        # applies under the PATHWAY_JOIN_REORDER opt-in; the advice and
-        # its sketches are always recorded in the plan report.
+        # equivalent but permutes intra-wave emission order, so the mode
+        # ladder is: "on" (PATHWAY_JOIN_REORDER=1) swaps on any sketch
+        # win; "auto" (default) swaps only when the sketches disagree by
+        # >= _REORDER_AUTO_RATIOx AND no order-sensitive sink
+        # (subscribe/capture) observes this join — the verifier's
+        # check_join_reorder re-proves both legs; "off" never swaps.
+        # The advice and its sketches are always recorded in the report.
         ctx = self.plan_ctx
         use_cheap_ids = False
         if self.fuse and ctx is not None:
@@ -1645,8 +1649,18 @@ class Session:
                     and r_sk["rows"] is not None
                     and l_sk["rows"] < r_sk["rows"]
                 )
+                mode_ = _planner.join_reorder_mode()
                 applied = False
-                if advise_swap and _planner.join_reorder_enabled():
+                if advise_swap and mode_ == "on":
+                    _planner._swap_join_spec(spec)
+                    applied = True
+                elif (
+                    advise_swap
+                    and mode_ == "auto"
+                    and l_sk["rows"] * _planner._REORDER_AUTO_RATIO
+                    <= r_sk["rows"]
+                    and spec.id not in ctx.order_sensitive
+                ):
                     _planner._swap_join_spec(spec)
                     applied = True
                 self.plan_report["join_orders"].append({
@@ -1654,6 +1668,7 @@ class Session:
                     "left": l_sk,
                     "right": r_sk,
                     "advice": "swap" if advise_swap else "keep",
+                    "mode": mode_,
                     "applied": applied,
                     "trace": getattr(spec, "trace", None),
                 })
@@ -1926,6 +1941,14 @@ class Session:
         if self.plan_ctx is not None:
             rep["elision"]["sources"] = len(self.plan_ctx.cheap_key_sources)
             rep["elision"]["joins"] = len(self.plan_ctx.cheap_id_joins)
+        # morsel gates (engine/morsel.py): snapshot PATHWAY_MORSEL /
+        # PATHWAY_MORSEL_ROWS into the hot-path caches at this seam —
+        # the steal scheduler and cone splitting never read the
+        # environment per wave, and an env flip mid-process applies
+        # from the next session build
+        from pathway_tpu.engine import morsel as _morsel
+
+        _morsel.refresh()
         # wave cones (engine/cone.py): installed BEFORE the verifier so
         # check_cone_contract re-proves every cone ahead of any compile.
         # PATHWAY_MEGAKERNEL=0 skips installation — the per-node fused

@@ -494,7 +494,25 @@ class Profiler:
                 and getattr(graph, "plan_report", None) is not None
                 else {}
             ),
+            # morsel execution visibility: stolen share of executed
+            # morsels (cumulative gauge the steal scheduler maintains)
+            # plus the last wave's queue/steal tallies — docs/parallelism.md
+            **self._morsel_section(),
         }
+
+    @staticmethod
+    def _morsel_section() -> dict:
+        if PLANE is None:
+            return {}
+        ratio = PLANE.metrics.gauge_value("pathway_steal_ratio")
+        if ratio is None:
+            return {}
+        from pathway_tpu.engine import morsel as _morsel
+
+        return {"morsels": {
+            "steal_ratio": round(float(ratio), 4),
+            "last_wave": _morsel.last_run(),
+        }}
 
 
 # ------------------------------------------------------- flight recorder

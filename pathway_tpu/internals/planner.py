@@ -62,10 +62,35 @@ def fuse_enabled() -> bool:
     return os.environ.get("PATHWAY_FUSE", "1") != "0"
 
 
+def join_reorder_mode() -> str:
+    """Sketch-costed join input reordering (permutes intra-wave emission
+    order: multiset-equivalent, not byte-equivalent). Three modes:
+
+    * ``"off"``  — ``PATHWAY_JOIN_REORDER=0``: never reorder.
+    * ``"on"``   — ``PATHWAY_JOIN_REORDER=1``: reorder whenever the
+      sketches say the left input is smaller (the historical opt-in).
+    * ``"auto"`` — unset (the default): reorder only when the sketches
+      disagree by >= ``_REORDER_AUTO_RATIO``x AND no order-sensitive sink
+      (subscribe/capture — anything that observes row ids/arrival order)
+      is downstream of the join, as computed by ``PlanContext`` and
+      re-proved by the verifier's ``check_join_reorder``.
+    """
+    raw = os.environ.get("PATHWAY_JOIN_REORDER")
+    if raw == "0":
+        return "off"
+    if raw == "1":
+        return "on"
+    return "auto"
+
+
+# sketch ratio an "auto" reorder demands: the win must be unambiguous,
+# not a coin flip between two near-equal estimates
+_REORDER_AUTO_RATIO = 4
+
+
 def join_reorder_enabled() -> bool:
-    """Opt-in: sketch-costed join input reordering permutes intra-wave
-    emission order (multiset-equivalent, not byte-equivalent)."""
-    return os.environ.get("PATHWAY_JOIN_REORDER", "0") == "1"
+    """Back-compat boolean view of join_reorder_mode() (forced mode)."""
+    return join_reorder_mode() == "on"
 
 
 def adaptive_enabled() -> bool:
@@ -270,6 +295,23 @@ class PlanContext:
             self.consumers[t._spec.id] = (
                 self.consumers.get(t._spec.id, 0) + 1
             )
+        # specs upstream of an order-sensitive sink (subscribe/capture —
+        # anything that observes row ids / arrival order). The "auto"
+        # join-reorder mode refuses to swap any join such a sink can see,
+        # because reordering permutes intra-wave emission order; fs file
+        # writers declare observes_ids=False and do not pin anything.
+        self.order_sensitive: set[int] = set()
+        for table, observes_ids in (sink_meta or []):
+            if not observes_ids:
+                continue
+            up = [table]
+            while up:
+                t = up.pop()
+                sid = t._spec.id
+                if sid in self.order_sensitive:
+                    continue
+                self.order_sensitive.add(sid)
+                up.extend(_spec_input_tables(t._spec))
         self._analyze(order, sink_meta or [])
 
     # ---------------------------------------------------------- analysis
@@ -568,6 +610,9 @@ class AdaptivePolicy:
         self._exchange_tuned = 0
         self._spill_tuned = 0
         self._spill_probe_seen = 0.0
+        self._morsel_tuned = 0
+        self._morsel_task_seen = 0.0
+        self._morsel_task_total = 0.0
         # fresh tuning per run: the exchanger is a process-wide
         # singleton, and a previous run's doublings must not ratchet
         # into this one (same discipline as the scan-tuning claim)
@@ -599,6 +644,7 @@ class AdaptivePolicy:
         changes = self._refuse_hot_chains(plane)
         changes += self._retune_exchange(plane)
         changes += self._retune_spill(plane)
+        changes += self._retune_morsels(plane)
         if changes and scheduler is not None:
             scheduler.replan_refresh()
         if changes:
@@ -806,5 +852,52 @@ class AdaptivePolicy:
         self.report["replans"].append({
             "action": "spill_retune", "run_hits": int(window),
             "stores": tuned,
+        })
+        return 1
+
+    # --------------------------------------------------- morsel retune
+
+    def _retune_morsels(self, plane) -> int:
+        """Morsel granularity off the wave histograms: the steal
+        scheduler publishes per-morsel execution latency
+        (``pathway_morsel_task_seconds``); a fence window averaging
+        under ~1ms means morsels are paying more claim traffic than
+        compute (double the rows), over ~50ms means a straggler is too
+        coarse for stealing to smooth (halve them). Bounded by
+        ``morsel.set_rows`` (16x either side of the env-configured base)
+        and by the usual per-run retune budget."""
+        from pathway_tpu.engine import morsel as _morsel
+
+        if self._morsel_tuned >= 4 or not _morsel.enabled_cached():
+            return 0
+        cnt, total = plane.metrics.histogram_stats(
+            "pathway_morsel_task_seconds", None
+        )
+        window = cnt - self._morsel_task_seen
+        if window < 64:
+            return 0  # too few morsels since the last fence to judge
+        mean = (total - self._morsel_task_total) / window
+        self._morsel_task_seen = cnt
+        self._morsel_task_total = total
+        rows = _morsel.morsel_rows_cached()
+        if mean < 1e-3:
+            applied = _morsel.set_rows(rows * 2)
+            action = "morsel_retune_up"
+        elif mean > 50e-3:
+            applied = _morsel.set_rows(rows // 2)
+            action = "morsel_retune_down"
+        else:
+            return 0
+        if applied == rows:
+            return 0  # saturated bound: leave the budget for live knobs
+        self._morsel_tuned += 1
+        plane.metrics.counter("pathway_planner_retunes")
+        plane.record(
+            "replan", action=action,
+            mean_ms=round(mean * 1e3, 3), morsel_rows=applied,
+        )
+        self.report["replans"].append({
+            "action": action, "mean_ms": round(mean * 1e3, 3),
+            "morsel_rows": applied,
         })
         return 1

@@ -79,6 +79,14 @@ def _native_info(format: str, schema, csv_settings, with_metadata: bool):  # noq
         # was the PR 9(h) hot-path bug class)
         "chunk": int(os.environ.get("PATHWAY_FS_CHUNK", 4 << 20)),
     }
+    # morsel-parallel decode (engine/morsel.py), likewise decided at
+    # construction. Concurrent decode into one intern table additionally
+    # requires the kernel's reentrancy contract (dp_abi_flags bit 0) —
+    # a stale library without it degrades to the serial chunk path.
+    from pathway_tpu.engine import morsel as _msl
+
+    info["morsel"] = _msl.enabled() and dp.ingest_reentrant()
+    info["morsel_rows"] = _msl.morsel_rows()
     if format in ("json", "jsonlines"):
         info["kind"] = "json"
         # declared dtype tags for lossless literal coercion in C
@@ -413,23 +421,71 @@ def _py_resume_rows(
         yield key, row
 
 
+def _morsel_bodies(info: dict, body: bytes, start_abs: int, m_rows: int):
+    """Record-aligned morsel slices of one chunk body: ≤ m_rows records
+    each, yielded in file order as (sub_body, abs_end_pos). Concatenated
+    in order the slices reproduce the body byte-for-byte, and every
+    slice boundary is a valid resume frontier."""
+    if info["kind"] == "csv":
+        from pathway_tpu.engine import native as zs
+
+        starts, _ends = zs.split_csv_records(body)
+        if len(starts) <= m_rows:
+            yield body, start_abs + len(body)
+            return
+        cuts = [int(starts[k]) for k in range(m_rows, len(starts), m_rows)]
+    else:
+        import numpy as np
+
+        nl = np.flatnonzero(np.frombuffer(body, np.uint8) == 10)
+        if len(nl) + 1 <= m_rows:  # +1: a possible final unterminated line
+            yield body, start_abs + len(body)
+            return
+        cuts = [int(nl[k]) + 1 for k in range(m_rows - 1, len(nl), m_rows)]
+    prev = 0
+    for cut in cuts:
+        if cut <= prev:
+            continue
+        yield body[prev:cut], start_abs + cut
+        prev = cut
+    if prev < len(body):
+        yield body[prev:], start_abs + len(body)
+
+
 def _native_parse_file(
     path: str, info: dict, tab, emit_batch, emit_entry,
     start_pos: int = 0, on_progress: Callable[[int], None] | None = None,
 ):
     """Chunked native parse of one file: complete records go through the C
     parser as NativeBatch segments; rejected lines re-parse in Python.
-    Chunks parse CONCURRENTLY on the worker pool (the C parser releases
-    the GIL), a window at a time, emitted in file order.
+    With morsels on (info['morsel'], decided at connector construction)
+    each chunk splits into record-aligned ~info['morsel_rows'] slices
+    first; either way the units parse CONCURRENTLY on the worker pool
+    (the C parser releases the GIL and interns each unit's rows as one
+    batch — dp_abi_flags bit 0), a window at a time, emitted in file
+    order. The window is the per-source double-buffered prefetch ring:
+    ~2 morsels per worker stay in flight, so file IO and decode overlap
+    the previous wave's compute instead of serializing behind it.
     emit_batch(NativeBatch); emit_entry((key, row)); on_progress(abs_pos)
-    fires after each chunk's rows are emitted (record-aligned byte
-    frontier for persistence)."""
+    fires after each unit's rows are emitted (record-aligned byte
+    frontier for persistence). Key ranges are reserved at submit, in
+    file order, so sequence keys never depend on pool scheduling —
+    PATHWAY_MORSEL=0 reproduces the serial chunk path byte-identically."""
     from pathway_tpu.engine.workers import _pool, worker_threads
 
     pk_idx = info["pk_idx"]
 
-    window = max(2, worker_threads())
-    pool = _pool() if window > 2 else None
+    threads = worker_threads()
+    morsel_on = bool(info.get("morsel"))
+    m_rows = int(info.get("morsel_rows") or 0) or 65536
+    window = max(2, threads)
+    if morsel_on:
+        window = max(window, 2 * threads)
+    pool = (
+        _pool()
+        if (threads > 2 or (morsel_on and threads > 1))
+        else None
+    )
     inflight: list = []
 
     def flush_one() -> None:
@@ -442,7 +498,7 @@ def _native_parse_file(
         if on_progress is not None:
             on_progress(end_pos)
 
-    for body, end_pos in _chunk_bodies(path, info, start_pos):
+    def submit(body: bytes, end_pos: int) -> None:
         # reserve the key range HERE so sequence ranges follow file order
         # regardless of pool scheduling
         n_cap = body.count(b"\n") + (0 if body.endswith(b"\n") else 1)
@@ -455,6 +511,15 @@ def _native_parse_file(
             inflight.append((_parse_body(info, tab, body, seq_start), end_pos))
         if len(inflight) >= window:
             flush_one()
+
+    for body, end_pos in _chunk_bodies(path, info, start_pos):
+        if morsel_on:
+            for sub, sub_end in _morsel_bodies(
+                info, body, end_pos - len(body), m_rows
+            ):
+                submit(sub, sub_end)
+        else:
+            submit(body, end_pos)
     while inflight:
         flush_one()
 

@@ -79,6 +79,12 @@ fully resident and must be byte-identical to the spill-enabled default
 (docs/persistence.md §out-of-core); the spill-on side (tiny-budget A/B,
 probe ladder, compaction, manifest checkpoints) lives in
 tests/test_spill.py and runs inside legs 1-2.
+Leg 18 (morsel-off): the scan/wave suites with morsel-driven execution
+killed (PATHWAY_MORSEL=0) — whole-chunk parses, one future per replica,
+no stealing; the byte-identity baseline the morsel/steal path is pinned
+against (docs/parallelism.md). The morsel-on A/B matrix and the seeded
+straggler-determinism harness live in tests/test_morsel.py and run
+inside legs 1-2.
 
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
@@ -333,6 +339,22 @@ def main() -> int:
                 "tests/test_reducers_matrix.py",
                 "tests/test_iterate.py",
                 "tests/test_persistence_matrix.py",
+                "tests/test_persistence.py",
+            ],
+        ),
+        # morsel execution killed: scans parse whole chunks, waves run
+        # one future per replica, no stealing — the byte-identity
+        # baseline the morsel/steal path is pinned against; the per-
+        # pipeline A/B matrix + seeded straggler determinism live in
+        # tests/test_morsel.py (docs/parallelism.md)
+        run_leg(
+            "morsel-off", {"PATHWAY_MORSEL": "0"}, extra,
+            [
+                "tests/test_morsel.py",
+                "tests/test_workers.py",
+                "tests/test_io_formats.py",
+                "tests/test_megakernel.py",
+                "tests/test_native_engine.py",
                 "tests/test_persistence.py",
             ],
         ),
