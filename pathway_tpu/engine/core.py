@@ -2630,13 +2630,39 @@ class JoinNode(Node):
 
     def merge_shard_states(self, states: list[dict]) -> dict:
         if any(
-            k in st for st in states
+            st.get(k) is not None for st in states
             for k in ("spill", "spill_left", "spill_right")
         ):
-            raise RescaleUnsupported(
-                "spilled join arrangement (on-disk runs) cannot merge "
-                "across worker shards; resume falls back to journal replay"
-            )
+            # spilled arrangements rescale as METADATA: pop the run
+            # manifests, merge the resident tails normally, then fold the
+            # manifests (spill.merge_manifests — run files stay in place)
+            from pathway_tpu.engine import spill as _spill
+
+            stripped = [
+                {
+                    k: v for k, v in st.items()
+                    if k not in ("spill", "spill_left", "spill_right")
+                }
+                for st in states
+            ]
+            merged = self.merge_shard_states(stripped)
+            for key in ("spill_left", "spill_right"):
+                mans = [st[key] for st in states if st.get(key) is not None]
+                if mans:
+                    merged[key] = _spill.merge_manifests(mans)
+            if any(st.get("spill") is not None for st in states):
+                per_side = []
+                for side in range(2):
+                    mans = [
+                        st["spill"][side] for st in states
+                        if st.get("spill") is not None
+                        and st["spill"][side] is not None
+                    ]
+                    per_side.append(
+                        _spill.merge_manifests(mans) if mans else None
+                    )
+                merged["spill"] = per_side
+            return merged
         if not states or "njoin" not in states[0]:
             return super().merge_shard_states(states)
         # native arrangements: concat the flat arrays; intern ids are
@@ -2662,11 +2688,34 @@ class JoinNode(Node):
         return {"njoin": merged}
 
     def split_shard_state(self, merged: dict, n: int, shard_of) -> list[dict]:
-        if any(k in merged for k in ("spill", "spill_left", "spill_right")):
-            raise RescaleUnsupported(
-                "spilled join arrangement (on-disk runs) cannot "
-                "re-partition across worker shards"
-            )
+        if any(
+            merged.get(k) is not None
+            for k in ("spill", "spill_left", "spill_right")
+        ):
+            # metadata split: every shard inherits the full run list as
+            # shared runs (exchange routing keeps probes owner-only)
+            from pathway_tpu.engine import spill as _spill
+
+            rest = {
+                k: v for k, v in merged.items()
+                if k not in ("spill", "spill_left", "spill_right")
+            }
+            outs = self.split_shard_state(rest, n, shard_of)
+            for key in ("spill_left", "spill_right"):
+                man = merged.get(key)
+                if man is not None:
+                    for s, part in enumerate(_spill.split_manifest(man, n)):
+                        outs[s][key] = part
+            if merged.get("spill") is not None:
+                per_side = [
+                    _spill.split_manifest(m, n) if m is not None else None
+                    for m in merged["spill"]
+                ]
+                for s in range(n):
+                    outs[s]["spill"] = [
+                        ps[s] if ps is not None else None for ps in per_side
+                    ]
+            return outs
         if "njoin" not in merged:
             return super().split_shard_state(merged, n, shard_of)
         # shard of a jk = shard of its VALUE tuple: decode the canonical
@@ -3436,11 +3485,18 @@ class GroupByNode(Node):
     def merge_shard_states(self, states: list[dict]) -> dict:
         if not states:
             return {}
-        if any("spill" in st for st in states):
-            raise RescaleUnsupported(
-                "spilled groupby arrangement (on-disk runs) cannot merge "
-                "across worker shards; resume falls back to journal replay"
-            )
+        if any(st.get("spill") is not None for st in states):
+            # metadata rescale: merge the resident tails normally, fold
+            # the run manifests without touching run files
+            from pathway_tpu.engine import spill as _spill
+
+            mans = [st["spill"] for st in states if st.get("spill") is not None]
+            merged = self.merge_shard_states([
+                {k: v for k, v in st.items() if k != "spill"}
+                for st in states
+            ])
+            merged["spill"] = _spill.merge_manifests(mans)
+            return merged
         if "native_plan" in states[0]:
             # group-aligned arrays concatenate; slots align positionally
             aggs = [st["native_plan"] for st in states]
@@ -3500,11 +3556,16 @@ class GroupByNode(Node):
         return super().merge_shard_states(states)
 
     def split_shard_state(self, merged: dict, n: int, shard_of) -> list[dict]:
-        if "spill" in merged:
-            raise RescaleUnsupported(
-                "spilled groupby arrangement (on-disk runs) cannot "
-                "re-partition across worker shards"
-            )
+        if merged.get("spill") is not None:
+            from pathway_tpu.engine import spill as _spill
+
+            rest = {k: v for k, v in merged.items() if k != "spill"}
+            outs = self.split_shard_state(rest, n, shard_of)
+            for s, part in enumerate(
+                _spill.split_manifest(merged["spill"], n)
+            ):
+                outs[s]["spill"] = part
+            return outs
         if "native" in merged:
             # decompose the canonical merged export, routed by group token
             exp, g2t, info = (

@@ -55,6 +55,7 @@ __all__ = [
     "SlotPool",
     "WaveCoalescer",
     "get_device_plane",
+    "reset_quarantines",
 ]
 
 
@@ -282,6 +283,16 @@ class DeviceProgram:
         return min(
             self.PROBE_BASE_S * 2 ** min(failures - 1, 32), self.PROBE_CAP_S
         )
+
+    def reset_quarantine(self) -> int:
+        """Drop every per-bucket quarantine record (generation boundary:
+        a supervisor restart or mesh rebalance starts the new generation
+        with a clean slate — stale cooldowns belong to the device state
+        of a process that no longer exists). Returns entries dropped."""
+        with self._lock:
+            n = len(self.quarantine)
+            self.quarantine.clear()
+        return n
 
     def _admit_probe(self, bucket: Any) -> bool:
         """True when the bucket is healthy, or quarantined but due for a
@@ -591,6 +602,22 @@ class DevicePlane:
                 out[(name, bucket)] = n
         return out
 
+    def reset_quarantines(self) -> int:
+        """Clear quarantine state across every registered program (the
+        new-generation slate wipe; see DeviceProgram.reset_quarantine).
+        Returns the number of (program, bucket) entries dropped."""
+        with self._lock:
+            progs = list(self.programs.values())
+        dropped = sum(p.reset_quarantine() for p in progs)
+        if dropped:
+            from pathway_tpu.internals import observability as _obs
+
+            if _obs.PLANE is not None:
+                _obs.PLANE.record(
+                    "device.quarantine_reset", dropped=dropped
+                )
+        return dropped
+
     def quarantined(self) -> dict[tuple[str, Any], dict[str, Any]]:
         """{(program_name, bucket): quarantine record} for every entry
         currently degraded to the host path (see DeviceProgram).
@@ -739,3 +766,12 @@ def get_device_plane() -> DevicePlane:
         if _plane is None:
             _plane = DevicePlane()
         return _plane
+
+
+def reset_quarantines() -> int:
+    """Generation-boundary slate wipe on the registered plane, if any —
+    never *constructs* a plane just to clear it (a supervisor that ran no
+    device work has nothing to reset)."""
+    with _plane_lock:
+        plane = _plane
+    return plane.reset_quarantines() if plane is not None else 0

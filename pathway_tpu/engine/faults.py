@@ -39,6 +39,12 @@ Examples::
                                                    # seal and the sink flush
     PATHWAY_FAULTS="sink.flush.torn@5"             # die mid-flush, part of
                                                    # a sealed range delivered
+    PATHWAY_FAULTS="mesh.member.join@1"            # fail a join announcement
+                                                   # (mesh.member.leave too)
+    PATHWAY_FAULTS="swap.mid_commit@1"             # die inside a blue/green
+                                                   # swap's rename commit
+    PATHWAY_FAULTS="swap.replay.divergent@1"       # force the green replay
+                                                   # to mismatch -> abort
 
 The sink-side windows (``sink.outbox.pre_seal``, ``sink.outbox.post_seal``,
 ``sink.flush.torn`` — probed in persistence/__init__.py and io/outbox.py)

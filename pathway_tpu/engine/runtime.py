@@ -565,6 +565,42 @@ class Runtime:
                     f"async={sorted(sched._async_waves)} view={view}"
                 )
 
+    def _mesh_rebalance_exit(self, mesh: Any, sid: int) -> None:
+        """End this generation at a membership fence. Every process just
+        committed the same epoch; an rb-ack flag barrier proves it mesh-
+        wide (a process must not exit — killing its wires — while a peer
+        is still quiescing toward that fence). Process 0, the only one
+        holding the lowered graph, then re-homes the persisted shards
+        before exiting. Never returns: raises SystemExit(REBALANCE_EXIT),
+        which the supervisor treats as a planned generation boundary."""
+        from pathway_tpu.parallel import membership as _mb
+        from pathway_tpu.parallel.process_mesh import WorkerLost
+
+        mesh.send_flag(("rb-ack", sid), 1)
+        mesh.set_flag(("rb-ack", sid), 1)
+        deadline = _time.monotonic() + 120.0
+        while not all(
+            mesh.flag_of(("rb-ack", sid), p, 0) for p in mesh.peers
+        ):
+            if mesh._dead:
+                raise WorkerLost(
+                    f"process {mesh.process_id}: peer(s) "
+                    f"{sorted(mesh._dead)} died during the rebalance "
+                    "quiesce; resume from the last committed checkpoint"
+                )
+            if _time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"process {mesh.process_id}: rebalance quiesce ack "
+                    "timed out"
+                )
+            mesh.wait_frames(0.05)
+        if self.checkpointer is not None:
+            self.checkpointer.close()
+        if mesh.process_id == 0:
+            _mb.rebalance_at_fence(self)
+        _obs.record("runtime.rebalance_exit", process=mesh.process_id)
+        raise SystemExit(_mb.REBALANCE_EXIT)
+
     def run_mesh(
         self, static_batches: list[tuple[int, InputNode, list[Entry]]] | None = None
     ) -> None:
@@ -589,6 +625,10 @@ class Runtime:
         try:
             self._run_mesh(static_batches)
         except BaseException as e:
+            if isinstance(e, SystemExit) and e.code == 75:
+                # planned rebalance exit (parallel/membership.py), not a
+                # crash: no postmortem
+                raise
             # postmortem before the supervisor restarts the generation:
             # the recorder holds the last waves/frames/faults this worker
             # saw, which is exactly what "why did the mesh die" needs
@@ -604,6 +644,7 @@ class Runtime:
     ) -> None:
         from pathway_tpu.engine.frontier import DONE
         from pathway_tpu.engine.workers import ProcessExchangeNode
+        from pathway_tpu.parallel import membership as _mb
         from pathway_tpu.parallel.process_mesh import WorkerLost
 
         mesh = self.mesh
@@ -643,8 +684,25 @@ class Runtime:
         closed: set = set()
         done_sent = False
         ckpt_dirty = False
+        # elastic membership (parallel/membership.py): process 0 watches
+        # for quiesce requests under the SHARED persistence root; every
+        # process stops admitting input once the quiesce flag is seen and
+        # exits REBALANCE_EXIT after the final fence commits
+        shared_root: str | None = None
+        if self.checkpointer is not None:
+            shared_root = os.path.dirname(
+                os.path.abspath(self.checkpointer.config.backend.path)
+            )
+        elastic = shared_root is not None and _mb.elastic_enabled()
+        if elastic:
+            _mb.write_source_map(
+                self.checkpointer.config.backend.path, self.connectors
+            )
         try:
             while True:
+                quiescing = elastic and bool(
+                    mesh.flag_value(("quiesce", sid), default=0)
+                )
                 if mesh._dead:
                     # supervised recovery: abort THIS wave cleanly (no
                     # partial checkpoint — the last committed epoch stays
@@ -658,11 +716,15 @@ class Runtime:
                         "the last committed checkpoint"
                     )
                 # 1. local ingestion: one fresh wave per source per poll
-                for c in self.connectors:
-                    entries = c.poll()
-                    if entries:
-                        sched.stage(src[c], self.next_time(), entries)
-                        ckpt_dirty = True
+                # (suspended during a rebalance quiesce: anything consumed
+                # after the final fence would be lost to the next
+                # generation, which resumes from that fence's offsets)
+                if not quiescing:
+                    for c in self.connectors:
+                        entries = c.poll()
+                        if entries:
+                            sched.stage(src[c], self.next_time(), entries)
+                            ckpt_dirty = True
                 stopped = (
                     self.stop_event is not None and self.stop_event.is_set()
                 )
@@ -690,8 +752,27 @@ class Runtime:
                     )
                 # 4. checkpoint fences (cadence owned by process 0)
                 if (
+                    elastic
+                    and mesh.process_id == 0
+                    and not done_sent
+                    and not quiescing
+                    and _mb.quiesce_requested(shared_root)
+                ):
+                    # membership change pending: broadcast the quiesce
+                    # (flag value = the fence number that seals this
+                    # generation) BEFORE raising that fence — per-peer
+                    # frame ordering makes every process see the quiesce
+                    # no later than the fence itself
+                    quiescing = True
+                    fences_raised += 1
+                    mesh.send_flag(("quiesce", sid), fences_raised)
+                    mesh.set_flag(("quiesce", sid), fences_raised)
+                    mesh.send_flag(("fence", sid), fences_raised)
+                    mesh.set_flag(("fence", sid), fences_raised)
+                elif (
                     mesh.process_id == 0
                     and not done_sent
+                    and not quiescing
                     and self.checkpointer is not None
                     and self.checkpointer.due()
                     and (ckpt_dirty or self.checkpointer.frontier_advanced())
@@ -718,6 +799,17 @@ class Runtime:
                         self.checkpointer.checkpoint(self.time)
                         ckpt_dirty = False
                     pending_fence = mesh.flag_value(("fence", sid), default=0)
+                # 4b. rebalance exit: the quiesce flag names the fence
+                # that seals this generation; once THAT fence's epoch is
+                # committed everywhere, acknowledge and hand the roots to
+                # the rebalancer (process 0) / exit (peers)
+                quiesce_fence = (
+                    mesh.flag_value(("quiesce", sid), default=0)
+                    if elastic
+                    else 0
+                )
+                if quiesce_fence and fences_handled >= quiesce_fence:
+                    self._mesh_rebalance_exit(mesh, sid)  # never returns
                 # 5. termination: local done -> announce; global done ->
                 # drain to quiescence and end together
                 local_done = len(closed) == len(self.connectors)

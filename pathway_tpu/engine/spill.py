@@ -77,6 +77,9 @@ __all__ = [
     "validate_manifest_files",
     "check_two_tier",
     "is_manifest",
+    "merge_manifests",
+    "split_manifest",
+    "relocate_manifest",
     "SpillStore",
     "MANIFEST_MARK",
 ]
@@ -189,7 +192,7 @@ class _Run:
 
     __slots__ = (
         "path", "file", "n", "nbytes", "hmin", "hmax", "bloom", "m_bits",
-        "k", "dead", "seq", "_index",
+        "k", "dead", "seq", "dir", "shared", "_index",
     )
 
 
@@ -285,6 +288,8 @@ class SpillStore:
         )
         run.dead = set()
         run.seq = seq
+        run.dir = None
+        run.shared = False
         run._index = (index_h, index_off, len(out))
         return run
 
@@ -415,16 +420,23 @@ class SpillStore:
                 break
 
     def compact_once(self) -> bool:
-        """Merge all current runs into one, dropping dead keys, then swap
-        the generation atomically. Mutations that landed mid-merge
-        (promotions into the snapshot runs, newly sealed runs) are
-        replayed into / kept after the merged run — no lost inserts."""
+        """Merge all current *private* runs into one, dropping dead keys,
+        then swap the generation atomically. Mutations that landed
+        mid-merge (promotions into the snapshot runs, newly sealed runs)
+        are replayed into / kept after the merged run — no lost inserts.
+
+        Runs inherited from a manifest split (``shared``) are excluded:
+        they may hold live records for keys a *sibling* shard owns, so
+        folding them into a private run would resurrect state a sibling
+        has since promoted. Shared runs stay pinned until a future merge
+        re-unifies ownership (merge_manifests marks runs private again
+        when one store becomes the sole owner)."""
         with self._compact_lock:
             with self._gen_lock:
-                if len(self.runs) < 2:
+                snap = [r for r in self.runs if not r.shared]
+                if len(snap) < 2:
                     return False
-                snap = list(self.runs)
-                n_snap = len(snap)
+                snap_ids = {id(r) for r in snap}
                 dead0 = [set(r.dead) for r in snap]
             t0 = time.monotonic()
             merged: dict[bytes, bytes] = {}
@@ -449,16 +461,20 @@ class SpillStore:
             # byte-identical from the pre-merge manifest
             _faults.crash("state.compaction.mid_merge")
             with self._gen_lock:
-                tail = self.runs[n_snap:]  # sealed while merging
+                shared = [r for r in self.runs if r.shared]
+                tail = [  # sealed while merging
+                    r for r in self.runs
+                    if not r.shared and id(r) not in snap_ids
+                ]
                 if new_run is not None:
                     for run, d0 in zip(snap, dead0):
                         # replayed mid-merge promotions: those keys left
                         # for the tail after the snapshot was cut
                         for kb in run.dead - d0:
                             new_run.dead.add(kb)
-                    self.runs = [new_run] + tail
+                    self.runs = shared + [new_run] + tail
                 else:
-                    self.runs = tail
+                    self.runs = shared + tail
             self._retire(snap)
             m = _metrics()
             if m:
@@ -510,8 +526,13 @@ class SpillStore:
     def gc_orphans(self) -> int:
         """Remove run files no generation references (half-merged output
         of a mid-compaction crash, runs sealed after the last durable
-        checkpoint). Only safe AFTER the attached manifest verified."""
+        checkpoint). Only safe AFTER the attached manifest verified, and
+        only for a store whose runs are all private: with shared runs in
+        play a file in this directory may be live in a *sibling* shard's
+        manifest that this store cannot see."""
         with self._gen_lock:
+            if any(r.shared for r in self.runs):
+                return 0
             keep = {r.file for r in self.runs}
             keep |= {os.path.basename(p) for p, _ in self._garbage}
         removed = 0
@@ -546,6 +567,8 @@ class SpillStore:
                     "bloom": r.bloom.tobytes(),
                     "seq": r.seq,
                     "dead": sorted(r.dead),
+                    "dir": r.dir or "",
+                    "shared": int(r.shared),
                 }
                 for r in self.runs
             ]
@@ -616,7 +639,12 @@ def attach_store(manifest: dict, budget: int | None = None) -> SpillStore:
     for rm in manifest["runs"]:
         run = _Run()
         run.file = str(rm["file"])
-        run.path = os.path.join(d, run.file)
+        # post-rescale manifests carry per-run directories: a split
+        # shard's inherited runs stay in the directory that sealed them
+        run.dir = str(rm.get("dir") or "") or None
+        run.shared = bool(rm.get("shared", 0))
+        run.path = os.path.join(base, run.dir, run.file) if run.dir \
+            else os.path.join(d, run.file)
         run.n = int(rm["n"])
         run.nbytes = int(rm["bytes"])
         run.hmin = int.from_bytes(rm["hmin"], "big")
@@ -701,7 +729,9 @@ def validate_manifest_files(manifest: dict) -> None:
     base, _persistent = root()
     d = os.path.join(base, str(manifest.get("dir", "")))
     for rm in manifest.get("runs", []):
-        path = os.path.join(d, str(rm["file"]))
+        rd = str(rm.get("dir") or "")
+        path = os.path.join(base, rd, str(rm["file"])) if rd \
+            else os.path.join(d, str(rm["file"]))
         if not os.path.exists(path):
             raise RuntimeError(
                 f"spill run listed in the checkpoint manifest but missing "
@@ -721,6 +751,139 @@ def validate_manifest_files(manifest: dict) -> None:
             raise RuntimeError(
                 f"spill run {rm['file']}: record count mismatch vs manifest"
             )
+
+
+# ----------------------------------------------------------------- rescale
+#
+# Rescale of spilled state is a METADATA move, not a data move: run files
+# are immutable and content-complete, so re-owning them only needs the
+# manifests rewritten. Soundness rests on two facts: (a) exchange routing
+# delivers a key only to its owning shard, so live records for unowned
+# keys in a shared run are simply never probed; (b) only a key's owner
+# ever promotes it (marks it dead), so merging sibling views of the same
+# run file takes the union of their dead sets.
+
+
+def merge_manifests(manifests: list[dict], label: str | None = None) -> dict:
+    """Fold several shard manifests into one (n -> 1 of a rescale). Runs
+    are deduplicated by (directory, file) — split siblings inherit the
+    same physical files — with dead sets unioned, and come out private
+    (``shared: 0``): the merged store is the sole owner again, so
+    compaction and orphan GC reopen. Per-run directories keep pointing at
+    the files' sealed locations; nothing is rewritten on disk."""
+    runs: list[dict] = []
+    seen: dict[tuple[str, str], dict] = {}
+    max_orig_seq = 0
+    for man in manifests:
+        verify_manifest(man)
+        mdir = str(man.get("dir", ""))
+        for rm in man["runs"]:
+            max_orig_seq = max(max_orig_seq, int(rm.get("seq", 0)))
+            rd = str(rm.get("dir") or "") or mdir
+            key = (rd, str(rm["file"]))
+            if key in seen:
+                # the same file seen through two sibling shards: only a
+                # key's owner promotes it, so the merged dead set is the
+                # union of the siblings' views
+                seen[key]["dead"] = sorted(
+                    set(seen[key]["dead"]) | set(rm.get("dead", []))
+                )
+                continue
+            rec = dict(rm)
+            rec["dir"] = rd
+            rec["shared"] = 0
+            runs.append(rec)
+            seen[key] = rec
+    # renumber: manifest order preserves newest-wins within each source
+    # shard, and cross-shard order is irrelevant (disjoint key ownership)
+    for i, rec in enumerate(runs):
+        rec["seq"] = i + 1
+    lab = label or (str(manifests[0]["label"]) if manifests else "merged")
+    dir0 = str(manifests[0]["dir"]) if manifests else lab
+    return {
+        MANIFEST_MARK: 1,
+        "label": lab,
+        "dir": dir0,
+        # next-seal counter starts past every inherited seq: run FILES
+        # keep their original names, so a renumber-only counter could
+        # collide a fresh seal with an inherited file in the store dir
+        "seq": max(len(runs), max_orig_seq),
+        "n_runs": len(runs),
+        "total_records": sum(int(r["n"]) for r in runs),
+        "runs": runs,
+    }
+
+
+def split_manifest(
+    manifest: dict, n: int, label: str | None = None
+) -> list[dict]:
+    """Split one manifest across ``n`` shards (1 -> n of a rescale) as
+    pure metadata: every shard inherits the FULL run list as ``shared``
+    runs — exchange routing guarantees a shard only ever probes the keys
+    it owns, so unowned live records are dead weight, not wrong answers.
+    Each shard gets a fresh private directory (deterministically derived
+    from the manifest content) for the runs it seals afterwards."""
+    verify_manifest(manifest)
+    if n <= 1:
+        return [merge_manifests([manifest], label=label)]
+    lab = label or str(manifest["label"])
+    mdir = str(manifest.get("dir", ""))
+    ident = hashlib.blake2b(
+        repr((
+            int(manifest.get("seq", 0)),
+            [
+                (str(r.get("dir") or "") or mdir, str(r["file"]))
+                for r in manifest["runs"]
+            ],
+        )).encode(),
+        digest_size=5,
+    ).hexdigest()
+    out = []
+    for i in range(n):
+        runs = []
+        for rm in manifest["runs"]:
+            rec = dict(rm)
+            rec["dir"] = str(rm.get("dir") or "") or mdir
+            rec["shared"] = 1
+            runs.append(rec)
+        out.append({
+            MANIFEST_MARK: 1,
+            "label": lab,
+            "dir": f"{lab}~{ident}.s{i}",
+            "seq": int(manifest["seq"]),
+            "n_runs": len(runs),
+            "total_records": sum(int(r["n"]) for r in runs),
+            "runs": runs,
+        })
+    return out
+
+
+def relocate_manifest(
+    manifest: dict, src_root: str, dst_root: str
+) -> tuple[int, int]:
+    """Materialize a manifest's run files under another spill root
+    (cross-process rebalance): hardlink — copy when the link fails —
+    every referenced run file from ``src_root`` into the same
+    root-relative location under ``dst_root``. The manifest itself needs
+    no rewrite (directories are root-relative). Returns
+    (files placed, bytes referenced)."""
+    mdir = str(manifest.get("dir", ""))
+    moved = 0
+    nbytes = 0
+    for rm in manifest.get("runs", []):
+        rd = str(rm.get("dir") or "") or mdir
+        src = os.path.join(src_root, rd, str(rm["file"]))
+        dst = os.path.join(dst_root, rd, str(rm["file"]))
+        nbytes += int(rm.get("bytes", 0))
+        if os.path.exists(dst):
+            continue
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        try:
+            os.link(src, dst)
+        except OSError:
+            shutil.copy2(src, dst)
+        moved += 1
+    return moved, nbytes
 
 
 def check_two_tier(store: SpillStore, owner: str = "") -> None:

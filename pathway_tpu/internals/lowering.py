@@ -995,6 +995,10 @@ class Session:
             factory = spec.params["factory"]
             session = InputSession(node, upsert=spec.params.get("upsert", False))
             connector = factory(session)
+            # global lowering ordinal: ownership is ordinal % mesh.n, and
+            # elastic rebalance (parallel/membership.py) needs it to route
+            # a source's journal to its owner under a NEW mesh size
+            connector.ordinal = ordinal
             self.connectors.append(connector)
             return node
 

@@ -81,6 +81,7 @@ __all__ = [
     "enabled",
     "verify_session",
     "check_donation",
+    "check_swap_contract",
 ]
 
 
@@ -1111,6 +1112,120 @@ def check_join_reorder(session, v: _Verdict, shared: dict) -> None:
                 "order-sensitive sink — subscribe/capture observes "
                 "intra-wave arrival order, which the swap permutes",
             )
+
+
+# ------------------------------------------------------- swap contract
+
+
+def _swap_meta_roots(root: str) -> dict[str, str]:
+    """{slot name -> metadata-bearing dir} for a persistence root: either
+    the root itself (single process) or its ``proc-N`` children (mesh)."""
+    if os.path.exists(os.path.join(root, "metadata.json")):
+        return {".": root}
+    out: dict[str, str] = {}
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return out
+    for fn in sorted(entries):
+        if fn.startswith("proc-") and fn[5:].isdigit():
+            out[fn] = os.path.join(root, fn)
+    return out
+
+
+def check_swap_contract(blue_root: str, green_root: str) -> dict:
+    """Blue/green swap gate (parallel/bluegreen.py): the GREEN staged
+    root may replace the BLUE serving root only if nothing the blue
+    pipeline promised is lost. Re-proved from the roots alone — no trust
+    in the green run's own claims: (1) shard-map consistency — same
+    process slots on both sides; (2) offsets carried forward — every
+    source the blue side committed exists on the green side at an offset
+    at least as far; (3) outbox/sink compatibility — every blue sink's
+    sealed delivery offset is carried forward, so exactly-once dedup
+    survives the swap; (4) the green side actually warmed — its epoch is
+    at least blue's (a cold-started green would replay the world onto
+    already-delivered sinks). Raises PlanVerificationError on violation;
+    returns the verdict report otherwise."""
+    import json as _json
+
+    check = "swap-contract"
+    v = _Verdict(mode())
+    v.start(check)
+    blue = _swap_meta_roots(blue_root)
+    green = _swap_meta_roots(green_root)
+    if set(blue) != set(green):
+        v.violation(
+            check,
+            f"shard map mismatch: blue has slots {sorted(blue)}, green "
+            f"has {sorted(green)} — a swap must not change mesh "
+            "membership (rebalance first, then swap)",
+        )
+    def _meta(d: str) -> dict | None:
+        try:
+            with open(os.path.join(d, "metadata.json")) as f:
+                return _json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    for slot in sorted(set(blue) & set(green)):
+        bm, gm = _meta(blue[slot]), _meta(green[slot])
+        if bm is None:
+            continue  # blue never committed: nothing promised, any green ok
+        if gm is None:
+            v.violation(
+                check,
+                f"slot {slot}: green has no committed metadata — it "
+                "never warmed against the persisted state",
+            )
+            continue
+        if int(gm.get("epoch", -1)) < int(bm.get("epoch", -1)):
+            v.violation(
+                check,
+                f"slot {slot}: green epoch {gm.get('epoch')} is behind "
+                f"blue epoch {bm.get('epoch')} — the fence epoch was "
+                "not replayed",
+            )
+        boff = bm.get("offsets") or {}
+        goff = gm.get("offsets") or {}
+        for nm, off in boff.items():
+            if nm not in goff:
+                v.violation(
+                    check,
+                    f"slot {slot}: source {nm!r} committed by blue is "
+                    "missing from green — its journal would be dropped",
+                )
+            elif int(goff[nm]) < int(off):
+                v.violation(
+                    check,
+                    f"slot {slot}: source {nm!r} offset went backwards "
+                    f"({off} -> {goff[nm]}) — green would re-consume "
+                    "delivered input",
+                )
+        bout = bm.get("outbox") or {}
+        gout = gm.get("outbox") or {}
+        for sink, off in bout.items():
+            if sink not in gout:
+                v.violation(
+                    check,
+                    f"slot {slot}: sink {sink!r} outbox offset not "
+                    "carried forward — exactly-once dedup would reset "
+                    "and redeliver",
+                )
+            elif int(gout[sink]) < int(off):
+                v.violation(
+                    check,
+                    f"slot {slot}: sink {sink!r} outbox offset went "
+                    f"backwards ({off} -> {gout[sink]})",
+                )
+        if not gm.get("signature"):
+            v.violation(
+                check,
+                f"slot {slot}: green metadata carries no pipeline "
+                "signature — state cannot be mapped onto any plan",
+            )
+    if v.report["violations"]:
+        raise PlanVerificationError(v.report["violations"], v.report)
+    return v.report
 
 
 # ---------------------------------------------------------------- driver

@@ -102,14 +102,26 @@ def run_supervised(
     faults_after_restart: str = "0",
     poll_s: float = 0.1,
     timeout_s: float = 600.0,
+    state_dir: str | None = None,
 ) -> dict[str, Any]:
     """Run ``argv`` as an ``n_processes`` mesh until every worker exits 0,
     restarting the whole mesh (same ports, same persistence roots) after
-    any worker death. Returns ``{"generations": g, "stderr": [...]}`` of
-    the successful generation; raises :class:`SupervisedMeshFailed` after
-    ``max_restarts`` failed generations and :class:`TimeoutError` on the
-    overall deadline."""
+    any worker death. Returns ``{"generations": g, "stderr": [...],
+    "rebalances": r, "members": n}`` of the successful generation; raises
+    :class:`SupervisedMeshFailed` after ``max_restarts`` failed
+    generations and :class:`TimeoutError` on the overall deadline.
+
+    ``state_dir`` (the SHARED persistence root the workers put their
+    ``proc-N`` roots under) switches on elastic membership (parallel/
+    membership.py, unless ``PATHWAY_ELASTIC=0``): join/leave intents
+    announced under ``state_dir/control/`` are folded into a pending
+    membership record, the running generation is asked to quiesce to a
+    checkpoint fence, and when every worker exits with the planned
+    rebalance code the mesh respawns at the new size — without spending
+    restart budget, because nothing failed."""
+    from pathway_tpu.engine import device_plane as _dp
     from pathway_tpu.internals import observability as obs
+    from pathway_tpu.parallel import membership as _mb
 
     # supervisor-side black box: generation lifecycles land in the flight
     # recorder (workers dump their own rings when they crash; this is the
@@ -118,12 +130,31 @@ def run_supervised(
     base_env = {**os.environ, **(env or {})}
     deadline = time.monotonic() + timeout_s
     failures: list[str] = []
-    for generation in range(max_restarts + 1):
+    elastic = state_dir is not None and _mb.elastic_enabled()
+    n = n_processes
+    if elastic:
+        # finish any rebalance that crashed mid-commit, then honour the
+        # committed membership record over the caller's initial size
+        _mb.recover_rebalance(state_dir)
+        rec = _mb.load_membership(state_dir)
+        if rec is not None:
+            n = int(
+                rec["n"] if rec.get("rebalanced") else rec.get("prev_n", n)
+            )
+    generation = 0
+    rebalances = 0
+    while len(failures) <= max_restarts:
         gen_env = dict(base_env)
         if generation > 0:
             gen_env["PATHWAY_FAULTS"] = faults_after_restart
-        procs = _spawn(argv, n_processes, first_port, gen_env)
+        procs = _spawn(argv, n, first_port, gen_env)
+        if obs.PLANE is not None:
+            obs.PLANE.metrics.gauge(
+                "pathway_mesh_members", n,
+                help="mesh size after the last committed rebalance",
+            )
         failed: str | None = None
+        rebalanced = False
         while True:
             if time.monotonic() > deadline:
                 _reap(procs)
@@ -132,8 +163,9 @@ def run_supervised(
                     f"(generation {generation})"
                 )
             codes = [p.poll() for p, _spill in procs]
-            if any(c not in (None, 0) for c in codes):
-                dead = [i for i, c in enumerate(codes) if c not in (None, 0)]
+            benign = (None, 0, _mb.REBALANCE_EXIT)
+            if any(c not in benign for c in codes):
+                dead = [i for i, c in enumerate(codes) if c not in benign]
                 # one worker died: the survivors observe WorkerLost on
                 # their wires and exit on their own — kill + wait the
                 # stragglers to reclaim the ports for the next generation
@@ -151,7 +183,12 @@ def run_supervised(
                     if err.strip():
                         failed += f"\n-- worker {i} stderr --\n{err[-2000:]}"
                 break
-            if all(c == 0 for c in codes):
+            if all(c is not None for c in codes):
+                if any(c == _mb.REBALANCE_EXIT for c in codes):
+                    # planned generation boundary, not a failure
+                    rebalanced = True
+                    _reap(procs)
+                    break
                 if generation > 0:
                     # restarts happened: leave the decision record beside
                     # the workers' own crash dumps
@@ -162,13 +199,46 @@ def run_supervised(
                 return {
                     "generations": generation + 1,
                     "stderr": _reap(procs),
+                    "rebalances": rebalances,
+                    "members": n,
                 }
+            if elastic and not _mb.quiesce_requested(state_dir):
+                joins, leaves = _mb.pending_intents(state_dir)
+                if joins or leaves:
+                    planned = _mb.plan_membership(state_dir, n)
+                    if planned != n:
+                        _mb.request_quiesce(state_dir)
+                        obs.record(
+                            "supervisor.quiesce_requested",
+                            members=n, planned=planned,
+                        )
             time.sleep(poll_s)
+        # a fresh generation must not inherit the dead one's device-plane
+        # quarantines: its failures died with its processes
+        _dp.reset_quarantines()
+        if rebalanced:
+            # process 0 rebalanced the roots (or refused and reverted)
+            # before exiting; roll forward if it crashed mid-commit and
+            # respawn at whatever the membership record now says
+            if elastic:
+                _mb.recover_rebalance(state_dir)
+                rec = _mb.load_membership(state_dir) or {}
+                new_n = int(rec.get("n", n)) if rec.get("rebalanced") else n
+                if new_n != n:
+                    rebalances += 1
+                    obs.record(
+                        "supervisor.rebalanced", members=new_n, was=n,
+                        generation=generation,
+                    )
+                n = new_n
+            generation += 1
+            continue
         failures.append(failed or "unknown failure")
-    obs.record("supervisor.gave_up", generations=max_restarts + 1)
+        generation += 1
+    obs.record("supervisor.gave_up", generations=len(failures))
     obs.dump_flight("supervisor")
     raise SupervisedMeshFailed(
-        f"mesh failed {max_restarts + 1} generations:\n" + "\n".join(failures)
+        f"mesh failed {len(failures)} generations:\n" + "\n".join(failures)
     )
 
 
@@ -186,7 +256,10 @@ def main() -> int:
     head, argv = args[:split], args[split + 1:]
     n, port = int(head[0]), int(head[1])
     restarts = int(head[2]) if len(head) > 2 else 3
-    out = run_supervised(argv, n, port, max_restarts=restarts)
+    out = run_supervised(
+        argv, n, port, max_restarts=restarts,
+        state_dir=os.environ.get("PATHWAY_STATE_DIR") or None,
+    )
     print(f"supervised mesh ok after {out['generations']} generation(s)")
     return 0
 

@@ -700,14 +700,21 @@ class OperatorSnapshotStore:
                     pass
 
 
-def _pipeline_signature(graph: Any) -> str:
+def _pipeline_signature(graph: Any, exchange_n: int | None = None) -> str:
     """Stable id of the lowered pipeline: node order + each operator's
     semantic signature (class, mode, reducer set, widths, …) + native
     kernel availability. A change means persisted operator state cannot
     be mapped back onto the graph. Deliberately NOT included: the worker
     count — snapshots re-partition across PATHWAY_THREADS changes (see
-    engine/core.py shard-rescale protocol; the reference pins `-w`)."""
+    engine/core.py shard-rescale protocol; the reference pins `-w`).
+
+    ``exchange_n`` substitutes a different process count into the
+    ProcessExchangeNode signatures: elastic rebalance (parallel/
+    membership.py) stages metadata that the NEXT generation — lowered at
+    the new mesh size — must accept, so it computes the signature that
+    generation will compute rather than its own."""
     from pathway_tpu.engine import native
+    from pathway_tpu.engine.workers import ProcessExchangeNode
 
     from pathway_tpu.internals.fingerprint import fingerprint_spec
 
@@ -718,7 +725,10 @@ def _pipeline_signature(graph: Any) -> str:
             spec = getattr(node, "_fingerprint_spec", None)
             fp = fingerprint_spec(spec) if spec is not None else ""
             node.state_fingerprint = fp  # cache for repeat signatures
-        parts.append(f"{node.node_id}:{node.persist_signature()}:{fp}")
+        sig = node.persist_signature()
+        if exchange_n is not None and isinstance(node, ProcessExchangeNode):
+            sig = f"ProcessExchange/{exchange_n}/{int(node.route is None)}"
+        parts.append(f"{node.node_id}:{sig}:{fp}")
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
 
 
@@ -1183,6 +1193,10 @@ def attach_persistence(session: Any, config: Config) -> None:
         def __init__(self, inner: Connector, name: str):
             super().__init__(name, inner.session)
             self.inner = inner
+            # global lowering ordinal rides the wrapper: elastic
+            # rebalance routes this source's journal by ordinal % n
+            if hasattr(inner, "ordinal"):
+                self.ordinal = inner.ordinal
             self.style = (
                 "offset" if inner.replay_style == "offset" else
                 "seekable" if inner.replay_style == "seekable" else "live"

@@ -32,11 +32,19 @@ baseline (duplicates absorbed), and the fs file — truncated per
 generation by the direct writer — is excluded from comparison, which is
 exactly the gap the outbox exists to close.
 
+A second family of **elastic** kinds (``worker_join``, ``worker_leave``,
+``swap_mid_commit``, ``swap_divergent``) drills the supervised mesh
+instead: membership changes announced under load must rebalance through
+the quiesce fence and still deliver the analytic table, and blue/green
+swaps crashed mid-commit (roll forward) or diverged at replay (abort,
+blue untouched) must leave the delivered sink output intact.
+
 Usage::
 
-    python scripts/chaos_drill.py --quick          # 6 kinds x 1 seed (CI leg)
-    python scripts/chaos_drill.py                  # 10 kinds x 3 seeds
+    python scripts/chaos_drill.py --quick          # 8 kinds x 1 seed (CI leg)
+    python scripts/chaos_drill.py                  # 15 kinds x 3 seeds
     python scripts/chaos_drill.py --kinds sink_torn_flush --seeds 0,1,2
+    python scripts/chaos_drill.py --kinds worker_join,worker_leave --seeds 0,1,2
     python scripts/chaos_drill.py --json /tmp/chaos.json
 """
 
@@ -289,8 +297,338 @@ CRASH_KINDS = {
 QUICK_KINDS = [
     "crash_mid_wave", "torn_metadata", "connector_flap", "device_dispatch",
     "sink_post_seal", "device_wire", "compaction_mid_merge",
+    "swap_mid_commit",
 ]
 MAX_GENERATIONS = 4  # a schedule may land a crash in the recovery window
+
+# -------------------------------------------------------- elastic kinds
+#
+# Elasticity drills run the SUPERVISED mesh (parallel/supervisor.py +
+# membership.py) rather than the single-process workload above: a worker
+# joins or leaves mid-stream (quiesce -> fence checkpoint -> metadata
+# rebalance -> respawn at the new width), or a blue/green plan swap is
+# crashed/diverged mid-flight (parallel/bluegreen.py). The equivalence
+# claim is the same one the static matrix makes: the DELIVERED sink
+# output, consolidated to the final table, must be byte-identical to
+# what an unfaulted, never-rescaled run delivers (tests/test_elastic.py
+# proves static == analytic; the drill compares against the analytic
+# table directly to keep the matrix runtime sane).
+
+ELASTIC_EVENTS = 160  # paced stream long enough to straddle a rebalance
+
+# the mesh workload: streaming groupby, subscribe sink stamped with wall
+# time so deliveries consolidate across ownership moves (a rebalance
+# moves groups between worker output files; per-file order would let a
+# retired owner's stale final shadow the new owner's)
+ELASTIC_WORKER = textwrap.dedent(
+    """
+    import json, os, sys, time
+    sys.path.insert(0, {repo!r})
+    import pathway_tpu as pw
+    from pathway_tpu.io.python import ConnectorSubject
+
+    PDIR, OUT, READY, N = sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4])
+    PID = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+
+    class Nums(ConnectorSubject):
+        def run(self):
+            for i in range(N):
+                self.next(g=f"g{{i % 4}}", v=i)
+                if i == 5:
+                    open(READY + f".{{PID}}", "w").write("up")
+                time.sleep(0.01)
+
+    t = pw.io.python.read(
+        Nums(), schema=pw.schema_from_types(g=str, v=int), name="nums"
+    )
+    agg = t.groupby(t.g).reduce(
+        t.g, total=pw.reducers.sum(t.v), n=pw.reducers.count()
+    )
+    sink = open(OUT + f".{{PID}}", "a")
+    def on_change(key, row, time, is_addition):
+        sink.write(json.dumps({{**row, "add": is_addition,
+                               "ts": __import__("time").time()}}) + "\\n")
+        sink.flush()
+    pw.io.subscribe(agg, on_change=on_change)
+    pw.run(persistence_config=pw.persistence.Config(
+        pw.persistence.Backend.filesystem(PDIR)))
+    """
+).format(repo=REPO)
+
+# the solo workload swap drills stage blue/green around: a real
+# jsonlines sink so the delivered file is what the drill consolidates
+ELASTIC_SOLO = textwrap.dedent(
+    """
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    import pathway_tpu as pw
+    from pathway_tpu.io.python import ConnectorSubject
+
+    ROOT, OUT, N = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+    class Nums(ConnectorSubject):
+        def run(self):
+            for i in range(N):
+                self.next(g=f"g{{i % 4}}", v=i)
+                time.sleep(0.005)
+
+    t = pw.io.python.read(
+        Nums(), schema=pw.schema_from_types(g=str, v=int), name="nums"
+    )
+    agg = t.groupby(t.g).reduce(
+        t.g, total=pw.reducers.sum(t.v), n=pw.reducers.count()
+    )
+    pw.io.jsonlines.write(agg, OUT)
+    pw.run(persistence_config=pw.persistence.Config(
+        pw.persistence.Backend.filesystem(ROOT)))
+    """
+).format(repo=REPO)
+
+# kind -> announce delay / blue-stream length, both seed-varied so each
+# seed lands the membership change (or the swap) at a different point in
+# the stream / a different fence epoch
+ELASTIC_KINDS = {
+    "worker_join": lambda seed: {"delay_s": 0.3 + 0.15 * seed},
+    "worker_leave": lambda seed: {"delay_s": 0.3 + 0.15 * seed},
+    "swap_mid_commit": lambda seed: {"blue_n": 32 + 16 * seed},
+    "swap_divergent": lambda seed: {"blue_n": 32 + 16 * seed},
+}
+
+
+def _elastic_expected(n_events: int) -> dict:
+    exp: dict = {}
+    for i in range(n_events):
+        g = f"g{i % 4}"
+        t0, n0 = exp.get(g, (0, 0))
+        exp[g] = (t0 + i, n0 + 1)
+    return exp
+
+
+def _elastic_consolidate(out_prefix: str, max_pids: int) -> dict:
+    events = []
+    for pid in range(max_pids):
+        path = out_prefix + f".{pid}"
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            for i, line in enumerate(f):
+                ev = json.loads(line)
+                events.append((ev["ts"], pid, i, ev))
+    events.sort(key=lambda e: (e[0], e[1], e[2]))
+    state: dict = {}
+    for _, _, _, ev in events:
+        if ev["add"]:
+            state[ev["g"]] = (ev["total"], ev["n"])
+        elif state.get(ev["g"]) == (ev["total"], ev["n"]):
+            del state[ev["g"]]
+    return state
+
+
+def _free_port_base(n: int) -> int:
+    import socket
+
+    for _ in range(60):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        ok = True
+        for i in range(n * n):
+            try:
+                with socket.socket() as s2:
+                    s2.bind(("127.0.0.1", p + i))
+            except OSError:
+                ok = False
+                break
+        if ok:
+            return p
+    raise RuntimeError("no contiguous port range free")
+
+
+def _sink_table(path: str) -> dict:
+    state: dict = {}
+    if os.path.exists(path):
+        for line in open(path):
+            rec = json.loads(line)
+            if rec["diff"] > 0:
+                state[rec["g"]] = (rec["total"], rec["n"])
+            elif state.get(rec["g"]) == (rec["total"], rec["n"]):
+                del state[rec["g"]]
+    return state
+
+
+def _run_membership_case(kind: str, seed: int, workdir: str) -> dict:
+    """worker_join / worker_leave: a member change announced mid-stream
+    must rebalance exactly once and deliver the analytic table."""
+    import threading
+
+    from pathway_tpu.parallel import membership as mb
+    from pathway_tpu.parallel.supervisor import run_supervised
+
+    params = ELASTIC_KINDS[kind](seed)
+    start_n = 2 if kind == "worker_join" else 3
+    want_n = start_n + (1 if kind == "worker_join" else -1)
+    announce = (
+        mb.announce_join if kind == "worker_join" else mb.announce_leave
+    )
+    case_dir = os.path.join(workdir, f"{kind}-s{seed}")
+    os.makedirs(case_dir, exist_ok=True)
+    pdir = os.path.join(case_dir, "pstate")
+    out = os.path.join(case_dir, "deliveries")
+    ready = os.path.join(case_dir, "ready")
+    argv = [sys.executable, "-c", ELASTIC_WORKER, pdir, out, ready,
+            str(ELASTIC_EVENTS)]
+
+    def _announcer():
+        deadline = time.monotonic() + 60
+        while (
+            time.monotonic() < deadline
+            and not os.path.exists(ready + ".0")
+        ):
+            time.sleep(0.05)
+        time.sleep(params["delay_s"])
+        announce(pdir)
+
+    th = threading.Thread(target=_announcer)
+    th.start()
+    try:
+        res = run_supervised(
+            argv, start_n, _free_port_base(max(start_n, want_n)),
+            env={"JAX_PLATFORMS": "cpu", "PATHWAY_THREADS": "2",
+                 "PATHWAY_FAULTS": "0"},
+            timeout_s=240, state_dir=pdir,
+        )
+    finally:
+        th.join()
+    assert res["rebalances"] == 1, (
+        f"{kind} seed {seed}: expected exactly one rebalance, got "
+        f"{res['rebalances']}"
+    )
+    assert res["members"] == want_n, (
+        f"{kind} seed {seed}: final width {res['members']} != {want_n}"
+    )
+    rec = mb.load_membership(pdir)
+    assert rec is not None and rec["n"] == want_n and rec["rebalanced"]
+    state = _elastic_consolidate(out, max(start_n, want_n))
+    return {
+        "outputs": {"mesh": json.dumps(sorted(state.items()))},
+        "equivalent": state == _elastic_expected(ELASTIC_EVENTS),
+        "generations": res["generations"],
+    }
+
+
+def _run_swap_case(kind: str, seed: int, workdir: str) -> dict:
+    """swap_mid_commit / swap_divergent: a blue/green swap crashed in
+    the commit window rolls FORWARD on recovery; a divergent replay
+    aborts with blue byte-for-byte untouched. Either way the delivered
+    sink file still consolidates to the analytic table."""
+    from pathway_tpu.parallel import bluegreen as bg
+
+    params = ELASTIC_KINDS[kind](seed)
+    blue_n = params["blue_n"]
+    case_dir = os.path.join(workdir, f"{kind}-s{seed}")
+    os.makedirs(case_dir, exist_ok=True)
+    blue = os.path.join(case_dir, "blue")
+    sink = os.path.join(case_dir, "blue.jsonl")
+    r = subprocess.run(
+        [sys.executable, "-c", ELASTIC_SOLO, blue, sink, str(blue_n)],
+        capture_output=True, text=True, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PATHWAY_THREADS": "1",
+             "PATHWAY_FAULTS": "0"},
+    )
+    assert r.returncode == 0, (
+        f"{kind} seed {seed}: blue run rc={r.returncode}\n"
+        + r.stderr[-2000:]
+    )
+    expected = _elastic_expected(blue_n)
+    generations = 1
+
+    def _snapshot(root):
+        out = []
+        for dp, _dirs, files in os.walk(root):
+            for f in files:
+                p = os.path.join(dp, f)
+                st = os.stat(p)
+                out.append(
+                    (os.path.relpath(p, root), st.st_size, st.st_mtime_ns)
+                )
+        return sorted(out)
+
+    if kind == "swap_mid_commit":
+        # crash INSIDE the commit window (marker durable, renames maybe
+        # partial) in a subprocess, then roll forward
+        crasher = textwrap.dedent(
+            """
+            import sys
+            sys.path.insert(0, {repo!r})
+            from pathway_tpu.parallel import bluegreen as bg
+            bg.swap_plan(sys.argv[1], lambda stage: None, verify=False)
+            """
+        ).format(repo=REPO)
+        r = subprocess.run(
+            [sys.executable, "-c", crasher, blue],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PATHWAY_FAULTS": f"seed={seed};swap.mid_commit@1"},
+        )
+        assert r.returncode == CRASH_EXIT, (
+            f"{kind} seed {seed}: swap never crashed (rc={r.returncode})\n"
+            + r.stderr[-2000:]
+        )
+        assert os.path.exists(blue + ".swap.commit")
+        assert bg.recover_swap(blue) == "completed"
+        assert os.path.isdir(blue)
+        assert not os.path.exists(blue + ".swap.commit")
+        assert not os.path.isdir(blue + ".green")
+        generations = 2
+    else:  # swap_divergent
+        from pathway_tpu.engine import faults
+
+        before = _snapshot(blue)
+        prev = os.environ.get("PATHWAY_FAULTS")
+        os.environ["PATHWAY_FAULTS"] = (
+            f"seed={seed};swap.replay.divergent@1"
+        )
+        faults.reset()
+        try:
+            res = bg.swap_plan(
+                blue, lambda stage: expected, baseline=expected,
+                verify=False,
+            )
+        finally:
+            if prev is None:
+                os.environ.pop("PATHWAY_FAULTS", None)
+            else:
+                os.environ["PATHWAY_FAULTS"] = prev
+            faults.reset()
+        assert not res["committed"] and "injected" in res["reason"], res
+        assert _snapshot(blue) == before, (
+            f"{kind} seed {seed}: aborted swap touched the blue root"
+        )
+    state = _sink_table(sink)
+    return {
+        "outputs": {"fs": json.dumps(sorted(state.items()))},
+        "equivalent": state == expected,
+        "generations": generations,
+    }
+
+
+def run_elastic_case(kind: str, seed: int, workdir: str) -> dict:
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    t0 = time.monotonic()
+    if kind in ("worker_join", "worker_leave"):
+        rec = _run_membership_case(kind, seed, workdir)
+    else:
+        rec = _run_swap_case(kind, seed, workdir)
+    return {
+        "kind": kind,
+        "seed": seed,
+        "spec": json.dumps(ELASTIC_KINDS[kind](seed)),
+        "seconds": round(time.monotonic() - t0, 2),
+        "note": "",
+        "flight": {},
+        **rec,
+    }
 
 
 def _run_workload(
@@ -545,6 +883,8 @@ def _run_matrix(
     kinds: list[str], seeds: list[int], n_events: int, workdir: str
 ) -> dict:
     eo = exactly_once_mode()
+    elastic_kinds = [k for k in kinds if k in ELASTIC_KINDS]
+    kinds = [k for k in kinds if k not in ELASTIC_KINDS]
     if not eo:
         skipped = [k for k in kinds if k in SINK_KINDS]
         kinds = [k for k in kinds if k not in SINK_KINDS]
@@ -553,7 +893,7 @@ def _run_matrix(
                 "PATHWAY_EXACTLY_ONCE=0: sink-window kinds skipped "
                 f"(outbox disarmed): {skipped}"
             )
-        assert kinds, (
+        assert kinds or elastic_kinds, (
             "no fault kinds left to run — sink kinds skip under "
             "PATHWAY_EXACTLY_ONCE=0; an empty matrix must not report ok"
         )
@@ -570,25 +910,32 @@ def _run_matrix(
                 "native dataplane unavailable: device_wire kind skipped "
                 "(the column plane's wire rides NativeBatch)"
             )
-            assert kinds, (
+            assert kinds or elastic_kinds, (
                 "no fault kinds left to run — an empty matrix must not "
                 "report ok"
             )
     t0 = time.monotonic()
-    base_pdir = os.path.join(workdir, "baseline-pdir")
-    base_out = os.path.join(workdir, "baseline-out")
-    rc = _run_workload(base_pdir, base_out, "0", n_events)
-    assert rc == 0, f"baseline rc={rc}"
-    baseline = consolidate_outputs(base_out, eo)
-    assert all(v != "[]" for v in baseline.values()), (
-        f"baseline produced no output: {baseline}"
-    )
+    baseline: dict[str, str] = {}
+    if kinds:
+        base_pdir = os.path.join(workdir, "baseline-pdir")
+        base_out = os.path.join(workdir, "baseline-out")
+        rc = _run_workload(base_pdir, base_out, "0", n_events)
+        assert rc == 0, f"baseline rc={rc}"
+        baseline = consolidate_outputs(base_out, eo)
+        assert all(v != "[]" for v in baseline.values()), (
+            f"baseline produced no output: {baseline}"
+        )
     cases = []
     failures = []
-    for kind in kinds:
+    for kind in kinds + elastic_kinds:
         for seed in seeds:
-            case = run_case(kind, seed, n_events, workdir)
-            case["equivalent"] = case["outputs"] == baseline
+            if kind in ELASTIC_KINDS:
+                # elastic cases carry their own equivalence verdict
+                # (vs the analytic table, see the elastic-kinds note)
+                case = run_elastic_case(kind, seed, workdir)
+            else:
+                case = run_case(kind, seed, n_events, workdir)
+                case["equivalent"] = case["outputs"] == baseline
             cases.append(case)
             if not case["equivalent"]:
                 failures.append(
@@ -607,7 +954,7 @@ def _run_matrix(
         "ok": not failures,
         "exactly_once": eo,
         "baseline": baseline,
-        "kinds": kinds,
+        "kinds": kinds + elastic_kinds,
         "seeds": seeds,
         "n_events": n_events,
         "cases": cases,
@@ -621,9 +968,9 @@ def _run_matrix(
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
-                    help="6 kinds x 1 seed (the tier-1 CI leg, <=90s)")
+                    help="8 kinds x 1 seed (the tier-1 CI leg, <=90s)")
     ap.add_argument("--kinds", default=None,
-                    help=f"comma list from {sorted(KINDS)}")
+                    help=f"comma list from {sorted(KINDS) + sorted(ELASTIC_KINDS)}")
     ap.add_argument("--seeds", default=None, help="comma list of ints")
     ap.add_argument("--events", type=int, default=50)
     ap.add_argument("--json", dest="json_out", default=None)
@@ -632,13 +979,16 @@ def main() -> int:
         kinds = QUICK_KINDS
         seeds = [0]
     else:
-        kinds = sorted(KINDS)
+        kinds = sorted(KINDS) + sorted(ELASTIC_KINDS)
         seeds = [0, 1, 2]
     if args.kinds:
         kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
         for k in kinds:
-            if k not in KINDS:
-                ap.error(f"unknown kind {k!r} (have {sorted(KINDS)})")
+            if k not in KINDS and k not in ELASTIC_KINDS:
+                ap.error(
+                    f"unknown kind {k!r} "
+                    f"(have {sorted(KINDS) + sorted(ELASTIC_KINDS)})"
+                )
     if args.seeds:
         seeds = [int(s) for s in args.seeds.split(",")]
     report = run_matrix(kinds, seeds, n_events=args.events)

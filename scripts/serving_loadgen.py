@@ -25,10 +25,23 @@ and the gateway's shed/queue counters. The acceptance contrast
 bounded by shedding at the edge, while the ``--no-gateway`` control's
 pending map grows to the full client count.
 
+``--rolling-upgrade`` adds the zero-downtime rung (docs/robustness.md
+§elasticity): mid-bench, a REAL blue/green plan swap
+(parallel/bluegreen.py — clone, green replay, verified gates, atomic
+rename commit) runs against a persisted pipeline root on the same host
+while the client fleet keeps hammering the live server. The report then
+splits p99 into during-swap vs outside-swap windows and records the
+swap's own duration and verdict — the claim under test is that an
+upgrade swap never stalls serving (blue never stops). On a 1-CPU host
+the swap subprocess and the server serialize on the same core, which
+measures the scheduler, not the swap — the rung skips with an explicit
+reason instead of reporting a junk p99.
+
 Usage:
   python scripts/serving_loadgen.py --clients 100 --duration 5
   PATHWAY_FAULTS="serving.straggler@1+" python scripts/serving_loadgen.py \
       --clients 100 --duration 5 --straggler-ms 20 [--no-gateway]
+  python scripts/serving_loadgen.py --clients 50 --duration 6 --rolling-upgrade
 
 Prints ONE JSON line; --json PATH also writes it to a file.
 """
@@ -39,7 +52,10 @@ import argparse
 import asyncio
 import json
 import os
+import subprocess
 import sys
+import tempfile
+import textwrap
 import threading
 import time
 
@@ -112,12 +128,106 @@ def build_server(args, port: int):
     return webserver, gateway, start_run
 
 
+# the pipeline whose root the rolling-upgrade rung swaps: a paced
+# streaming groupby persisted to ROOT with a real jsonlines sink (the
+# same shape the blue/green drills in scripts/chaos_drill.py use)
+UPGRADE_SOLO = textwrap.dedent(
+    """
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    import pathway_tpu as pw
+    from pathway_tpu.io.python import ConnectorSubject
+
+    ROOT, OUT, N = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+    class Nums(ConnectorSubject):
+        def run(self):
+            for i in range(N):
+                self.next(g=f"g{{i % 4}}", v=i)
+                time.sleep(0.005)
+
+    t = pw.io.python.read(
+        Nums(), schema=pw.schema_from_types(g=str, v=int), name="nums"
+    )
+    agg = t.groupby(t.g).reduce(
+        t.g, total=pw.reducers.sum(t.v), n=pw.reducers.count()
+    )
+    pw.io.jsonlines.write(agg, OUT)
+    pw.run(persistence_config=pw.persistence.Config(
+        pw.persistence.Backend.filesystem(ROOT)))
+    """
+).format(repo=REPO)
+
+
+def _upgrade_solo(root: str, out: str, n: int) -> None:
+    r = subprocess.run(
+        [sys.executable, "-c", UPGRADE_SOLO, root, out, str(n)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PATHWAY_THREADS": "1",
+             "PATHWAY_FAULTS": "0"},
+    )
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"upgrade pipeline rc={r.returncode}\n" + r.stderr[-2000:]
+        )
+
+
+def _upgrade_table(n: int) -> dict:
+    exp: dict = {}
+    for i in range(n):
+        g = f"g{i % 4}"
+        t0, n0 = exp.get(g, (0, 0))
+        exp[g] = (t0 + i, n0 + 1)
+    return exp
+
+
+def _upgrade_sink_state(path: str) -> dict:
+    state: dict = {}
+    if os.path.exists(path):
+        for line in open(path):
+            rec = json.loads(line)
+            if rec["diff"] > 0:
+                state[rec["g"]] = (rec["total"], rec["n"])
+            elif state.get(rec["g"]) == (rec["total"], rec["n"]):
+                del state[rec["g"]]
+    return state
+
+
+def run_rolling_swap(workdir: str, info: dict) -> None:
+    """One real blue/green swap: blue persisted at 32 events, green
+    replays the full 64-event stream from the clone, gates verify, the
+    rename pair commits. Fills `info` in place (the bench thread reads
+    it after joining)."""
+    from pathway_tpu.parallel import bluegreen as bg
+
+    blue = os.path.join(workdir, "blue")
+    try:
+        t0 = time.perf_counter()
+
+        def green(stage):
+            out = os.path.join(workdir, "green.jsonl")
+            _upgrade_solo(stage, out, 64)
+            return _upgrade_sink_state(out)
+
+        res = bg.swap_plan(blue, green, baseline=_upgrade_table(64))
+        info["swap_seconds"] = round(time.perf_counter() - t0, 3)
+        info["swap_committed"] = bool(res["committed"])
+        if not res["committed"]:
+            info["swap_reason"] = res["reason"]
+    except Exception as e:  # noqa: BLE001 — the bench must still report
+        info["swap_committed"] = False
+        info["swap_reason"] = f"{type(e).__name__}: {e}"
+    finally:
+        info["t_end"] = time.perf_counter()
+
+
 async def drive_clients(args, port: int) -> dict:
     """Closed-loop client fleet; returns raw measurements."""
     import aiohttp
 
     url = f"http://127.0.0.1:{port}/answer"
     latencies: list[float] = []
+    stamps: list[float] = []  # completion time of each 200, for windowing
     counts = {"ok": 0, "shed": 0, "timeout": 0, "error": 0}
     stop_at = time.perf_counter() + args.duration
     conn = aiohttp.TCPConnector(limit=0)
@@ -138,6 +248,7 @@ async def drive_clients(args, port: int) -> dict:
                         if resp.status == 200:
                             counts["ok"] += 1
                             latencies.append(dt)
+                            stamps.append(time.perf_counter())
                         elif resp.status == 429:
                             counts["shed"] += 1
                             ra = float(resp.headers.get("Retry-After", "1"))
@@ -151,7 +262,7 @@ async def drive_clients(args, port: int) -> dict:
                     await asyncio.sleep(0.05)
 
         await asyncio.gather(*(client(i) for i in range(args.clients)))
-    return {"latencies": latencies, **counts}
+    return {"latencies": latencies, "stamps": stamps, **counts}
 
 
 def percentile(xs: list[float], p: float) -> float | None:
@@ -179,8 +290,27 @@ def main() -> int:
                     help="slow-path sleep when serving.straggler fires")
     ap.add_argument("--timeout-s", type=float, default=30.0)
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--rolling-upgrade", action="store_true",
+                    help="run a real blue/green plan swap mid-bench and "
+                         "report during-swap vs outside-swap p99")
     ap.add_argument("--json", dest="json_path", default=None)
     args = ap.parse_args()
+
+    if args.rolling_upgrade and (os.cpu_count() or 1) < 2:
+        # the swap subprocess and the server would timeshare one core:
+        # the p99 split would measure the OS scheduler, not the swap
+        line = json.dumps({
+            "skipped": True,
+            "reason": "rolling-upgrade rung needs >=2 CPUs "
+                      f"(os.cpu_count()={os.cpu_count()}); a 1-core host "
+                      "serializes the swap against the server and the "
+                      "p99 contrast is meaningless",
+        })
+        print(line)
+        if args.json_path:
+            with open(args.json_path, "w") as f:
+                f.write(line + "\n")
+        return 0
 
     port = args.port
     if port == 0:
@@ -223,13 +353,38 @@ def main() -> int:
 
     st_thread = threading.Thread(target=sampler, daemon=True)
     st_thread.start()
+
+    # rolling upgrade: stage blue BEFORE the bench (its pipeline run is
+    # not part of the serving measurement), swap DURING it
+    swap_info: dict = {}
+    swap_thread = None
+    upgrade_dir = None
+    if args.rolling_upgrade:
+        upgrade_dir = tempfile.mkdtemp(prefix="pathway-upgrade-")
+        _upgrade_solo(
+            os.path.join(upgrade_dir, "blue"),
+            os.path.join(upgrade_dir, "blue.jsonl"), 32,
+        )
+
+        def _swapper() -> None:
+            time.sleep(args.duration / 3.0)  # let the fleet reach steady state
+            swap_info["t_start"] = time.perf_counter()
+            run_rolling_swap(upgrade_dir, swap_info)
+
+        swap_thread = threading.Thread(target=_swapper, daemon=True)
+
     t0 = time.perf_counter()
+    if swap_thread is not None:
+        swap_thread.start()
     raw = asyncio.run(drive_clients(args, port))
     wall = time.perf_counter() - t0
     sampling = False
     st_thread.join(timeout=2)
+    if swap_thread is not None:
+        swap_thread.join(timeout=120)
 
     lat = raw.pop("latencies")
+    stamps = raw.pop("stamps")
     route = pw.io.http.route_stats().get("/answer", {})
     out = {
         "clients": args.clients,
@@ -251,6 +406,30 @@ def main() -> int:
     }
     if gateway is not None:
         out["gateway_stats"] = gateway.snapshot()
+    if args.rolling_upgrade:
+        t_start = swap_info.get("t_start")
+        t_end = swap_info.get("t_end")
+        during, outside = [], []
+        if t_start is not None and t_end is not None:
+            for ts, dt in zip(stamps, lat):
+                (during if t_start <= ts <= t_end else outside).append(dt)
+        out["rolling_upgrade"] = {
+            "swap_committed": swap_info.get("swap_committed", False),
+            "swap_seconds": swap_info.get("swap_seconds"),
+            "ok_during_swap": len(during),
+            "p99_ms_during_swap": (
+                round(1000 * percentile(during, 99), 2) if during else None
+            ),
+            "p99_ms_outside_swap": (
+                round(1000 * percentile(outside, 99), 2) if outside else None
+            ),
+        }
+        if "swap_reason" in swap_info:
+            out["rolling_upgrade"]["swap_reason"] = swap_info["swap_reason"]
+        if upgrade_dir:
+            import shutil
+
+            shutil.rmtree(upgrade_dir, ignore_errors=True)
     line = json.dumps(out)
     print(line)
     if args.json_path:

@@ -85,6 +85,14 @@ no stealing; the byte-identity baseline the morsel/steal path is pinned
 against (docs/parallelism.md). The morsel-on A/B matrix and the seeded
 straggler-determinism harness live in tests/test_morsel.py and run
 inside legs 1-2.
+Leg 19 (elastic-off): the supervision/recovery suites with elastic mesh
+membership killed (PATHWAY_ELASTIC=0) — join/leave intents ignored, no
+quiesce fence, no rebalance, no blue/green swap machinery; supervised
+runs must behave exactly like the pre-elastic static mesh
+(docs/robustness.md §elasticity). The elastic-on side — rebalance A/B
+vs a static mesh, swap gates, crash roll-forward — lives in
+tests/test_elastic.py and runs inside legs 1-2 plus the chaos drill's
+elastic kinds.
 
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
@@ -355,6 +363,20 @@ def main() -> int:
                 "tests/test_io_formats.py",
                 "tests/test_megakernel.py",
                 "tests/test_native_engine.py",
+                "tests/test_persistence.py",
+            ],
+        ),
+        # elastic membership killed: intents are ignored, no quiesce, no
+        # rebalance, no swap machinery on the supervision path — the
+        # static-mesh baseline the elastic protocol is pinned against;
+        # the bypass byte-identity test itself is
+        # tests/test_elastic.py::test_elastic_off_is_a_bypass, and the
+        # rebalance tests skip themselves (docs/robustness.md)
+        run_leg(
+            "elastic-off", {"PATHWAY_ELASTIC": "0"}, extra,
+            [
+                "tests/test_elastic.py",
+                "tests/test_chaos.py",
                 "tests/test_persistence.py",
             ],
         ),

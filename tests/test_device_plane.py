@@ -487,3 +487,83 @@ def test_chat_finalizer_drops_its_program_from_the_plane():
     chat._finalizer()  # what gc runs when the instance dies
     assert name not in plane.programs
     assert not any(isinstance(k, tuple) and name in k for k in plane._leases)
+
+
+# ------------------------------------------------- quarantine lifecycle
+
+
+def test_quarantine_reset_is_the_generation_boundary_slate_wipe(monkeypatch):
+    """A failed dispatch quarantines its bucket (host fallback until the
+    cooldown admits a re-probe); reset_quarantine() drops the record so
+    a fresh supervisor generation starts back on the device path instead
+    of inheriting a dead process's cooldowns."""
+    from pathway_tpu.engine import faults
+
+    plane = DevicePlane()
+    prog = plane.program("quar_double", lambda x: x * 2)
+    # a cooldown long enough that nothing re-probes behind our back
+    monkeypatch.setattr(DeviceProgram, "PROBE_BASE_S", 120.0)
+    monkeypatch.setattr(DeviceProgram, "PROBE_CAP_S", 120.0)
+
+    x = np.arange(4)
+    monkeypatch.setenv("PATHWAY_FAULTS", "device.dispatch.quar_double@1")
+    faults.reset()
+    try:
+        out = prog(x, bucket=4)  # injected dispatch failure
+    finally:
+        monkeypatch.setenv("PATHWAY_FAULTS", "0")
+        faults.reset()
+    # degraded, but the answer still arrived via the host path
+    np.testing.assert_array_equal(np.asarray(out), x * 2)
+    assert prog.quarantine[4]["failures"] == 1
+    assert "injected fault" in prog.quarantine[4]["last_error"]
+    assert prog.host_fallbacks == 1
+
+    # cooldown still running: the next call is a host fallback too
+    np.testing.assert_array_equal(np.asarray(prog(x, bucket=4)), x * 2)
+    assert prog.host_fallbacks == 2
+
+    assert prog.reset_quarantine() == 1
+    assert prog.quarantine == {}
+    # immediately back on the device path: no new fallback, and the
+    # compile ledger is charged by the successful dispatch
+    np.testing.assert_array_equal(np.asarray(prog(x, bucket=4)), x * 2)
+    assert prog.host_fallbacks == 2
+    assert prog.compile_counts.get(4) == 1
+
+
+def test_plane_wide_quarantine_reset_spans_programs():
+    """The supervisor's generation-boundary hook is the module-level
+    reset_quarantines(): it sweeps every registered program on the
+    shared plane and reports how many records it dropped."""
+    import time as _t
+
+    from pathway_tpu.engine.device_plane import (
+        get_device_plane,
+        reset_quarantines,
+    )
+
+    plane = get_device_plane()
+    reset_quarantines()  # start from a clean slate
+    p1 = plane.program("quar_sweep_a", lambda x: x + 1)
+    p2 = plane.program("quar_sweep_b", lambda x: x - 1)
+    try:
+        far = _t.monotonic() + 999.0
+        with p1._lock:
+            p1.quarantine["b8"] = {
+                "failures": 3, "reopen_at": far, "last_error": "x"
+            }
+        with p2._lock:
+            p2.quarantine["b16"] = {
+                "failures": 1, "reopen_at": far, "last_error": "y"
+            }
+        assert set(plane.quarantined()) >= {
+            ("quar_sweep_a", "b8"), ("quar_sweep_b", "b16")
+        }
+        assert reset_quarantines() == 2
+        assert p1.quarantine == {} and p2.quarantine == {}
+        # idempotent on a clean slate — and never constructs a plane
+        assert reset_quarantines() == 0
+    finally:
+        plane.drop_program("quar_sweep_a")
+        plane.drop_program("quar_sweep_b")

@@ -393,3 +393,151 @@ def test_verify_session_proves_spill_contract(monkeypatch):
     store.seal([(b"forged", b"p2")])  # violates exclusive residency
     with pytest.raises(PlanVerificationError, match="spill-two-tier"):
         verifier.verify_session(s)
+
+
+# ------------------------------------------- manifest-level rescale moves
+#
+# Elastic rebalance (parallel/membership.py) re-homes spilled state as
+# METADATA: split/merge of manifests plus hardlinks of the immutable run
+# files. The spilled arrangement must never force a journal-replay
+# fallback just because its state lives on disk.
+
+
+def _sealed_store(label: str, n_runs: int = 3, per: int = 40):
+    store = spill.store_for(label, budget=4)
+    items = {}
+    for r in range(n_runs):
+        batch = [
+            (f"{label}-k{r:02d}{i:04d}".encode(), f"p{r}-{i}".encode() * 2)
+            for i in range(per)
+        ]
+        store.seal(batch)
+        items.update(batch)
+    return store, items
+
+
+def _disk_run_files():
+    base, _ = spill.root()
+    out = []
+    for dp, _dirs, files in os.walk(base):
+        out.extend(os.path.join(dp, f) for f in files)
+    return sorted(out)
+
+
+def test_split_manifest_is_a_metadata_move():
+    """1 -> n: every shard inherits the full run list as shared runs;
+    nothing on disk is copied or rewritten, and each shard store still
+    serves every byte."""
+    store, items = _sealed_store("resc-split")
+    man = store.manifest()
+    before = _disk_run_files()
+    parts = spill.split_manifest(man, 3)
+    assert _disk_run_files() == before  # pure metadata: zero file churn
+    assert len(parts) == 3
+    dirs = set()
+    for p in parts:
+        spill.verify_manifest(p)
+        dirs.add(p["dir"])
+        assert all(rm.get("shared") == 1 for rm in p["runs"])
+        s = spill.attach_store(p)
+        for kb, payload in list(items.items())[::13]:
+            assert s.take(kb) == payload
+    assert len(dirs) == 3  # fresh private dirs for post-split seals
+
+
+def test_merge_manifests_dedupes_split_siblings():
+    """n -> 1: split siblings share physical runs; the merge dedupes by
+    (dir, file), unions dead sets, and the merged store owns its runs
+    privately again (compaction/GC reopen)."""
+    store, items = _sealed_store("resc-merge")
+    man = store.manifest()
+    n_runs = len(man["runs"])
+    parts = spill.split_manifest(man, 3)
+    merged = spill.merge_manifests(parts)
+    spill.verify_manifest(merged)
+    assert len(merged["runs"]) == n_runs  # shared siblings folded back
+    assert all(not rm.get("shared") for rm in merged["runs"])
+    s = spill.attach_store(merged)
+    for kb, payload in list(items.items())[::7]:
+        assert s.take(kb) == payload
+    assert s.compact_once()  # private again: compaction is legal
+
+
+def test_merged_seq_counter_clears_inherited_file_names():
+    """Run FILES keep their original seq-derived names across a merge,
+    so the merged store's next-seal counter must start past every
+    inherited seq — a fresh seal colliding with an inherited file would
+    silently shadow sealed bytes."""
+    store, _items = _sealed_store("resc-seq", n_runs=5, per=10)
+    man = store.manifest()
+    merged = spill.merge_manifests([man])
+    assert merged["seq"] >= max(int(rm["seq"]) for rm in man["runs"])
+    s = spill.attach_store(merged)
+    inherited = {str(rm["file"]) for rm in merged["runs"]}
+    s.seal([(b"post-merge-key", b"post-merge-payload")])
+    newest = s.manifest()["runs"][-1]
+    assert str(newest["file"]) not in inherited
+    assert s.take(b"post-merge-key") == b"post-merge-payload"
+
+
+def test_relocate_manifest_hardlinks_run_files(tmp_path):
+    """Cross-root rebalance: run files materialize under the new root at
+    the same relative layout; same inode where the fs allows links."""
+    store, items = _sealed_store("resc-reloc", n_runs=2, per=15)
+    man = store.manifest()
+    src_root, _ = spill.root()
+    dst_root = str(tmp_path / "new-proc-spill")
+    moved, nbytes = spill.relocate_manifest(man, src_root, dst_root)
+    assert moved == len(man["runs"]) and nbytes > 0
+    for rm in man["runs"]:
+        rd = str(rm.get("dir") or "") or str(man["dir"])
+        src = os.path.join(src_root, rd, str(rm["file"]))
+        dst = os.path.join(dst_root, rd, str(rm["file"]))
+        assert os.path.exists(dst)
+        assert os.stat(dst).st_size == os.stat(src).st_size
+
+
+def test_spilled_groupby_state_splits_without_refusal():
+    """The PR's headline regression: a groupby whose arrangement has
+    SPILLED must still split/merge its shard state (manifest moves), not
+    raise RescaleUnsupported and force whole-journal replay."""
+    import os as _os
+
+    _os.environ["PATHWAY_SPILL"] = "1"
+    _os.environ["PATHWAY_SPILL_BUDGET"] = "1"
+    try:
+        G.clear()
+        s = Session()
+        s.capture(_groupby_build())
+        s.execute()
+        node = next(n for n in s.graph.nodes if hasattr(n, "_maybe_spill"))
+        node._maybe_spill()
+        assert node._spill is not None and node._spill.has_runs
+        st = node.persist_state()
+        blob = codec.encode_record(st, with_magic=True)  # codec-clean
+        st = next(codec.read_records(blob, with_magic=True))
+        parts = node.split_shard_state(st, 2, lambda tok: hash(tok) % 2)
+        assert len(parts) == 2
+        manifests = [
+            m for p in parts for m in _manifests_in(p)
+        ]
+        assert manifests, "split states must carry the spill manifests"
+        merged = node.merge_shard_states(parts)
+        assert _manifests_in(merged)
+    finally:
+        _os.environ.pop("PATHWAY_SPILL", None)
+        _os.environ.pop("PATHWAY_SPILL_BUDGET", None)
+        G.clear()
+
+
+def _manifests_in(v):
+    found = []
+    if spill.is_manifest(v):
+        return [v]
+    if isinstance(v, dict):
+        for x in v.values():
+            found.extend(_manifests_in(x))
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            found.extend(_manifests_in(x))
+    return found
