@@ -5770,6 +5770,16 @@ class ExternalIndexNode(Node):
         # re-pack rows without re-running the search
         self.matches: dict[Key, list] = {}
 
+    def index_tiers(self) -> list:
+        """Tiered ANN indexes behind this node (verifier contract
+        surface — `index-tier-contract`). Unwraps the rerank wrapper;
+        non-tiered and exact indexes contribute nothing."""
+        hi = self.host_index
+        hi = getattr(hi, "inner", hi)
+        if getattr(hi, "_tiers", None) is not None:
+            return [hi]
+        return []
+
     def _search_many(
         self, queries: list[tuple[Key, tuple]]
     ) -> dict[Key, list] | None:

@@ -334,6 +334,45 @@ class SpillStore:
             m.counter("pathway_spill_probe_tier", {"tier": "miss"})
         return None
 
+    def peek(self, kb: bytes) -> bytes | None:
+        """Read without promoting: the same fence -> bloom -> one
+        windowed read ladder as :meth:`take`, but the key stays live in
+        its run and the promotion counter is untouched. For callers
+        whose read buffer is NOT a tier (the tiered ANN index probes
+        cold lists through here — the decoded block is transient, so
+        marking the run record dead would orphan the only copy)."""
+        if not self.runs:
+            return None
+        h = key_hash(kb)
+        m = _metrics()
+        with self._gen_lock:
+            runs = tuple(self.runs)
+        for run in reversed(runs):
+            if kb in run.dead:
+                continue
+            if h < run.hmin or h > run.hmax:
+                if m:
+                    m.counter(
+                        "pathway_spill_probe_tier", {"tier": "fence"},
+                        help="spill probe outcomes by ladder tier",
+                    )
+                continue
+            if not _dp.bloom_check(run.bloom, run.m_bits, run.k, h):
+                if m:
+                    m.counter("pathway_spill_probe_tier", {"tier": "bloom"})
+                continue
+            payload = self._lookup(run, h, kb)
+            if payload is None:
+                if m:
+                    m.counter("pathway_spill_probe_tier", {"tier": "run_false"})
+                continue
+            if m:
+                m.counter("pathway_spill_probe_tier", {"tier": "run_hit"})
+            return payload
+        if m:
+            m.counter("pathway_spill_probe_tier", {"tier": "miss"})
+        return None
+
     def _lookup(self, run: _Run, h: int, kb: bytes) -> bytes | None:
         """One windowed disk read: the sparse-index block(s) that can
         hold hash h, scanned in memory."""

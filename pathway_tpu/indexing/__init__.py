@@ -34,8 +34,28 @@ from pathway_tpu.stdlib.indexing import (  # noqa: F401 — engine-layer names
 )
 
 from pathway_tpu.indexing.ann import IvfPqIndex
+from pathway_tpu.indexing.tiers import (  # noqa: F401
+    TIER_COLD,
+    TIER_HOT,
+    TIER_NAMES,
+    TIER_WARM,
+    TierState,
+    tiered_enabled,
+    verify_tier_state,
+)
 
-__all__ = ["IvfPqIndex", "ann_enabled", *_stdlib_all]
+__all__ = [
+    "IvfPqIndex",
+    "ann_enabled",
+    "tiered_enabled",
+    "TierState",
+    "TIER_HOT",
+    "TIER_WARM",
+    "TIER_COLD",
+    "TIER_NAMES",
+    "verify_tier_state",
+    *_stdlib_all,
+]
 
 
 def ann_enabled(default: bool = True) -> bool:

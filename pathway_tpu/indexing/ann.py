@@ -40,6 +40,7 @@ docs/retrieval.md).
 from __future__ import annotations
 
 import atexit
+import copy
 import itertools
 import os
 import threading
@@ -50,6 +51,8 @@ from typing import Any
 import numpy as np
 
 from pathway_tpu.ops import ivf as _ivf
+from pathway_tpu.engine import spill as _spill
+from pathway_tpu.indexing import tiers as _tiers
 from pathway_tpu.stdlib.indexing.host_indexes import VectorSlabIndex
 from pathway_tpu.analysis import lockgraph as _lockgraph
 
@@ -71,11 +74,48 @@ def _drain_retrain_threads() -> None:
             t.join(timeout=30)
 
 
+# Indexes with a live tier-rebalance daemon: same exit discipline.
+_LIVE_TIER_DAEMONS: "weakref.WeakSet[IvfPqIndex]" = weakref.WeakSet()
+
+
+@atexit.register
+def _drain_tier_daemons() -> None:
+    for idx in list(_LIVE_TIER_DAEMONS):
+        ev = idx._tier_stop
+        if ev is not None:
+            ev.set()
+    for idx in list(_LIVE_TIER_DAEMONS):
+        t = idx._tier_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=10)
+
+
+def _tier_loop(ref: "weakref.ref[IvfPqIndex]", stop: threading.Event,
+               interval: float) -> None:
+    # weakref, not self: the loop is perpetual, and a hard reference
+    # from its own thread would keep the index alive forever
+    while not stop.wait(interval):
+        idx = ref()
+        if idx is None:
+            return
+        try:
+            idx.rebalance_tiers_now()
+        except Exception as e:  # noqa: BLE001 — background: log, keep placement
+            from pathway_tpu.internals.errors import global_error_log
+
+            global_error_log().log(
+                f"ANN tier rebalance failed ({type(e).__name__}: {e})"
+            )
+        del idx
+
+
 class _Generation:
     """One trained routing structure: coarse centroids + PQ codebooks +
     the packed per-list cell arrays. Mutations only ever touch cells;
     centroids/codebooks are immutable per generation (that is what
     makes the background-retrain swap atomic)."""
+
+    dead_cold = 0  # class default: pre-tiering pickles restore cleanly
 
     def __init__(
         self,
@@ -94,6 +134,7 @@ class _Generation:
         self.fill = np.zeros(L, np.int64)  # next append pos per list
         self.cell_of: dict[int, tuple[int, int]] = {}  # slot -> (l, pos)
         self.n_dead = 0
+        self.dead_cold = 0  # dead cells pinned in cold lists (uncompactable)
         self.spills = 0
         self.trained_rows = trained_rows
         self.version = next(_GEN_SEQ)
@@ -162,6 +203,11 @@ class IvfPqIndex(VectorSlabIndex):
         seed: int = 0,
         name: str | None = None,
         sharded: bool | None = None,
+        tiered: bool | None = None,
+        hot_lists: int | None = None,
+        ram_lists: int | None = None,
+        background_tiering: bool = True,
+        tier_interval: float = 5.0,
     ):
         super().__init__(
             dimensions=dimensions,
@@ -213,6 +259,26 @@ class IvfPqIndex(VectorSlabIndex):
         self._sharded_view = None
         self._sharded_key = None
         self._sharded_failures = 0
+        # three-tier list placement (indexing/tiers.py): constructor
+        # budgets opt in; PATHWAY_ANN_TIERED=1 opts in with auto
+        # budgets; =0 ALWAYS vetoes (the byte-identical bypass leg).
+        # Env is read at construction time, same as the sharded flag.
+        self.hot_lists = hot_lists
+        self.ram_lists = ram_lists
+        self.background_tiering = background_tiering
+        self.tier_interval = tier_interval
+        self._tiered = _tiers.tiered_enabled(
+            default=(
+                tiered
+                if tiered is not None
+                else (hot_lists is not None or ram_lists is not None)
+            )
+        )
+        self._tiers: _tiers.TierState | None = None
+        self._tier_thread: threading.Thread | None = None
+        self._tier_stop: threading.Event | None = None
+        self._tier_dev: dict[str, Any] | None = None  # hot sub-cube mirror
+        self._tier_dev_key = None
         self._metrics_dirty = True
         self.counters = {
             "retrains": 0,
@@ -231,6 +297,30 @@ class IvfPqIndex(VectorSlabIndex):
         # pickle while a background retrain is mid-swap
         with self._gen_lock:
             st = super().__getstate__()
+            gen = self._gen
+            ts = self._tiers
+            if ts is not None and gen is not None and ts.version == gen.version:
+                # tiered checkpoint = run manifest + RAM-resident code
+                # blocks only: cold lists restore as zeros and stay
+                # reachable through the (verified) manifest — the
+                # checkpoint shrinks from the whole cube to hot state
+                resident = np.flatnonzero(ts.tier != _tiers.TIER_COLD)
+                st["_tier_ckpt"] = {
+                    "manifest": ts.store.manifest(),
+                    "tier": np.asarray(ts.tier).copy(),
+                    "accesses": ts.accesses.copy(),
+                    "version": ts.version,
+                    "hot_budget": ts.hot_budget,
+                    "ram_budget": ts.ram_budget,
+                    "promotions": ts.promotions,
+                    "demotions": ts.demotions,
+                    "resident": resident.astype(np.int64),
+                    "blocks": gen.cube[resident].copy(),
+                    "shape": gen.cube.shape,
+                }
+                g2 = copy.copy(gen)
+                g2.cube = None  # rebuilt from _tier_ckpt on restore
+                st["_gen"] = g2
         st["_gen_lock"] = None
         st["_retrain_mutex"] = None
         st["_retrain_thread"] = None
@@ -243,9 +333,15 @@ class IvfPqIndex(VectorSlabIndex):
         st["_ann_dirty_slots"] = set()
         st["_sharded_view"] = None
         st["_sharded_key"] = None
+        st["_tiers"] = None
+        st["_tier_thread"] = None
+        st["_tier_stop"] = None
+        st["_tier_dev"] = None
+        st["_tier_dev_key"] = None
         return st
 
     def __setstate__(self, st):
+        ckpt = st.pop("_tier_ckpt", None)
         self.__dict__.update(st)
         self._gen_lock = _lockgraph.register_lock(
             "ann.generation", threading.RLock(), reentrant=True
@@ -253,6 +349,29 @@ class IvfPqIndex(VectorSlabIndex):
         self._retrain_mutex = _lockgraph.register_lock(
             "ann.retrain", threading.Lock()
         )
+        if ckpt is not None:
+            # crash-safe rebuild: attach_store re-proves the manifest
+            # (PlanVerificationError on tampering) and validates every
+            # run file's bytes on disk (RuntimeError on damage) BEFORE
+            # the index serves a single probe
+            gen = self._gen
+            L, cap, m = ckpt["shape"]
+            cube = np.zeros((L, cap, m), np.uint8)
+            cube[ckpt["resident"]] = ckpt["blocks"]
+            gen.cube = cube
+            store = _spill.attach_store(ckpt["manifest"])
+            ts = _tiers.TierState(
+                L, ckpt["version"], ckpt["hot_budget"], ckpt["ram_budget"],
+                store,
+            )
+            ts.tier = np.asarray(ckpt["tier"], np.int8)
+            ts.accesses = np.asarray(ckpt["accesses"], np.float64)
+            ts.promotions = int(ckpt["promotions"])
+            ts.demotions = int(ckpt["demotions"])
+            ts.store.tail_keys = ts.resident_list_keys
+            self._tiers = ts
+            if self._tiered and self.background_tiering:
+                self._start_tier_daemon()
 
     # ----------------------------------------------------------- mutation
 
@@ -314,6 +433,20 @@ class IvfPqIndex(VectorSlabIndex):
             gen.grow_cap()
             self._ann_dev = None  # shape changed: full device rebuild
             self._ann_dev_version = -1
+            self._tier_dev = None
+            self._tier_dev_key = None
+        ts = self._tiers
+        if (
+            ts is not None
+            and ts.version == gen.version
+            and ts.tier[lst] == _tiers.TIER_COLD
+        ):
+            # no-lost-inserts across tiers: codes append into the RAM
+            # cube, so a cold target list promotes FIRST (take = the
+            # run record dies; exclusive residency) and the row lands
+            # in a resident list inside its own probe footprint
+            self._promote_list(gen, ts, lst)
+            ts.tier[lst] = _tiers.TIER_WARM
         pos = int(gen.fill[lst])
         gen.cube[lst, pos] = code
         gen.valid[lst, pos] = True
@@ -337,7 +470,11 @@ class IvfPqIndex(VectorSlabIndex):
         self._metrics_dirty = True
         self._mutations += 1  # invalidates the list-sharded mesh view
         gen = self._gen
-        if gen is not None and gen.tombstone_frac() > self.compact_frac:
+        if (
+            gen is not None
+            and gen.tombstone_frac() > self.compact_frac
+            and gen.n_dead > gen.dead_cold  # something is reclaimable
+        ):
             self._compact(gen)
         self._maybe_retrain()
 
@@ -348,13 +485,33 @@ class IvfPqIndex(VectorSlabIndex):
         rebuilt on next search). O(live cells) host work, amortized by
         the compact_frac threshold."""
         L, cap, m = gen.cube.shape
+        ts = self._tiers
+        tiered = ts is not None and ts.version == gen.version
         new_cube = np.zeros_like(gen.cube)
         new_valid = np.zeros_like(gen.valid)
         new_slots = np.full_like(gen.slots, -1)
         new_fill = np.zeros_like(gen.fill)
         cell_of: dict[int, tuple[int, int]] = {}
+        dead_cold = 0
         for lst in range(L):
-            live = np.flatnonzero(gen.valid[lst, : gen.fill[lst]])
+            fl = int(gen.fill[lst])
+            if tiered and ts.tier[lst] == _tiers.TIER_COLD:
+                # a cold list's codes live in an IMMUTABLE sealed run
+                # and its RAM rows are zeros — re-packing here would
+                # scramble code<->slot alignment. Cell positions carry
+                # over unchanged; its tombstones compact at promotion
+                # or at the next retrain instead (tracked in dead_cold
+                # so they can't re-trigger compaction every mutation).
+                new_cube[lst] = gen.cube[lst]
+                new_valid[lst, :fl] = gen.valid[lst, :fl]
+                new_slots[lst, :fl] = gen.slots[lst, :fl]
+                new_fill[lst] = fl
+                live = np.flatnonzero(gen.valid[lst, :fl])
+                dead_cold += fl - live.size
+                for pos in live:
+                    cell_of[int(gen.slots[lst, pos])] = (lst, int(pos))
+                continue
+            live = np.flatnonzero(gen.valid[lst, :fl])
             k = live.size
             new_cube[lst, :k] = gen.cube[lst, live]
             new_valid[lst, :k] = True
@@ -363,9 +520,12 @@ class IvfPqIndex(VectorSlabIndex):
             for pos, slot in enumerate(gen.slots[lst, live]):
                 cell_of[int(slot)] = (lst, pos)
         gen.cube, gen.valid, gen.slots = new_cube, new_valid, new_slots
-        gen.fill, gen.cell_of, gen.n_dead = new_fill, cell_of, 0
+        gen.fill, gen.cell_of, gen.n_dead = new_fill, cell_of, dead_cold
+        gen.dead_cold = dead_cold
         self._ann_dev = None  # cell positions moved wholesale: rebuild
         self._ann_dev_version = -1
+        self._tier_dev = None
+        self._tier_dev_key = None
         self._ann_dirty_cells.clear()
         self.counters["compactions"] += 1
         self._publish_metrics()
@@ -419,6 +579,16 @@ class IvfPqIndex(VectorSlabIndex):
                 f"ANN retrain failed ({type(e).__name__}: {e}); "
                 "keeping the previous generation"
             )
+            return
+        # the sampled recall probe rides the background thread ONLY:
+        # synchronous retrains run on the add path (wave), where 16
+        # side-by-side ANN+exact searches would block queries. The
+        # gauge publishes from here; tests that need a number call
+        # measured_recall() directly.
+        try:
+            self.measured_recall()
+        except Exception:  # noqa: BLE001 — quality probe must never kill a swap
+            pass
 
     def retrain_now(self) -> None:
         """Train a fresh generation and swap it in. Safe to call from a
@@ -488,11 +658,10 @@ class IvfPqIndex(VectorSlabIndex):
             # the f32 row mirror survives generations (slot-addressed)
             self.counters["retrains"] += 1
             self.counters["retrain_seconds"] += time.monotonic() - t0
+            # fresh generation => fresh tier placement: keys are
+            # generation-scoped, so the old store's runs are garbage
+            self._init_tiers(gen)
         self._publish_metrics()
-        try:
-            self.measured_recall()
-        except Exception:  # noqa: BLE001 — quality probe must never kill a swap
-            pass
 
     def wait_retrain(self, timeout: float = 60.0) -> None:
         t = self._retrain_thread
@@ -510,6 +679,140 @@ class IvfPqIndex(VectorSlabIndex):
             while b < n:
                 b *= 2
             return b
+
+    # ---------------------------------------------------------------- tiers
+
+    def _init_tiers(self, gen: _Generation) -> None:
+        """(Re)build tier placement for a fresh generation. Called under
+        the generation lock at every swap; a NO-OP unless tiering is on.
+        The new generation packs densely from the slab in RAM, so
+        everything starts hot/warm and the daemon re-demotes the tail."""
+        if not self._tiered:
+            return
+        old = self._tiers
+        if old is not None:
+            old.store.close()
+        hot, ram = self.hot_lists, self.ram_lists
+        if hot is None and ram is None:
+            hot, ram = _tiers.auto_budgets(gen.n_lists)
+        elif hot is None:
+            hot = max(1, int(ram) // 2)
+        elif ram is None:
+            ram = gen.n_lists  # explicit hot budget only: no cold tier
+        store = _spill.store_for(f"ann-tiers-{self.name}")
+        ts = _tiers.TierState(gen.n_lists, gen.version, hot, ram, store)
+        ts.store.tail_keys = ts.resident_list_keys
+        self._tiers = ts
+        self._tier_dev = None
+        self._tier_dev_key = None
+        if self.background_tiering:
+            self._start_tier_daemon()
+
+    def _start_tier_daemon(self) -> None:
+        if self._tier_thread is not None and self._tier_thread.is_alive():
+            return
+        self._tier_stop = threading.Event()
+        t = threading.Thread(
+            target=_tier_loop,
+            args=(weakref.ref(self), self._tier_stop, self.tier_interval),
+            name=f"pw-ann-tier-{self.name}",
+            daemon=True,
+        )
+        self._tier_thread = t
+        _LIVE_TIER_DAEMONS.add(self)
+        t.start()
+
+    def stop_tiering(self) -> None:
+        """Stop the rebalance daemon (placement freezes where it is)."""
+        if self._tier_stop is not None:
+            self._tier_stop.set()
+        t = self._tier_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=10)
+
+    def rebalance_tiers_now(self) -> dict[str, int] | None:
+        """One promotion/demotion pass: decay access counters, rank
+        lists, fit the hot/ram budgets, and migrate. Runs entirely under
+        the generation lock (atomic vs probes/appends/retrain swaps) —
+        the background daemon calls this on its interval, tests call it
+        directly with ``background_tiering=False``."""
+        with self._gen_lock:
+            gen = self._gen
+            ts = self._tiers
+            if gen is None or ts is None or ts.version != gen.version:
+                return None
+            ts.decay()
+            to_hot, to_warm, to_cold = ts.plan(np.asarray(gen.fill))
+            for lst in to_hot:
+                if ts.tier[lst] == _tiers.TIER_COLD:
+                    self._promote_list(gen, ts, lst)
+                ts.tier[lst] = _tiers.TIER_HOT
+            for lst in to_warm:
+                if ts.tier[lst] == _tiers.TIER_COLD:
+                    self._promote_list(gen, ts, lst)
+                ts.tier[lst] = _tiers.TIER_WARM
+            if to_cold:
+                # one sealed run for the whole wave of demotions; RAM
+                # rows zero AFTER the fsync'd seal so a crash between
+                # the two leaves the codes readable (in RAM via the
+                # resident checkpoint, on disk as an orphan run)
+                ts.store.seal(
+                    [
+                        (
+                            _tiers.list_key(ts.version, lst),
+                            _tiers.pack_codes(gen.cube[lst]),
+                        )
+                        for lst in to_cold
+                    ]
+                )
+                for lst in to_cold:
+                    gen.cube[lst] = 0
+                    ts.tier[lst] = _tiers.TIER_COLD
+                ts.demotions += len(to_cold)
+            self._tier_dev = None
+            self._tier_dev_key = None
+            self._metrics_dirty = True
+            return {
+                "to_hot": len(to_hot),
+                "to_warm": len(to_warm),
+                "to_cold": len(to_cold),
+            }
+
+    def _promote_list(
+        self, gen: _Generation, ts: "_tiers.TierState", lst: int
+    ) -> None:
+        """Cold -> RAM: take() the sealed block (marking the run record
+        dead — exclusive residency) and unpack it into the cube. The
+        caller flips the tier flag."""
+        payload = ts.store.take(_tiers.list_key(ts.version, int(lst)))
+        if payload is None:
+            raise RuntimeError(
+                f"ANN index {self.name}: cold list {int(lst)} has no live "
+                "run record — the one-tier invariant is broken"
+            )
+        gen.cube[lst] = _tiers.unpack_codes(
+            payload, gen.cap, gen.cube.shape[2]
+        )
+        ts.promotions += 1
+
+    def _count_probe_tiers(
+        self, ts: "_tiers.TierState", union: np.ndarray
+    ) -> None:
+        from pathway_tpu.internals import observability as _obs
+
+        plane = _obs.PLANE
+        if plane is None:
+            return
+        t = ts.tier[union]
+        for ti, tname in enumerate(_tiers.TIER_NAMES):
+            n = int((t == ti).sum())
+            if n:
+                plane.metrics.counter(
+                    "pathway_index_tier_probe_tier",
+                    {"index": self.name, "tier": tname},
+                    inc=n,
+                    help="probed routing lists by resident tier",
+                )
 
     # -------------------------------------------------------------- search
 
@@ -543,6 +846,11 @@ class IvfPqIndex(VectorSlabIndex):
         return out
 
     def _ann_topk(self, qmat: np.ndarray, k: int, gen: _Generation, nprobe: int):
+        ts = self._tiers
+        if ts is not None and ts.version == gen.version:
+            # tiered placement takes precedence over the mesh-sharded
+            # view: the hot sub-cube is the device-resident shard
+            return self._ann_topk_tiered(qmat, k, gen, ts, nprobe)
         if self._shard_search:
             try:
                 result = self._ann_topk_sharded(qmat, k, gen, nprobe)
@@ -614,6 +922,181 @@ class IvfPqIndex(VectorSlabIndex):
                 metric=self.metric if self.metric != "cosine" else "cos",
             )
         return self._collect(slots_out, dists)
+
+    def _ann_topk_tiered(
+        self, qmat, k, gen: _Generation, ts: "_tiers.TierState", nprobe: int
+    ):
+        """Search across tiers. Host computes coarse similarities against
+        the FULL centroid set (tiny: [B, L]) and unions each query's
+        top-nprobe lists over the batch — every query's top-nprobe
+        WITHIN the union is exactly its global top-nprobe, so searching
+        the union sub-layout is probe-equivalent to the all-resident
+        index. When every probed list is hot, the dispatch runs on the
+        device-resident hot sub-cube (pad lists masked via the static
+        `n_live` arg); otherwise cold blocks stream in through the spill
+        ladder (`SpillStore.peek`: fence -> bloom -> one windowed read)
+        and the numpy mirror scans the union."""
+        with self._gen_lock:
+            q = np.asarray(qmat, np.float32)
+            if q.ndim == 1:
+                q = q[None, :]
+            metric = self.metric if self.metric != "cosine" else "cos"
+            if metric == "cos":
+                qn = q / np.maximum(
+                    np.linalg.norm(q, axis=1, keepdims=True), 1e-12
+                )
+            else:
+                qn = q
+            C = np.asarray(gen.centroids, np.float32)
+            if metric == "l2sq":
+                csim = -(
+                    (qn * qn).sum(1, keepdims=True)
+                    - 2.0 * qn @ C.T
+                    + (C * C).sum(1)[None, :]
+                )
+            else:
+                csim = qn @ C.T
+            P = min(nprobe, gen.n_lists)
+            probed = np.argpartition(-csim, P - 1, axis=1)[:, :P]
+            union = np.unique(probed)
+            ts.record_access(union)
+            self._count_probe_tiers(ts, union)
+            kk = min(k, len(self.slot_of))
+            if kk <= 0:
+                return [
+                    (np.empty(0, np.int64), np.empty(0, np.float32))
+                    for _ in range(q.shape[0])
+                ]
+            cand = self._candidates(k, gen)
+            if self._ann_use_device and bool(
+                np.all(ts.tier[union] == _tiers.TIER_HOT)
+            ):
+                try:
+                    result = self._ann_dispatch_tier_device(
+                        q, kk, gen, ts, P, cand, metric
+                    )
+                    self._ann_device_failures = 0
+                    return result
+                except (ImportError, NotImplementedError) as e:
+                    self._ann_use_device = False
+                    self._log_device_error(e, permanent=True)
+                except Exception as e:  # noqa: BLE001 — transient (OOM…)
+                    self._ann_device_failures += 1
+                    if self._ann_device_failures >= 3:
+                        self._ann_use_device = False
+                    self._log_device_error(
+                        e, permanent=not self._ann_use_device
+                    )
+            m = gen.cube.shape[2]
+            codes = np.empty((union.size, gen.cap, m), np.uint8)
+            for i, lst in enumerate(union):
+                lst = int(lst)
+                if ts.tier[lst] == _tiers.TIER_COLD and gen.fill[lst] > 0:
+                    payload = ts.store.peek(
+                        _tiers.list_key(ts.version, lst)
+                    )
+                    if payload is None:
+                        raise RuntimeError(
+                            f"ANN index {self.name}: cold list {lst} "
+                            "missing from every run — the one-tier "
+                            "invariant is broken"
+                        )
+                    codes[i] = _tiers.unpack_codes(payload, gen.cap, m)
+                else:
+                    codes[i] = gen.cube[lst]
+            sub = _ivf.sub_arrays(
+                gen.as_arrays(self.vectors[: self.n_slots]),
+                union,
+                codes=codes,
+            )
+            slots_out, dists = _ivf.ivf_pq_search_host(
+                q, sub, kk, nprobe=P, candidates=cand, metric=metric
+            )
+        return self._collect(slots_out, dists)
+
+    def _ann_dispatch_tier_device(
+        self, q, kk, gen: _Generation, ts, P: int, cand: int, metric: str
+    ):
+        import jax.numpy as jnp
+
+        from pathway_tpu.engine.device_plane import get_device_plane
+        from pathway_tpu.ops.ivf import _ivf_pq_search_fn
+
+        plane = get_device_plane()
+        self._refresh_ann_rows(plane)
+        dev = self._refresh_tier_device(gen, ts, plane)
+        n_live = dev["n_live"]
+        n_q = q.shape[0]
+        if n_q > plane.buckets.max_rows:
+            qpad, qbucket = q.astype(np.float32), n_q
+        else:
+            (qpad,), qbucket = plane.pad_rows([q.astype(np.float32)], n_q)
+        prog = plane.program(
+            "ann_ivf_search_hot",
+            _ivf_pq_search_fn,
+            static_argnames=("k", "nprobe", "candidates", "metric", "n_live"),
+        )
+        Hp = int(dev["cube"].shape[0])
+        slots_out, dists = prog(
+            jnp.asarray(qpad),
+            dev["centroids"],
+            dev["cube"],
+            dev["valid"],
+            dev["slots"],
+            dev["codebooks"],
+            self._ann_full,
+            k=kk,
+            nprobe=min(P, n_live),
+            candidates=cand,
+            metric=metric,
+            n_live=n_live,
+            bucket=(
+                Hp, gen.cap, gen.cube.shape[2], self._ann_full_slots,
+                qbucket, kk, min(P, n_live), cand, self.dim, n_live,
+            ),
+        )
+        return self._collect(
+            np.asarray(slots_out)[:n_q], np.asarray(dists)[:n_q]
+        )
+
+    def _refresh_tier_device(self, gen: _Generation, ts, plane):
+        """Device mirror of the HOT lists only: centroids/cube/valid/
+        slots gathered to a pow2-padded sub-layout ([Hp, cap, m] instead
+        of [L, cap, m] — the memory saving that lets the device serve an
+        index bigger than HBM). Cached per (generation, mutations, slot
+        bucket); mutations and rebalances invalidate lazily, so the
+        rebuild cost lands on the first search after a write."""
+        import jax
+        import jax.numpy as jnp
+
+        key = (gen.version, self._mutations, self._padded_slots())
+        if self._tier_dev is not None and self._tier_dev_key == key:
+            return self._tier_dev
+        hot = np.flatnonzero(ts.tier == _tiers.TIER_HOT)
+        n_live = int(hot.size)
+        if n_live == 0:
+            raise NotImplementedError("no hot lists to mirror")
+        Hp = self._cap_bucket(n_live)
+        cap, m = gen.cap, gen.cube.shape[2]
+        cents = np.zeros((Hp, gen.centroids.shape[1]), np.float32)
+        cents[:n_live] = gen.centroids[hot]
+        cube = np.zeros((Hp, cap, m), np.uint8)
+        cube[:n_live] = gen.cube[hot]
+        valid = np.zeros((Hp, cap), bool)
+        valid[:n_live] = gen.valid[hot]
+        slotm = np.full((Hp, cap), -1, np.int32)
+        slotm[:n_live] = gen.slots[hot]
+        dev = {
+            "centroids": jax.device_put(jnp.asarray(cents)),
+            "codebooks": jax.device_put(jnp.asarray(gen.codebooks)),
+            "cube": jax.device_put(jnp.asarray(cube)),
+            "valid": jax.device_put(jnp.asarray(valid)),
+            "slots": jax.device_put(jnp.asarray(slotm)),
+            "n_live": n_live,
+        }
+        self._tier_dev = dev
+        self._tier_dev_key = key
+        return dev
 
     def _ann_topk_device(self, qmat, k, gen: _Generation, nprobe: int):
         from pathway_tpu.engine.device_plane import get_device_plane
@@ -692,41 +1175,7 @@ class IvfPqIndex(VectorSlabIndex):
         from pathway_tpu.engine.device_plane import get_device_plane
 
         plane = get_device_plane()
-        # ---- the [padded_slots, d] f32 rescore rows, slot-addressed
-        padded = self._padded_slots()
-        full_ok = self._ann_full is not None and self._ann_full_slots == padded
-        if full_ok and self._ann_dirty_slots:
-            ub = plane.buckets.rows_bucket(
-                min(len(self._ann_dirty_slots), plane.buckets.max_rows)
-            )
-            if len(self._ann_dirty_slots) > ub:
-                full_ok = False
-            else:
-                prog = plane.program(
-                    "ann_rows_update",
-                    lambda rows, idx, fresh: rows.at[idx].set(fresh),
-                    donate_argnums=(0,),
-                )
-                idx = np.fromiter(self._ann_dirty_slots, np.int32)
-                idx = np.concatenate(
-                    [idx, np.full(ub - len(idx), idx[0], np.int32)]
-                )
-                try:
-                    self._ann_full = prog(
-                        self._ann_full,
-                        jnp.asarray(idx),
-                        jnp.asarray(self.vectors[idx], jnp.float32),
-                        bucket=(padded, ub, self.dim),
-                    )
-                except Exception:
-                    self._ann_full = None
-                    raise
-        if not full_ok:
-            self._ann_full = jax.device_put(
-                jnp.asarray(self.vectors[:padded], jnp.float32)
-            )
-            self._ann_full_slots = padded
-        self._ann_dirty_slots.clear()
+        self._refresh_ann_rows(plane)
         # ---- the generation cube/valid/slots (+ static centroid arrays)
         dev = self._ann_dev
         shape_ok = (
@@ -784,6 +1233,48 @@ class IvfPqIndex(VectorSlabIndex):
             self._ann_dev_version = gen.version
         self._ann_dirty_cells.clear()
 
+    def _refresh_ann_rows(self, plane) -> None:
+        """Sync the [padded_slots, d] f32 rescore rows, slot-addressed.
+        Shared by the all-resident dispatch and the tiered hot-sub-cube
+        dispatch (slots are GLOBAL row ids in both layouts)."""
+        import jax
+        import jax.numpy as jnp
+
+        padded = self._padded_slots()
+        full_ok = self._ann_full is not None and self._ann_full_slots == padded
+        if full_ok and self._ann_dirty_slots:
+            ub = plane.buckets.rows_bucket(
+                min(len(self._ann_dirty_slots), plane.buckets.max_rows)
+            )
+            if len(self._ann_dirty_slots) > ub:
+                full_ok = False
+            else:
+                prog = plane.program(
+                    "ann_rows_update",
+                    lambda rows, idx, fresh: rows.at[idx].set(fresh),
+                    donate_argnums=(0,),
+                )
+                idx = np.fromiter(self._ann_dirty_slots, np.int32)
+                idx = np.concatenate(
+                    [idx, np.full(ub - len(idx), idx[0], np.int32)]
+                )
+                try:
+                    self._ann_full = prog(
+                        self._ann_full,
+                        jnp.asarray(idx),
+                        jnp.asarray(self.vectors[idx], jnp.float32),
+                        bucket=(padded, ub, self.dim),
+                    )
+                except Exception:
+                    self._ann_full = None
+                    raise
+        if not full_ok:
+            self._ann_full = jax.device_put(
+                jnp.asarray(self.vectors[:padded], jnp.float32)
+            )
+            self._ann_full_slots = padded
+        self._ann_dirty_slots.clear()
+
     # ------------------------------------------------------------- quality
 
     def measured_recall(
@@ -828,7 +1319,7 @@ class IvfPqIndex(VectorSlabIndex):
     def stats(self) -> dict[str, Any]:
         with self._gen_lock:
             gen = self._gen
-            return {
+            out = {
                 "size_rows": len(self.slot_of),
                 "lists": gen.n_lists if gen else 0,
                 "cap": gen.cap if gen else 0,
@@ -837,6 +1328,19 @@ class IvfPqIndex(VectorSlabIndex):
                 "recall_at_k": self.last_recall,
                 **self.counters,
             }
+            ts = self._tiers
+            if ts is not None and gen is not None and ts.version == gen.version:
+                out["tiers"] = {
+                    "lists_per_tier": {
+                        tname: int((ts.tier == ti).sum())
+                        for ti, tname in enumerate(_tiers.TIER_NAMES)
+                    },
+                    "promotions": ts.promotions,
+                    "demotions": ts.demotions,
+                    "hot_budget": ts.hot_budget,
+                    "ram_budget": ts.ram_budget,
+                }
+            return out
 
     def _publish_metrics(self, recall_k: int | None = None) -> None:
         from pathway_tpu.internals import observability as _obs
@@ -878,6 +1382,24 @@ class IvfPqIndex(VectorSlabIndex):
             "pathway_index_compactions", self.counters["compactions"], labels,
             help="tombstone compactions since start",
         )
+        ts = self._tiers
+        if ts is not None and gen is not None and ts.version == gen.version:
+            live = gen.valid.sum(axis=1)
+            for ti, tname in enumerate(_tiers.TIER_NAMES):
+                m.gauge(
+                    "pathway_index_tier_rows",
+                    int(live[ts.tier == ti].sum()),
+                    {**labels, "tier": tname},
+                    help="live rows resident in each index tier",
+                )
+            m.gauge(
+                "pathway_index_tier_promotions", ts.promotions, labels,
+                help="cold->RAM list promotions in the current generation",
+            )
+            m.gauge(
+                "pathway_index_tier_demotions", ts.demotions, labels,
+                help="RAM->cold list demotions in the current generation",
+            )
         if recall_k is not None and self.last_recall is not None:
             m.gauge(
                 "pathway_index_recall_at_k",

@@ -68,6 +68,15 @@ Checks (each independent of the code it audits; see the matching
   ladder (a key live in two tiers would let tail-first-then-newest-run
   serve stale state). Restore re-runs the manifest checks on every
   spill manifest embedded in a checkpoint BEFORE any node mutates.
+* ``index-tier-contract`` — every tiered ANN index
+  (pathway_tpu/indexing/tiers.py): each live doc's PQ codes sit in
+  EXACTLY one tier (a cold list with live rows still in the RAM cube,
+  or a cold list with no live run record, breaks the probe ladder's
+  exclusive-residency assumption), the tier store's manifest passes the
+  spill manifest checks, and the resident/cold split agrees with the
+  store's two-tier rule. Promotion must preserve no-lost-inserts: an
+  append into a cold list promotes it first, which this check observes
+  as the one-tier invariant holding after the fact.
 """
 
 from __future__ import annotations
@@ -923,6 +932,20 @@ def check_spill_contract(session, v: _Verdict, shared: dict) -> None:
     v.report["checks"][check]["stores"] = stores
 
 
+# ----------------------------------------- check: index tier contract
+
+
+def check_index_tier_contract(session, v: _Verdict, shared: dict) -> None:
+    """Prove the tier placement of every tiered ANN index behind an
+    `ExternalIndexNode` (see pathway_tpu/indexing/tiers.py): exclusive
+    residency per list (RAM cube XOR a live run record), manifest
+    integrity of the tier store, and agreement between the placement
+    flags and the store's two-tier rule."""
+    from pathway_tpu.indexing import tiers as _tiers
+
+    _tiers.check_index_tier(session, v, shared)
+
+
 # --------------------------------------------- check: morsel contract
 
 # the StealScheduler class whose dynamic probe last passed — same
@@ -1239,6 +1262,7 @@ _CHECKS = (
     check_exchange_donation,
     check_cone_contract,
     check_spill_contract,
+    check_index_tier_contract,
     check_morsel_contract,
     check_join_reorder,
 )

@@ -386,6 +386,7 @@ def _ivf_pq_search_fn(
     nprobe: int,
     candidates: int,
     metric: str = "cos",
+    n_live: int | None = None,
 ):
     """The resident program: probe → ADC scan → exact rescore → top-k.
 
@@ -393,6 +394,11 @@ def _ivf_pq_search_fn(
     carry slot -1 / distance +inf. Jitted via `ivf_pq_search` or routed
     through a DevicePlane program by the incremental index (same fn, so
     both share the compile-ledger discipline).
+
+    `n_live` (static) masks trailing PAD lists out of the probe: the
+    tiered index dispatches on a pow2-padded hot sub-cube whose pad
+    centroids are zeros — without the mask a zero (or duplicated)
+    centroid could steal a probe slot from a real list.
     """
     import jax
     import jax.numpy as jnp
@@ -415,7 +421,9 @@ def _ivf_pq_search_fn(
         )
     else:
         csim = q @ centroids.T
-    P = min(nprobe, L)
+    if n_live is not None and n_live < L:
+        csim = jnp.where(jnp.arange(L)[None, :] < n_live, csim, -jnp.inf)
+    P = min(nprobe, n_live if n_live is not None else L)
     _, probe = jax.lax.top_k(csim, P)  # [B, P]
     # ---- ADC lookup table: one [m, 256] row of partial scores per query
     qs = q.reshape(B, m, dsub)
@@ -480,7 +488,7 @@ def _jitted_search():
 
     return jax.jit(
         _ivf_pq_search_fn,
-        static_argnames=("k", "nprobe", "candidates", "metric"),
+        static_argnames=("k", "nprobe", "candidates", "metric", "n_live"),
     )
 
 
@@ -513,6 +521,27 @@ def ivf_pq_search(
         nprobe=nprobe,
         candidates=candidates,
         metric=metric,
+    )
+
+
+def sub_arrays(index: IvfPqArrays, lists, codes=None) -> IvfPqArrays:
+    """Restrict the layout to a subset of routing lists (host-side).
+
+    `slots` keep GLOBAL row ids and `full` passes through whole, so
+    results over the sub-layout are directly comparable to the full
+    index's — and each query's top-nprobe WITHIN a subset that contains
+    its global top-nprobe lists is exactly its global top-nprobe (they
+    dominate every other member). `codes` optionally overrides the code
+    slices (the tiered index substitutes blocks unpacked from cold
+    runs)."""
+    lists = np.asarray(lists, np.int64)
+    return IvfPqArrays(
+        centroids=np.asarray(index.centroids, np.float32)[lists],
+        codes=np.asarray(index.codes)[lists] if codes is None else codes,
+        valid=np.asarray(index.valid)[lists],
+        slots=np.asarray(index.slots)[lists],
+        codebooks=index.codebooks,
+        full=index.full,
     )
 
 

@@ -279,25 +279,41 @@ def make_knn_searcher(
 
         return search
 
+    import os
     import weakref
+    from collections import OrderedDict
 
     import numpy as np
 
     from pathway_tpu.ops import ivf as _ivf
 
-    # one resident index per searcher, keyed by a LIVE reference to the
-    # doc matrix: an id()-keyed cache would serve stale neighbors when a
-    # freed array's address is recycled by a new same-shape matrix
-    cache: dict = {}
+    # Bounded LRU of resident indexes, keyed by matrix id() but only
+    # served through a LIVE weakref check (a freed array's address can
+    # be recycled by a new same-shape matrix — the id alone must never
+    # validate a hit). Multiple entries keep alternating doc matrices
+    # (A/B snapshot swaps in serving) warm without retraining per call;
+    # the bound keeps the cache from growing monotonically per distinct
+    # matrix across a long-lived searcher.
+    cache: "OrderedDict[int, tuple]" = OrderedDict()
+    cache_cap = max(1, int(os.environ.get("PATHWAY_KNN_CACHE", "4") or 4))
 
     def search_ann(queries: Array, docs: Array) -> TopKResult:
+        key = id(docs)
         index = None
-        ent = cache.get("index")
+        ent = cache.get(key)
         if ent is not None:
             ref, shape, cached = ent
             if ref() is docs and shape == tuple(docs.shape):
                 index = cached
+                cache.move_to_end(key)
+            else:  # recycled id: the entry is stale, drop it
+                del cache[key]
         if index is None:
+            # prune entries whose matrix has been freed, THEN evict LRU
+            for stale in [
+                kk for kk, (r, _s, _i) in cache.items() if r() is None
+            ]:
+                del cache[stale]
             index = _ivf.build_ivf_pq(np.asarray(docs), metric=metric)
             if mesh is not None:
                 # one placement per trained index: lists sharded over the
@@ -307,7 +323,9 @@ def make_knn_searcher(
                 ref = weakref.ref(docs)
             except TypeError:  # unweakreferenceable: pin it (still correct)
                 ref = (lambda d=docs: d)
-            cache["index"] = (ref, tuple(docs.shape), index)
+            cache[key] = (ref, tuple(docs.shape), index)
+            while len(cache) > cache_cap:
+                cache.popitem(last=False)
         if mesh is not None:
             slots, dists = _ivf.ivf_pq_search_sharded(
                 queries, index, k, nprobe=nprobe, metric=metric
@@ -318,4 +336,5 @@ def make_knn_searcher(
             )
         return TopKResult(indices=slots, distances=dists)
 
+    search_ann._cache = cache  # introspection seam (tests, debugging)
     return search_ann

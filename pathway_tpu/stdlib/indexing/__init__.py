@@ -30,6 +30,7 @@ from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     UsearchKnn,
     UsearchKnnFactory,
 )
+from pathway_tpu.stdlib.indexing.reranking import RerankedSlabIndex
 from pathway_tpu.stdlib.indexing.retrievers import (
     InnerIndex,
     InnerIndexFactory,
@@ -65,6 +66,7 @@ __all__ = [
     "USearchMetricKind",
     "IvfPqKnn",
     "IvfPqKnnFactory",
+    "RerankedSlabIndex",
     "LshKnn",
     "LshKnnFactory",
     "TantivyBM25",

@@ -117,6 +117,13 @@ class IvfPqKnn(_EmbeddingKnn):
     byte-identical ranking semantics (same (score, key) tie-break), the
     guarantee the `ann` CI leg pins. Corpora under `train_min` rows are
     served exactly either way.
+
+    Tier placement (`tiered`/`hot_lists`/`ram_lists`, docs/retrieval.md
+    §tier lifecycle) and the second-stage reranker (`rerank`,
+    `stdlib/indexing/reranking.py`) ride the same build-time-env
+    discipline: ``PATHWAY_ANN_TIERED=0`` pins the all-resident layout
+    byte-identically, and the exact-slab fallback never wraps a
+    reranker (an exact first stage has nothing to recover).
     """
 
     dimensions: int | None = None
@@ -127,13 +134,19 @@ class IvfPqKnn(_EmbeddingKnn):
     subvectors: int | None = None
     train_min: int = 256
     background_retrain: bool = True
+    tiered: bool | None = None
+    hot_lists: int | None = None
+    ram_lists: int | None = None
+    rerank: bool = False
+    rerank_expand: int = 4
     embedder: Any = None
 
     def _host_index_factory(self) -> Callable:
         cfg = (
             self.dimensions, self.reserved_space, self.metric, self.n_lists,
             self.nprobe, self.subvectors, self.train_min,
-            self.background_retrain,
+            self.background_retrain, self.tiered, self.hot_lists,
+            self.ram_lists, self.rerank, self.rerank_expand,
         )
 
         def build():
@@ -146,11 +159,19 @@ class IvfPqKnn(_EmbeddingKnn):
                     dimensions=cfg[0], reserved_space=cfg[1], metric=cfg[2],
                     approx=False,
                 )
-            return IvfPqIndex(
+            index = IvfPqIndex(
                 dimensions=cfg[0], reserved_space=cfg[1], metric=cfg[2],
                 n_lists=cfg[3], nprobe=cfg[4], subvectors=cfg[5],
                 train_min=cfg[6], background_retrain=cfg[7],
+                tiered=cfg[8], hot_lists=cfg[9], ram_lists=cfg[10],
             )
+            if cfg[11]:
+                from pathway_tpu.stdlib.indexing.reranking import (
+                    RerankedSlabIndex,
+                )
+
+                return RerankedSlabIndex(index, expand=cfg[12])
+            return index
 
         return build
 
@@ -238,6 +259,11 @@ class IvfPqKnnFactory(InnerIndexFactory):
     subvectors: int | None = None
     train_min: int = 256
     background_retrain: bool = True
+    tiered: bool | None = None
+    hot_lists: int | None = None
+    ram_lists: int | None = None
+    rerank: bool = False
+    rerank_expand: int = 4
     embedder: Any = None
 
     def build_inner_index(
@@ -256,6 +282,11 @@ class IvfPqKnnFactory(InnerIndexFactory):
             subvectors=self.subvectors,
             train_min=self.train_min,
             background_retrain=self.background_retrain,
+            tiered=self.tiered,
+            hot_lists=self.hot_lists,
+            ram_lists=self.ram_lists,
+            rerank=self.rerank,
+            rerank_expand=self.rerank_expand,
             embedder=self.embedder,
         )
 

@@ -26,6 +26,11 @@ def default_retriever_factory(
     ann: bool | None = None,
     with_bm25: bool = False,
     rrf_k: float = 60.0,
+    tiered: bool | None = None,
+    hot_lists: int | None = None,
+    ram_lists: int | None = None,
+    rerank: bool = False,
+    rerank_expand: int = 4,
 ) -> InnerIndexFactory:
     """Config-driven retriever selection for document stores.
 
@@ -36,6 +41,13 @@ def default_retriever_factory(
     `with_bm25` wraps the KNN in a HybridIndexFactory with a BM25
     leg fused by reciprocal rank (the reference's USearch+Tantivy
     pairing as one operator).
+
+    `tiered`/`hot_lists`/`ram_lists` place the IVF routing lists
+    across the device/RAM/disk hierarchy, and `rerank` recovers the
+    first-stage recall with the batched on-device second stage plus
+    adaptive geometric candidate expansion (`rerank_expand` is the
+    round-0 overfetch multiplier) — both only meaningful with the
+    ANN retriever, silently inert on the exact slab.
     """
     from pathway_tpu.indexing import ann_enabled
     from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25Factory
@@ -51,7 +63,9 @@ def default_retriever_factory(
     # ann=True is an opt-in PATHWAY_ANN=0 can veto; None defers entirely
     if ann is not False and ann_enabled(default=bool(ann)):
         knn: InnerIndexFactory = IvfPqKnnFactory(
-            dimensions=dimensions, embedder=embedder
+            dimensions=dimensions, embedder=embedder,
+            tiered=tiered, hot_lists=hot_lists, ram_lists=ram_lists,
+            rerank=rerank, rerank_expand=rerank_expand,
         )
     else:
         knn = BruteForceKnnFactory(dimensions=dimensions, embedder=embedder)

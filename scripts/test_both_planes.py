@@ -93,6 +93,13 @@ runs must behave exactly like the pre-elastic static mesh
 vs a static mesh, swap gates, crash roll-forward — lives in
 tests/test_elastic.py and runs inside legs 1-2 plus the chaos drill's
 elastic kinds.
+Leg 20 (ann-tiered-off): the index suites with tiered ANN storage
+killed (PATHWAY_ANN_TIERED=0) — tier-configured IVF-PQ indexes stay
+all-resident, the byte-identity baseline the hot/warm/cold hierarchy
+is pinned against (docs/retrieval.md §tier lifecycle); the tiered-on
+side — placement, migration-vs-churn races, checkpoint shrink, the
+index-tier verifier contract, reranking — lives in
+tests/test_index_tiers.py and runs inside legs 1-2.
 
 Writes TESTLEGS.json at the repo root: the artifact proving the legs ran
 green on this checkout (VERDICT round-4 item: the equivalence leg must be
@@ -272,6 +279,19 @@ def main() -> int:
                 "tests/test_indexing_relevance.py",
                 "tests/test_vector_store.py",
                 "tests/test_ml.py",
+            ],
+        ),
+        # tiered index storage killed: tier-configured indexes stay
+        # all-resident, the byte-identity baseline the hot/warm/cold
+        # hierarchy is pinned against
+        # (tests/test_index_tiers.py::test_tiered_off_is_byte_identical)
+        run_leg(
+            "ann-tiered-off", {"PATHWAY_ANN_TIERED": "0"}, extra,
+            [
+                "tests/test_index_tiers.py",
+                "tests/test_ann_index.py",
+                "tests/test_indexing.py",
+                "tests/test_vector_store.py",
             ],
         ),
         # plan optimizer killed: the unoptimized lowering is the

@@ -1,0 +1,539 @@
+"""Tier-1 guards for tiered ANN storage + the batched reranker.
+
+Contracts (docs/retrieval.md §tier lifecycle):
+* **exclusive residency** — every doc's PQ codes live in EXACTLY one
+  tier; demotion seals codes to disk and zeroes the RAM cube, promotion
+  reads them back and the run record dies. `verify_tier_state` /
+  ``index-tier-contract`` prove it from the bytes on disk.
+* **no lost inserts** — an append routed into a cold list promotes the
+  list FIRST; concurrent retract + migrate churn never surfaces a
+  tombstone and never loses a live row (3 seeds).
+* **kill switch** — ``PATHWAY_ANN_TIERED=0`` pins the all-resident
+  layout byte-identically (same scores, same tie-break).
+* **checkpoint shrink** — a tiered checkpoint carries manifest + hot
+  state only; restore rebuilds cold lists crash-safely and REFUSES a
+  tampered tier manifest by name.
+* **rerank** — the second stage recovers first-stage probe misses via
+  adaptive geometric expansion, stays on the bucketed device ledger,
+  and degrades 3-strike to the numpy mirror.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine import spill
+from pathway_tpu.indexing import (
+    TIER_COLD,
+    IvfPqIndex,
+    tiered_enabled,
+    verify_tier_state,
+)
+from pathway_tpu.indexing import tiers as tiers_mod
+from pathway_tpu.internals.keys import Key
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.verifier import PlanVerificationError
+from pathway_tpu.stdlib.indexing import RerankedSlabIndex
+from pathway_tpu.stdlib.indexing.host_indexes import VectorSlabIndex
+
+DIM = 32
+
+
+@pytest.fixture(autouse=True)
+def _fresh(tmp_path, monkeypatch):
+    G.clear()
+    monkeypatch.delenv("PATHWAY_ANN", raising=False)
+    monkeypatch.delenv("PATHWAY_ANN_TIERED", raising=False)
+    saved = (spill._ROOT, spill._PERSISTENT)
+    spill.set_root(str(tmp_path), persistent=True)
+    yield
+    G.clear()
+    with spill._ROOT_LOCK:
+        spill._ROOT, spill._PERSISTENT = saved
+
+
+def _clustered(n: int, seed: int = 0, n_clusters: int = 40) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(n_clusters, DIM))
+    return (
+        centers[rng.integers(0, n_clusters, n)]
+        + 0.15 * rng.normal(size=(n, DIM))
+    ).astype(np.float32)
+
+
+def _load(index, docs: np.ndarray, start: int = 0) -> list[Key]:
+    keys = [Key(start + i) for i in range(len(docs))]
+    for key, vec in zip(keys, docs):
+        index.add(key, vec)
+    return keys
+
+
+def _tiered(docs, *, hot=4, ram=10, **kw):
+    """Trained tiered index with the background daemon off (tests drive
+    migration deterministically via rebalance_tiers_now)."""
+    ann = IvfPqIndex(
+        dimensions=DIM, background_retrain=False, seed=0,
+        tiered=True, hot_lists=hot, ram_lists=ram,
+        background_tiering=False, **kw,
+    )
+    _load(ann, docs)
+    assert ann.stats()["trained"]
+    return ann
+
+
+def _recall_at(res, ref, k: int = 10) -> float:
+    vals = []
+    for a, b in zip(res, ref):
+        got = {key for key, _ in a[:k]}
+        want = {key for key, _ in b[:k]}
+        vals.append(len(got & want) / max(len(want), 1))
+    return float(np.mean(vals))
+
+
+# ------------------------------------------------- placement + recall
+
+
+def test_tiered_recall_through_the_cold_ladder():
+    """With most lists demoted to disk, recall@10 vs the exact f32 scan
+    must hold the same >= 0.95 bar as the all-resident index — cold
+    probes take the fence/bloom/one-read ladder, not a quality cut."""
+    docs = _clustered(3000, seed=0)
+    ann = _tiered(docs)
+    moved = ann.rebalance_tiers_now()
+    assert moved["to_cold"] > 0
+    stats = ann.stats()["tiers"]
+    assert stats["lists_per_tier"]["cold"] > 0
+    ex = VectorSlabIndex(dimensions=DIM, device=False)
+    _load(ex, docs)
+    rng = np.random.default_rng(1)
+    q = docs[rng.choice(len(docs), 40)] + 0.05 * rng.normal(size=(40, DIM))
+    items = [(q[i], 10, None) for i in range(len(q))]
+    assert _recall_at(ann.search_batch(items), ex.search_batch(items)) >= 0.95
+    verify_tier_state(ann)
+
+
+def test_probe_promotes_hot_lists_on_access():
+    """The placement loop follows the query distribution: lists the
+    probes keep touching climb back out of the cold tier."""
+    docs = _clustered(2000, seed=3)
+    ann = _tiered(docs, hot=2, ram=4)
+    ann.rebalance_tiers_now()
+    ts, gen = ann._tiers, ann._gen
+    cold = [l for l in ts.cold_lists() if gen.fill[l] > 0]
+    assert cold
+    # a SKEWED query stream aimed at one cold list's own docs — uniform
+    # traffic would reproduce the fill ranking and move nothing
+    target = cold[0]
+    slots = gen.slots[target][gen.valid[target]]
+    q = ann.vectors[slots[:8]].astype(np.float32)
+    for _ in range(6):
+        ann.search_batch([(qi, 5, None) for qi in q])
+        ann.rebalance_tiers_now()
+    assert ts.promotions > 0
+    assert ts.tier[target] != TIER_COLD, "the hammered list must warm up"
+    verify_tier_state(ann)
+
+
+def test_append_into_cold_list_promotes_first():
+    """No-lost-inserts: adds routed to a demoted list must promote it
+    before the append lands — the new doc is findable immediately and
+    the one-tier invariant still proves out."""
+    docs = _clustered(2000, seed=2)
+    ann = _tiered(docs, hot=2, ram=4)
+    ann.rebalance_tiers_now()
+    gen = ann._gen
+    ts = ann._tiers
+    assert np.any(ts.tier == TIER_COLD)
+    before = ts.promotions
+    extra = _clustered(200, seed=7)
+    keys = _load(ann, extra, start=10_000)
+    assert ts.promotions > before, "no add ever landed in a cold list?"
+    res = ann.search_batch([(extra[i], 5, None) for i in range(0, 200, 20)])
+    for i, matches in zip(range(0, 200, 20), res):
+        assert keys[i] in {key for key, _ in matches}
+    verify_tier_state(ann)
+
+
+def test_tombstone_on_cold_list_stays_on_ram_flags():
+    """Retracting a doc whose codes are sealed on disk flips the RAM
+    valid bit only (runs are immutable); the row never resurfaces and
+    the invariant check still passes."""
+    docs = _clustered(1500, seed=6)
+    ann = _tiered(docs, hot=2, ram=4)
+    ann.rebalance_tiers_now()
+    ts = ann._tiers
+    gen = ann._gen
+    cold = [l for l in ts.cold_lists() if gen.fill[l] > 0]
+    assert cold
+    lst = cold[0]
+    pos = int(np.flatnonzero(gen.valid[lst])[0])
+    slot = int(gen.slots[lst, pos])
+    key = ann.key_of[slot]
+    vec = ann.vectors[slot].astype(np.float32).copy()
+    ann.remove(key)
+    assert ts.tier[lst] == TIER_COLD, "a retract must not promote"
+    res = ann.search_batch([(vec, 10, None)])[0]
+    assert key not in {k for k, _ in res}
+    verify_tier_state(ann)
+
+
+# --------------------------------------- churn x migration (satellite)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_concurrent_retract_and_tier_migration(seed):
+    """Retract/add churn racing the migration thread: every result set
+    stays a subset of live rows and the exclusive-residency invariant
+    holds at the end."""
+    rng = np.random.default_rng(seed)
+    docs = _clustered(2000, seed=seed)
+    ann = _tiered(docs, hot=3, ram=8)
+    live: dict[Key, np.ndarray] = {Key(i): docs[i] for i in range(len(docs))}
+    stop = threading.Event()
+    errors: list[Exception] = []
+
+    def migrate():
+        try:
+            while not stop.is_set():
+                ann.rebalance_tiers_now()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=migrate, daemon=True)
+    t.start()
+    next_id = len(docs)
+    try:
+        for _ in range(8):
+            for key in rng.choice(list(live), 80, replace=False):
+                ann.remove(key)
+                del live[key]
+            fresh = _clustered(80, seed=int(rng.integers(1 << 30)))
+            for vec in fresh:
+                key = Key(next_id)
+                ann.add(key, vec)
+                live[key] = vec
+                next_id += 1
+            keys = list(live)
+            sample = rng.choice(len(keys), 20, replace=False)
+            res = ann.search_batch([(live[keys[i]], 5, None) for i in sample])
+            for matches in res:
+                assert {k for k, _ in matches} <= set(live), \
+                    "tombstoned row surfaced during migration"
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert not errors
+    verify_tier_state(ann)
+    assert set(ann.key_of.values()) == set(live)
+
+
+# ------------------------------------------------------- kill switch
+
+
+def test_tiered_enabled_env_contract(monkeypatch):
+    monkeypatch.delenv("PATHWAY_ANN_TIERED", raising=False)
+    assert tiered_enabled(True) and not tiered_enabled(False)
+    monkeypatch.setenv("PATHWAY_ANN_TIERED", "0")
+    assert not tiered_enabled(True) and not tiered_enabled(False)
+    monkeypatch.setenv("PATHWAY_ANN_TIERED", "1")
+    assert tiered_enabled(True) and tiered_enabled(False)
+
+
+def test_tiered_off_is_byte_identical(monkeypatch):
+    """PATHWAY_ANN_TIERED=0 on a tier-configured index reproduces the
+    all-resident index byte for byte — scores AND tie-breaks."""
+    docs = _clustered(1500, seed=9)
+    items = [(docs[i] + 0.01, 10, None) for i in range(0, 60, 3)]
+
+    plain = IvfPqIndex(dimensions=DIM, background_retrain=False, seed=0)
+    _load(plain, docs)
+    want = plain.search_batch(items)
+
+    monkeypatch.setenv("PATHWAY_ANN_TIERED", "0")
+    vetoed = IvfPqIndex(
+        dimensions=DIM, background_retrain=False, seed=0,
+        tiered=True, hot_lists=2, ram_lists=4,
+    )
+    _load(vetoed, docs)
+    assert vetoed._tiers is None, "env veto must disable tier placement"
+    assert vetoed.search_batch(items) == want
+
+
+def test_tiered_results_match_resident_before_any_migration():
+    """Tiering ON but nothing demoted yet: the tiered probe path itself
+    (host csim + union sub-layout) must agree with the resident index on
+    every byte — the layout split, not the math, is the only change."""
+    docs = _clustered(1500, seed=9)
+    items = [(docs[i] + 0.01, 10, None) for i in range(0, 60, 3)]
+    # device=False: the tiered probe runs host-side (mixed-tier unions
+    # can't ship to HBM), so the apples-to-apples reference is the host
+    # path of the resident index — device f32 noise is not the claim
+    plain = IvfPqIndex(
+        dimensions=DIM, background_retrain=False, seed=0, device=False
+    )
+    _load(plain, docs)
+    tiered = _tiered(docs, hot=2, ram=4, device=False)
+    assert tiered.search_batch(items) == plain.search_batch(items)
+
+
+# ----------------------------------------------- checkpoint + restore
+
+
+def test_tiered_checkpoint_is_manifest_plus_hot_state():
+    """The pickled state of a mostly-cold index must NOT carry the full
+    code cube — only resident blocks + the run manifest."""
+    docs = _clustered(3000, seed=10)
+    ann = _tiered(docs, hot=2, ram=6)
+    ann.rebalance_tiers_now()
+    st = ann.__getstate__()
+    assert st["_gen"].cube is None
+    ckpt = st["_tier_ckpt"]
+    assert ckpt["blocks"].shape[0] == len(ckpt["resident"])
+    assert ckpt["blocks"].shape[0] < ann._gen.n_lists
+    assert ckpt["manifest"]["n_runs"] >= 1
+
+
+def test_tiered_pickle_roundtrip_preserves_results():
+    docs = _clustered(2000, seed=11)
+    ann = _tiered(docs, hot=3, ram=8)
+    ann.rebalance_tiers_now()
+    items = [(docs[i], 10, None) for i in range(12)]
+    before = ann.search_batch(items)
+    ann2 = pickle.loads(pickle.dumps(ann))
+    assert ann2.search_batch(items) == before
+    verify_tier_state(ann2)
+    # the restored store serves cold promotions (crash-safe rebuild)
+    assert ann2.rebalance_tiers_now() is not None
+
+
+def test_restore_refuses_tampered_tier_manifest():
+    """A checkpoint whose tier manifest lost a run must be refused BY
+    NAME before any state mutates — not limp into silent data loss."""
+    docs = _clustered(2000, seed=12)
+    ann = _tiered(docs, hot=2, ram=5)
+    ann.rebalance_tiers_now()
+    st = ann.__getstate__()
+    man = st["_tier_ckpt"]["manifest"]
+    assert man["runs"], "tamper target needs at least one sealed run"
+    man["runs"] = man["runs"][:-1]  # the tamper: drop a run record
+    fresh = IvfPqIndex.__new__(IvfPqIndex)
+    with pytest.raises(PlanVerificationError, match="spill-manifest"):
+        fresh.__setstate__(st)
+
+
+# ------------------------------------------------- verifier contract
+
+
+def _tier_session():
+    import pathway_tpu as pw
+    from pathway_tpu.internals.lowering import Session
+    from pathway_tpu.stdlib.indexing import DataIndex, IvfPqKnn
+
+    rng = np.random.default_rng(21)
+    vecs = rng.normal(size=(400, 8)).astype(np.float64).round(3)
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(vec=object, name=str),
+        [(tuple(vecs[i]), f"doc{i}") for i in range(len(vecs))],
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qvec=object),
+        [(tuple((vecs[i] + 0.01).round(3)),) for i in range(0, 400, 40)],
+    )
+    res = DataIndex(
+        docs,
+        IvfPqKnn(
+            data_column=docs.vec, dimensions=8, train_min=64,
+            tiered=True, hot_lists=2, ram_lists=4,
+        ),
+    ).query_as_of_now(queries.qvec, number_of_matches=5, with_distances=True)
+    s = Session()
+    s.capture(res)
+    s.execute()
+    return s
+
+
+def test_verify_session_proves_index_tier_contract():
+    from pathway_tpu.internals import verifier
+
+    s = _tier_session()
+    node = next(n for n in s.graph.nodes if hasattr(n, "index_tiers"))
+    (hi,) = node.index_tiers()
+    hi.stop_tiering()
+    hi.rebalance_tiers_now()
+    rep = verifier.verify_session(s)
+    assert rep["checks"]["index-tier-contract"]["indexes"] >= 1
+
+    # tamper 1: resurrect codes in the RAM cube of a cold list — the
+    # same doc now lives in two tiers
+    ts, gen = hi._tiers, hi._gen
+    cold = [l for l in ts.cold_lists() if gen.fill[l] > 0]
+    assert cold, "session index demoted nothing — tamper target missing"
+    gen.cube[cold[0], 0, :] = 7
+    with pytest.raises(PlanVerificationError, match="index-tier"):
+        verifier.verify_session(s)
+    gen.cube[cold[0], :, :] = 0
+
+    # tamper 2: flip a resident list's flag to cold with no sealed run —
+    # its docs would be unreachable
+    warm = int(np.flatnonzero((ts.tier != TIER_COLD) & (gen.fill > 0))[0])
+    ts.tier[warm] = TIER_COLD
+    with pytest.raises(
+        PlanVerificationError, match="index-tier.*no live run record"
+    ):
+        verifier.verify_session(s)
+
+
+# ------------------------------------------------------------ rerank
+
+
+def test_rerank_host_mirror_matches_device_fn():
+    from pathway_tpu.ops import rerank as rr
+
+    rng = np.random.default_rng(0)
+    q = rng.normal(size=(4, DIM)).astype(np.float32)
+    c = rng.normal(size=(4, 7, DIM)).astype(np.float32)
+    v = rng.random((4, 7)) > 0.3
+    for metric in ("cos", "l2sq", "dot"):
+        dev = np.asarray(rr._rerank_scores_fn(q, c, v, metric=metric))
+        host = rr.rerank_scores_host(q, c, v, metric)
+        np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-5)
+        assert np.all(np.isneginf(host[~v]))
+
+
+def test_reranked_index_recovers_probe_misses():
+    """nprobe=1 cripples first-stage recall; the reranked wrapper's
+    geometric nprobe expansion must claw it back above the quality bar
+    while plain overfetch-at-nprobe-1 cannot."""
+    docs = _clustered(3000, seed=14)
+    ex = VectorSlabIndex(dimensions=DIM, device=False)
+    _load(ex, docs)
+    rng = np.random.default_rng(15)
+    q = docs[rng.choice(len(docs), 40)] + 0.05 * rng.normal(size=(40, DIM))
+    items = [(q[i], 10, None) for i in range(len(q))]
+    ref = ex.search_batch(items)
+
+    base = IvfPqIndex(
+        dimensions=DIM, background_retrain=False, seed=0, nprobe=1
+    )
+    _load(base, docs)
+    base_recall = _recall_at(base.search_batch(items), ref)
+
+    wrapped = RerankedSlabIndex(base, expand=4, factor=2, max_rounds=4)
+    rr_recall = _recall_at(wrapped.search_batch(items), ref)
+    assert rr_recall >= max(base_recall, 0.9)
+    assert wrapped.counters["rerank_expansions"] > 0, \
+        "nprobe=1 must trigger the adaptive expansion"
+
+
+def test_rerank_results_keep_host_index_contract():
+    """Distances come back in the index's own convention, ascending by
+    (dist, key) — a reranked index is a drop-in host index."""
+    docs = _clustered(1200, seed=16)
+    ann = IvfPqIndex(dimensions=DIM, background_retrain=False, seed=0)
+    _load(ann, docs)
+    wrapped = RerankedSlabIndex(ann, expand=2)
+    res = wrapped.search([np.asarray(docs[0])], 8)
+    assert res
+    dists = [d for _k, d in res]
+    assert dists == sorted(dists)
+    assert all(d >= -1e-6 for d in dists)  # cos: 1 - sim >= 0
+
+
+def test_rerank_three_strike_degradation(monkeypatch):
+    from pathway_tpu.ops.rerank import BatchedReranker, rerank_scores_host
+
+    rer = BatchedReranker("cos", device=True)
+
+    def boom(*a, **k):
+        raise ValueError("synthetic transient device failure")
+
+    monkeypatch.setattr(rer, "_scores_device", boom)
+    rng = np.random.default_rng(1)
+    q = rng.normal(size=(2, DIM)).astype(np.float32)
+    c = rng.normal(size=(2, 3, DIM)).astype(np.float32)
+    v = np.ones((2, 3), bool)
+    want = rerank_scores_host(q, c, v, "cos")
+    for strike in range(3):
+        np.testing.assert_allclose(rer.scores(q, c, v), want, rtol=1e-6)
+    assert rer._use_device is False, "3 transient strikes must pin host"
+
+
+def test_rerank_device_ledger_stays_flat():
+    from pathway_tpu.engine.device_plane import get_device_plane
+
+    docs = _clustered(1200, seed=17)
+    ann = IvfPqIndex(dimensions=DIM, background_retrain=False, seed=0)
+    _load(ann, docs)
+    wrapped = RerankedSlabIndex(ann, expand=2)
+    items = [(docs[i], 5, None) for i in range(16)]
+    for _ in range(4):
+        wrapped.search_batch(items)
+    counts = {
+        bucket: n
+        for (prog, bucket), n in get_device_plane().compile_counts().items()
+        if prog == "rerank_scores"
+    }
+    assert counts, "rerank must route through the device plane"
+    assert all(n == 1 for n in counts.values()), counts
+
+
+# ------------------------------------------------- knn cache LRU bound
+
+
+def test_make_knn_searcher_cache_is_bounded_lru(monkeypatch):
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops import make_knn_searcher
+
+    monkeypatch.setenv("PATHWAY_KNN_CACHE", "2")
+    search = make_knn_searcher(5, ann=True)
+    mats = [jnp.asarray(_clustered(600, seed=20 + i)) for i in range(4)]
+    q = jnp.asarray(_clustered(4, seed=30))
+    for m in mats:
+        search(q, m)
+    cache = search._cache
+    assert len(cache) <= 2, "cache must evict beyond PATHWAY_KNN_CACHE"
+    # LRU order: the two most recently used matrices survive
+    kept = set(cache.keys())
+    assert kept == {id(mats[2]), id(mats[3])}
+    # a hit refreshes recency instead of rebuilding
+    search(q, mats[2])
+    search(q, mats[3])
+    assert set(search._cache.keys()) == {id(mats[2]), id(mats[3])}
+
+
+# --------------------------------------------------------- observability
+
+
+def test_tier_metrics_published_to_registry():
+    from pathway_tpu.internals import observability as obs
+
+    obs.enable()
+    try:
+        docs = _clustered(1500, seed=18)
+        ann = _tiered(docs, hot=2, ram=5)
+        ann.rebalance_tiers_now()
+        ann.search_batch([(docs[0], 10, None)])
+        snap = obs.PLANE.metrics.snapshot()
+        for name in (
+            "pathway_index_tier_rows",
+            "pathway_index_tier_promotions",
+            "pathway_index_tier_demotions",
+        ):
+            assert name in snap, f"{name} missing from the registry"
+            series = snap[name]["series"]
+            assert any(s["labels"].get("index") == ann.name for s in series)
+        rows = snap["pathway_index_tier_rows"]["series"]
+        tiers_seen = {
+            s["labels"]["tier"] for s in rows
+            if s["labels"].get("index") == ann.name
+        }
+        assert tiers_seen == {"hot", "warm", "cold"}
+        probe = snap.get("pathway_index_tier_probe_tier")
+        assert probe is not None, "probe-tier counter missing"
+    finally:
+        obs.disable()
